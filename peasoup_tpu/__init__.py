@@ -1,0 +1,21 @@
+"""peasoup_tpu — a TPU-native pulsar acceleration-search framework.
+
+A from-scratch re-design of the capabilities of the CUDA ``peasoup``
+pipeline (reference: xiaobotianxie/peasoup) for TPU hardware using
+JAX/XLA.  The search chain — incoherent dedispersion over a DM-trial
+grid, red-noise whitening, time-domain acceleration resampling,
+interbinned power spectra, harmonic summing, peak finding, candidate
+distillation/scoring and phase folding with PDMP-style optimisation —
+runs as jitted XLA programs with the DM x acceleration trial grid
+mapped onto batch axes and (multi-chip) a ``jax.sharding.Mesh``.
+
+Layout:
+    io/        SIGPROC filterbank/time-series readers and writers
+    ops/       numerical kernels (jnp/XLA; exact reference numerics)
+    search/    the search pipeline, plans, distillers, scorer, folder
+    parallel/  device-mesh sharding of the trial grid
+    output/    overview.xml + candidates.peasoup writers/readers
+    native/    C++ helpers (bit unpacking) with NumPy fallbacks
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from .sigproc import (
+    SigprocHeader,
+    read_sigproc_header,
+    write_sigproc_header,
+    Filterbank,
+    TimeSeries,
+    read_filterbank,
+    write_filterbank,
+    read_tim,
+    write_tim,
+)
+from .unpack import unpack_bits, pack_bits

@@ -1,0 +1,254 @@
+"""SIGPROC filterbank / time-series I/O.
+
+Implements the SIGPROC keyword-length-prefixed binary header format and
+whole-file data loading, with the same semantics as the reference
+(`include/data_types/header.hpp:222-308,339-403` and
+`include/data_types/filterbank.hpp:207-250` in xiaobotianxie/peasoup):
+
+* header keys are length-prefixed ASCII strings followed by a binary
+  value; parsing stops at ``HEADER_END``;
+* when ``nsamples`` is absent (0) it is inferred from the file size:
+  ``(total_size - header_size) / nchans * 8 / nbits``;
+* data are stored time-major (time slowest), ``nchans`` values per
+  sample, 1/2/4/8/32 bits each.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field, asdict
+
+import numpy as np
+
+from .unpack import unpack_bits, pack_bits
+
+# SIGPROC header keys -> struct format. Matches the reference parser's
+# accepted keyword set (header.hpp:265-296).
+_INT_KEYS = {
+    "nchans", "telescope_id", "machine_id", "data_type", "ibeam",
+    "nbeams", "nbits", "barycentric", "pulsarcentric", "nbins",
+    "nsamples", "nifs", "npuls",
+}
+_DOUBLE_KEYS = {
+    "az_start", "za_start", "src_raj", "src_dej", "tstart", "tsamp",
+    "period", "fch1", "foff", "refdm",
+}
+_CHAR_KEYS = {"signed"}
+_STRING_KEYS = {"source_name", "rawdatafile"}
+
+
+@dataclass
+class SigprocHeader:
+    """SIGPROC header values (defaults all zero, as in the reference)."""
+
+    source_name: str = ""
+    rawdatafile: str = ""
+    az_start: float = 0.0
+    za_start: float = 0.0
+    src_raj: float = 0.0
+    src_dej: float = 0.0
+    tstart: float = 0.0
+    tsamp: float = 0.0
+    period: float = 0.0
+    fch1: float = 0.0
+    foff: float = 0.0
+    nchans: int = 0
+    telescope_id: int = 0
+    machine_id: int = 0
+    data_type: int = 0
+    ibeam: int = 0
+    nbeams: int = 0
+    nbits: int = 0
+    barycentric: int = 0
+    pulsarcentric: int = 0
+    nbins: int = 0
+    nsamples: int = 0
+    nifs: int = 0
+    npuls: int = 0
+    refdm: float = 0.0
+    signed_data: int = 0
+    size: int = 0  # header size in bytes (set on read)
+
+    @property
+    def cfreq(self) -> float:
+        """Centre frequency in MHz (filterbank.hpp:190-196)."""
+        if self.foff < 0:
+            return self.fch1 + self.foff * self.nchans / 2.0
+        return self.fch1 - self.foff * self.nchans / 2.0
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _read_string(f) -> str | None:
+    raw = f.read(4)
+    if len(raw) < 4:
+        return None
+    (length,) = struct.unpack("<i", raw)
+    if length <= 0 or length >= 80:
+        return None
+    return f.read(length).decode("latin-1")
+
+
+def read_sigproc_header(f) -> SigprocHeader:
+    """Parse a SIGPROC header from an open binary file object."""
+    hdr = SigprocHeader()
+    start = f.tell()
+    s = _read_string(f)
+    if s != "HEADER_START":
+        f.seek(start)
+        raise ValueError("not a SIGPROC file (missing HEADER_START)")
+    while True:
+        key = _read_string(f)
+        if key is None:
+            raise ValueError("unexpected EOF inside SIGPROC header")
+        if key == "HEADER_END":
+            break
+        if key in _INT_KEYS:
+            (val,) = struct.unpack("<i", f.read(4))
+            setattr(hdr, key, val)
+        elif key in _DOUBLE_KEYS:
+            (val,) = struct.unpack("<d", f.read(8))
+            setattr(hdr, key, val)
+        elif key in _CHAR_KEYS:
+            hdr.signed_data = f.read(1)[0]
+        elif key in _STRING_KEYS:
+            val = _read_string(f)
+            setattr(hdr, key, val if val is not None else "")
+        else:
+            # The reference warns and continues; with no length knowledge we
+            # cannot skip an unknown binary value, so fail loudly instead.
+            raise ValueError(f"unknown SIGPROC header parameter: {key!r}")
+    hdr.size = f.tell() - start
+    if hdr.nsamples == 0:
+        # Infer from file size (header.hpp:394-401)
+        pos = f.tell()
+        f.seek(0, os.SEEK_END)
+        total = f.tell()
+        f.seek(pos)
+        hdr.nsamples = (total - hdr.size) * 8 // hdr.nchans // hdr.nbits
+    return hdr
+
+
+def _write_string(f, s: str) -> None:
+    b = s.encode("latin-1")
+    f.write(struct.pack("<i", len(b)))
+    f.write(b)
+
+
+def write_sigproc_header(f, hdr: SigprocHeader, include_nsamples: bool = False) -> None:
+    """Write a SIGPROC header (header.hpp:339-403 semantics)."""
+    _write_string(f, "HEADER_START")
+    for key in _STRING_KEYS:
+        val = getattr(hdr, key)
+        if val:
+            _write_string(f, key)
+            _write_string(f, val)
+    for key in sorted(_DOUBLE_KEYS):
+        _write_string(f, key)
+        f.write(struct.pack("<d", float(getattr(hdr, key))))
+    for key in sorted(_INT_KEYS):
+        if key == "nsamples" and not include_nsamples:
+            continue
+        _write_string(f, key)
+        f.write(struct.pack("<i", int(getattr(hdr, key))))
+    _write_string(f, "signed")
+    f.write(struct.pack("<B", hdr.signed_data))
+    _write_string(f, "HEADER_END")
+
+
+@dataclass
+class Filterbank:
+    """A time x frequency data block plus metadata.
+
+    ``data`` is a (nsamps, nchans) uint8 array for nbits<=8 input or
+    float32 for 32-bit input; time is the slow axis, channel 0 = fch1.
+    """
+
+    header: SigprocHeader
+    data: np.ndarray  # (nsamps, nchans)
+
+    @property
+    def nsamps(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def nchans(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def tsamp(self) -> float:
+        return self.header.tsamp
+
+    @property
+    def fch1(self) -> float:
+        return self.header.fch1
+
+    @property
+    def foff(self) -> float:
+        return self.header.foff
+
+    @property
+    def cfreq(self) -> float:
+        return self.header.cfreq
+
+
+@dataclass
+class TimeSeries:
+    """A 1-D time series with metadata (timeseries.hpp:50-161)."""
+
+    data: np.ndarray
+    tsamp: float
+    dm: float = 0.0
+
+    @property
+    def nsamps(self) -> int:
+        return self.data.shape[0]
+
+
+def read_filterbank(filename: str) -> Filterbank:
+    """Load a whole SIGPROC filterbank into RAM (filterbank.hpp:218-240)."""
+    with open(filename, "rb") as f:
+        hdr = read_sigproc_header(f)
+        nbytes = hdr.nsamples * hdr.nbits * hdr.nchans // 8
+        f.seek(hdr.size)
+        raw = np.frombuffer(f.read(nbytes), dtype=np.uint8)
+    if hdr.nbits == 32:
+        data = raw.view(np.float32).reshape(hdr.nsamples, hdr.nchans)
+    else:
+        data = unpack_bits(raw, hdr.nbits)[: hdr.nsamples * hdr.nchans]
+        data = data.reshape(hdr.nsamples, hdr.nchans)
+    return Filterbank(header=hdr, data=data)
+
+
+def write_filterbank(filename: str, fil: Filterbank) -> None:
+    hdr = fil.header
+    with open(filename, "wb") as f:
+        write_sigproc_header(f, hdr)
+        if hdr.nbits == 32:
+            f.write(np.ascontiguousarray(fil.data, dtype=np.float32).tobytes())
+        else:
+            flat = np.ascontiguousarray(fil.data, dtype=np.uint8).ravel()
+            f.write(pack_bits(flat, hdr.nbits).tobytes())
+
+
+def read_tim(filename: str) -> TimeSeries:
+    """Read a SIGPROC .tim file (float32 payload; timeseries.hpp:137-160)."""
+    with open(filename, "rb") as f:
+        hdr = read_sigproc_header(f)
+        raw = np.frombuffer(f.read(), dtype=np.float32)
+    return TimeSeries(data=raw.copy(), tsamp=hdr.tsamp, dm=hdr.refdm)
+
+
+def write_tim(filename: str, tim: TimeSeries, header: SigprocHeader | None = None) -> None:
+    hdr = header or SigprocHeader()
+    hdr.tsamp = tim.tsamp
+    hdr.refdm = tim.dm
+    hdr.nbits = 32
+    hdr.nchans = 1
+    hdr.nifs = 1
+    hdr.data_type = 2  # sigproc time series
+    with open(filename, "wb") as f:
+        write_sigproc_header(f, hdr)
+        f.write(np.ascontiguousarray(tim.data, dtype=np.float32).tobytes())

@@ -1,0 +1,64 @@
+"""Bit unpacking/packing for 1/2/4/8-bit SIGPROC data.
+
+Sub-byte samples are packed little-endian within each byte (first sample
+in the lowest-order bits), matching the unpack convention of the
+``dedisp`` library the reference links against
+(`include/transforms/dedisperser.hpp:104-112` feeds raw 1/2/4/8-bit
+words straight to ``dedisp_execute``).
+
+A C++ fast path (``peasoup_tpu/native``) is used when available; the
+NumPy lookup-table fallback below is always correct.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_LUTS: dict[int, np.ndarray] = {}
+
+
+def _lut(nbits: int) -> np.ndarray:
+    lut = _LUTS.get(nbits)
+    if lut is None:
+        spb = 8 // nbits  # samples per byte
+        mask = (1 << nbits) - 1
+        byte = np.arange(256, dtype=np.uint16)
+        lut = np.empty((256, spb), dtype=np.uint8)
+        for k in range(spb):
+            lut[:, k] = (byte >> (k * nbits)) & mask
+        _LUTS[nbits] = lut
+    return lut
+
+
+def unpack_bits(raw: np.ndarray, nbits: int) -> np.ndarray:
+    """Unpack a uint8 byte buffer into one uint8 value per sample."""
+    raw = np.asarray(raw, dtype=np.uint8)
+    if nbits == 8:
+        return raw
+    if nbits not in (1, 2, 4):
+        raise ValueError(f"unsupported nbits: {nbits}")
+    try:
+        from ..native import lib as _native
+    except Exception:
+        _native = None
+    if _native is not None:
+        return _native.unpack_bits(raw, nbits)
+    return _lut(nbits)[raw].ravel()
+
+
+def pack_bits(samples: np.ndarray, nbits: int) -> np.ndarray:
+    """Pack uint8 samples (values < 2**nbits) into a byte buffer."""
+    samples = np.asarray(samples, dtype=np.uint8)
+    if nbits == 8:
+        return samples
+    if nbits not in (1, 2, 4):
+        raise ValueError(f"unsupported nbits: {nbits}")
+    spb = 8 // nbits
+    n = samples.shape[0]
+    if n % spb:
+        samples = np.pad(samples, (0, spb - n % spb))
+    groups = samples.reshape(-1, spb).astype(np.uint16)
+    out = np.zeros(groups.shape[0], dtype=np.uint16)
+    for k in range(spb):
+        out |= (groups[:, k] & ((1 << nbits) - 1)) << (k * nbits)
+    return out.astype(np.uint8)

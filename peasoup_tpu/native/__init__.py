@@ -1,0 +1,63 @@
+"""Native (C++) helpers, compiled lazily with g++ and loaded via ctypes.
+
+If compilation fails (no compiler on the host), importing ``lib`` raises
+and callers fall back to the NumPy implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "_peasoup_native.so")
+_SOURCES = [os.path.join(_HERE, "unpack.cpp")]
+
+
+def _build() -> str:
+    newest_src = max(os.path.getmtime(s) for s in _SOURCES)
+    if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= newest_src:
+        return _SO_PATH
+    with tempfile.TemporaryDirectory() as td:
+        tmp_so = os.path.join(td, "native.so")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SOURCES, "-o", tmp_so]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp_so, _SO_PATH)
+    return _SO_PATH
+
+
+class _NativeLib:
+    def __init__(self) -> None:
+        self._dll = ctypes.CDLL(_build())
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._dll.unpack_bits.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int, u8p]
+        self._dll.pack_bits.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int, u8p]
+
+    def unpack_bits(self, raw: np.ndarray, nbits: int) -> np.ndarray:
+        raw = np.ascontiguousarray(raw, dtype=np.uint8)
+        out = np.empty(raw.size * (8 // nbits), dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._dll.unpack_bits(
+            raw.ctypes.data_as(u8p), raw.size, nbits, out.ctypes.data_as(u8p)
+        )
+        return out
+
+    def pack_bits(self, samples: np.ndarray, nbits: int) -> np.ndarray:
+        samples = np.ascontiguousarray(samples, dtype=np.uint8)
+        spb = 8 // nbits
+        out = np.empty((samples.size + spb - 1) // spb, dtype=np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        self._dll.pack_bits(
+            samples.ctypes.data_as(u8p), samples.size, nbits, out.ctypes.data_as(u8p)
+        )
+        return out
+
+
+try:
+    lib: _NativeLib | None = _NativeLib()
+except Exception:  # pragma: no cover - depends on host toolchain
+    lib = None

@@ -1,0 +1,44 @@
+// Native bit-unpack/pack helpers for SIGPROC sub-byte data.
+//
+// TPU-native counterpart of the byte-level unpacking the reference
+// delegates to the dedisp CUDA library (dedisperser.hpp:104-112): here
+// the unpack runs on the host CPU as part of the IO layer (the TPU
+// compute path receives float32/uint8 arrays).
+//
+// Samples are packed little-endian within each byte: sample k of a byte
+// occupies bits [k*nbits, (k+1)*nbits).
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+void unpack_bits(const uint8_t* in, size_t nbytes, int nbits, uint8_t* out) {
+    const int spb = 8 / nbits;
+    const uint8_t mask = static_cast<uint8_t>((1u << nbits) - 1u);
+    for (size_t i = 0; i < nbytes; ++i) {
+        const uint8_t b = in[i];
+        uint8_t* o = out + i * spb;
+        for (int k = 0; k < spb; ++k) {
+            o[k] = (b >> (k * nbits)) & mask;
+        }
+    }
+}
+
+void pack_bits(const uint8_t* in, size_t nsamples, int nbits, uint8_t* out) {
+    const int spb = 8 / nbits;
+    const uint8_t mask = static_cast<uint8_t>((1u << nbits) - 1u);
+    const size_t nbytes = (nsamples + spb - 1) / spb;
+    for (size_t i = 0; i < nbytes; ++i) {
+        uint8_t b = 0;
+        for (int k = 0; k < spb; ++k) {
+            const size_t s = i * spb + k;
+            if (s < nsamples) {
+                b |= static_cast<uint8_t>((in[s] & mask) << (k * nbits));
+            }
+        }
+        out[i] = b;
+    }
+}
+
+}  // extern "C"

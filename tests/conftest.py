@@ -1,0 +1,30 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
+# exercised without TPU hardware. Must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+REFERENCE = "/root/reference"
+
+
+@pytest.fixture(scope="session")
+def tutorial_fil() -> str:
+    path = os.path.join(REFERENCE, "example_data", "tutorial.fil")
+    if not os.path.exists(path):
+        pytest.skip("reference tutorial.fil not available")
+    return path
+
+
+@pytest.fixture(scope="session")
+def golden_overview() -> str:
+    path = os.path.join(REFERENCE, "example_output", "overview.xml")
+    if not os.path.exists(path):
+        pytest.skip("reference example output not available")
+    return path

@@ -1,0 +1,91 @@
+import io
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.io import (
+    Filterbank,
+    SigprocHeader,
+    pack_bits,
+    read_filterbank,
+    read_sigproc_header,
+    unpack_bits,
+    write_filterbank,
+    write_sigproc_header,
+)
+from peasoup_tpu.io.unpack import _lut
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4, 8])
+def test_pack_unpack_roundtrip(nbits):
+    rng = np.random.default_rng(0)
+    samples = rng.integers(0, 1 << nbits, size=4096, dtype=np.uint8)
+    packed = pack_bits(samples, nbits)
+    assert packed.size == samples.size * nbits // 8
+    unpacked = unpack_bits(packed, nbits)
+    np.testing.assert_array_equal(unpacked, samples)
+
+
+@pytest.mark.parametrize("nbits", [1, 2, 4])
+def test_native_matches_numpy(nbits):
+    from peasoup_tpu import native
+
+    if native.lib is None:
+        pytest.skip("native helpers unavailable")
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, size=1000, dtype=np.uint8)
+    np.testing.assert_array_equal(
+        native.lib.unpack_bits(raw, nbits), _lut(nbits)[raw].ravel()
+    )
+
+
+def test_header_roundtrip():
+    hdr = SigprocHeader(
+        source_name="TESTPSR",
+        tstart=55000.0,
+        tsamp=6.4e-5,
+        fch1=1510.0,
+        foff=-1.09,
+        nchans=64,
+        nbits=8,
+        nifs=1,
+        data_type=1,
+        nsamples=1024,
+    )
+    buf = io.BytesIO()
+    write_sigproc_header(buf, hdr, include_nsamples=True)
+    buf.seek(0)
+    parsed = read_sigproc_header(buf)
+    for key in ("source_name", "tstart", "tsamp", "fch1", "foff", "nchans",
+                "nbits", "nsamples"):
+        assert getattr(parsed, key) == getattr(hdr, key)
+
+
+def test_filterbank_roundtrip(tmp_path):
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 4, size=(512, 16), dtype=np.uint8)
+    hdr = SigprocHeader(tsamp=1e-4, fch1=1400.0, foff=-0.5, nchans=16,
+                        nbits=2, nifs=1, data_type=1, nsamples=512)
+    path = str(tmp_path / "test.fil")
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    fil = read_filterbank(path)
+    assert fil.nsamps == 512 and fil.nchans == 16
+    np.testing.assert_array_equal(fil.data, data)
+
+
+def test_read_tutorial_header(tutorial_fil):
+    # Golden values from example_output/overview.xml <header_parameters>
+    fil = read_filterbank(tutorial_fil)
+    h = fil.header
+    assert h.nchans == 64
+    assert h.nbits == 2
+    assert h.nsamples == 187520
+    assert h.tsamp == pytest.approx(0.00032)
+    assert h.fch1 == pytest.approx(1510.0)
+    assert h.foff == pytest.approx(-1.09)
+    assert h.tstart == pytest.approx(50000.0)
+    assert h.source_name.startswith("P: 250")
+    assert fil.data.shape == (187520, 64)
+    assert fil.data.max() <= 3
+    # centre frequency as used by AccelerationPlan / scorer
+    assert h.cfreq == pytest.approx(1510.0 - 1.09 * 32)
