@@ -1,0 +1,7 @@
+from .dedisperse import (
+    generate_dm_list,
+    delay_table,
+    delays_in_samples,
+    max_delay,
+    dedisperse,
+)

@@ -1,0 +1,91 @@
+import re
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from peasoup_tpu.io import read_filterbank
+from peasoup_tpu.ops.dedisperse import (
+    dedisperse,
+    dedisperse_numpy,
+    delay_table,
+    delays_in_samples,
+    generate_dm_list,
+    max_delay,
+)
+
+
+def golden_dm_list(overview_path):
+    with open(overview_path) as f:
+        text = f.read()
+    block = text.split("<dedispersion_trials", 1)[1].split("</dedispersion_trials>")[0]
+    return np.array(
+        [float(m) for m in re.findall(r"<trial id='\d+'>([^<]+)</trial>", block)],
+        dtype=np.float64,
+    )
+
+
+def test_dm_list_matches_golden(golden_overview):
+    # tutorial.fil: tsamp=0.00032, fch1=1510, foff=-1.09, nchans=64
+    dms = generate_dm_list(0.0, 250.0, 0.00032, 64.0, 1510.0, -1.09, 64, 1.10)
+    golden = golden_dm_list(golden_overview)
+    assert len(dms) == len(golden) == 59
+    np.testing.assert_allclose(dms, golden, rtol=2e-5)
+
+
+def test_dm_list_trivial_range():
+    dms = generate_dm_list(5.0, 5.0, 0.00032, 64.0, 1510.0, -1.09, 64, 1.10)
+    assert len(dms) == 1 and dms[0] == pytest.approx(5.0)
+
+
+def test_delay_table_signs():
+    tab = delay_table(64, 0.00032, 1510.0, -1.09)
+    assert tab[0] == 0.0
+    assert np.all(np.diff(tab) > 0)  # lower freq -> larger delay
+    # analytic check on last channel
+    f0, f63 = 1510.0, 1510.0 - 63 * 1.09
+    expected = 4.15e3 / 0.00032 * (1.0 / f63**2 - 1.0 / f0**2)
+    assert tab[63] == pytest.approx(expected, rel=1e-6)
+
+
+def test_dedisperse_recovers_pulse():
+    # Synthetic filterbank with one dispersed pulse: at the right DM the
+    # channel sum is perfectly aligned.
+    nchans, nsamps, dm = 16, 4096, 50.0
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    dm_list = np.array([0.0, dm, 100.0], dtype=np.float32)
+    delays = delays_in_samples(dm_list, tab)
+    data = np.zeros((nchans, nsamps), dtype=np.float32)
+    t0 = 1000
+    for c in range(nchans):
+        data[c, t0 + delays[1, c]] = 1.0
+    out_nsamps = nsamps - max_delay(dm_list, tab)
+    out = np.asarray(dedisperse(jnp.asarray(data), jnp.asarray(delays), out_nsamps))
+    assert out.shape == (3, out_nsamps)
+    assert out[1, t0] == pytest.approx(nchans)  # aligned
+    assert out[0].max() < nchans  # misaligned at DM=0
+    np.testing.assert_allclose(
+        out, dedisperse_numpy(data, delays, out_nsamps), rtol=1e-6
+    )
+
+
+def test_dedisperse_killmask():
+    nchans, nsamps = 8, 256
+    data = np.ones((nchans, nsamps), dtype=np.float32)
+    delays = np.zeros((1, nchans), dtype=np.int32)
+    mask = np.array([1, 1, 0, 1, 0, 1, 1, 1], dtype=np.float32)
+    out = np.asarray(
+        dedisperse(jnp.asarray(data), jnp.asarray(delays), nsamps, jnp.asarray(mask))
+    )
+    assert np.all(out == 6.0)
+
+
+def test_tutorial_max_delay(tutorial_fil):
+    fil = read_filterbank(tutorial_fil)
+    dms = generate_dm_list(0.0, 250.0, fil.tsamp, 64.0, fil.fch1, fil.foff,
+                           fil.nchans, 1.10)
+    tab = delay_table(fil.nchans, fil.tsamp, fil.fch1, fil.foff)
+    md = max_delay(dms, tab)
+    # ~140 samples at DM 252.98 for the tutorial setup
+    assert 100 < md < 200
+    assert fil.nsamps - md > 131072  # search still uses a 2**17 FFT
