@@ -18,4 +18,12 @@ Layout:
     native/    C++ helpers (bit unpacking) with NumPy fallbacks
 """
 
+import jax as _jax
+
+# The acceleration-resampling index ramp (ops/resample.py) needs true
+# float64: i*(i-n) reaches ~2^45 for 2^23-point series and a 1-sample
+# index error moves power between Fourier bins. Everything else is kept
+# explicitly float32/bfloat16.
+_jax.config.update("jax_enable_x64", True)
+
 __version__ = "0.1.0"

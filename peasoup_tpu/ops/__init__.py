@@ -5,3 +5,14 @@ from .dedisperse import (
     max_delay,
     dedisperse,
 )
+from .spectrum import form_power, form_interpolated
+from .rednoise import median_scrunch5, linear_stretch, running_median, deredden
+from .zap import zap_birdies, load_zaplist
+from .stats import mean_rms_std, normalise
+from .resample import resample, resample2
+from .harmonics import harmonic_sums
+from .peaks import (
+    extract_above_threshold,
+    identify_unique_peaks,
+    spectrum_search_bounds,
+)

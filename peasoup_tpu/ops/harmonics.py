@@ -1,0 +1,46 @@
+"""Incremental harmonic summing.
+
+Reference semantics: `src/kernels.cu:33-99`.  Level k (1-based) adds the
+spectrum sampled at stretched indices ``(int)(i * m/2^k + 0.5)`` for the
+odd numerators m of 2^k, accumulating on the previous level, and stores
+``val / sqrt(2^k)``.  Up to 5 levels (2, 4, 8, 16, 32 summed harmonics).
+
+The reference evaluates ``i * m/2^k + 0.5`` in float64; here the index
+is computed with exact integer arithmetic — ``(i*m + 2^(k-1)) >> k`` is
+identical to ``floor(i * m/2^k + 0.5)`` for all i — avoiding float64 on
+TPU entirely.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_SCALES = [
+    0.7071067811865476,  # 1/sqrt(2)
+    0.5,
+    0.35355339059327373,  # 1/sqrt(8)
+    0.25,
+    0.17677669529663687,  # 1/sqrt(32)
+]
+
+
+def harmonic_sums(spectrum: jnp.ndarray, nharms: int) -> list[jnp.ndarray]:
+    """Return ``nharms`` stretched-and-summed spectra (levels 1..nharms).
+
+    ``spectrum`` is the (normalised, interbinned) power spectrum; output
+    level k sums 2^k harmonics and is scaled by 1/sqrt(2^k).
+    """
+    if not 1 <= nharms <= 5:
+        raise ValueError("nharms must be in 1..5")
+    size = spectrum.shape[0]
+    i = jnp.arange(size, dtype=jnp.int32)
+    out = []
+    val = spectrum
+    for k in range(1, nharms + 1):
+        denom_log2 = k
+        half = 1 << (k - 1)
+        for m in range(1, 1 << k, 2):  # odd numerators: the new harmonics
+            idx = (i * m + half) >> denom_log2
+            val = val + spectrum[jnp.clip(idx, 0, size - 1)]
+        out.append((val * jnp.float32(_SCALES[k - 1])).astype(jnp.float32))
+    return out

@@ -1,0 +1,94 @@
+"""Thresholded peak extraction and unique-peak merging.
+
+Reference semantics: `src/kernels.cu:384-416` (Thrust ``copy_if`` of all
+bins above threshold, in index order) +
+`include/transforms/peakfinder.hpp:27-94` (host merge of peaks closer
+than ``min_gap`` bins, then conversion to fundamental frequency).
+
+The dynamic-size ``copy_if`` is re-cast for TPU as a fixed-capacity
+top-k compaction: the k smallest above-threshold bin indices (plus the
+true above-threshold count) come back in one device->host transfer per
+spectrum, keeping the jitted program shape-static and making per-shard
+candidate buffers collective-friendly.  The reference's own capacity is
+100000 (`peakfinder.hpp:17,61`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def extract_above_threshold(
+    spectrum: jnp.ndarray,
+    thresh,
+    start_idx: int,
+    stop_idx: int,
+    capacity: int,
+):
+    """Compact the above-threshold bins of [start_idx, stop_idx).
+
+    Returns (idxs, snrs, count): the ``capacity`` smallest qualifying
+    bin indices in ascending order (padded with -1), their values, and
+    the true number of qualifying bins (may exceed ``capacity``).
+    """
+    size = spectrum.shape[0]
+    i = jnp.arange(size, dtype=jnp.int32)
+    mask = (i >= start_idx) & (i < stop_idx) & (spectrum > thresh)
+    sentinel = jnp.int32(-(size + 1))
+    score = jnp.where(mask, -i, sentinel)
+    top, _ = jax.lax.top_k(score, capacity)  # largest scores = smallest idx
+    valid = top != sentinel
+    idxs = jnp.where(valid, -top, -1)
+    snrs = jnp.where(valid, spectrum[jnp.clip(-top, 0, size - 1)], 0.0)
+    count = jnp.sum(mask, dtype=jnp.int32)
+    return idxs, snrs.astype(jnp.float32), count
+
+
+def identify_unique_peaks(
+    idxs: np.ndarray, snrs: np.ndarray, min_gap: int = 30
+):
+    """Greedy merge of above-threshold bins into unique peaks.
+
+    Exact reproduction of `peakfinder.hpp:27-56`: walking in index
+    order, a group keeps absorbing bins while the next bin is within
+    ``min_gap`` of the index of the group's current best peak (the
+    "last" index only advances when a higher value is found).
+    """
+    peak_idxs: list[int] = []
+    peak_snrs: list[float] = []
+    count = len(idxs)
+    ii = 0
+    while ii < count:
+        cpeak = snrs[ii]
+        cpeakidx = idxs[ii]
+        lastidx = idxs[ii]
+        ii += 1
+        while ii < count and (idxs[ii] - lastidx) < min_gap:
+            if snrs[ii] > cpeak:
+                cpeak = snrs[ii]
+                cpeakidx = idxs[ii]
+                lastidx = idxs[ii]
+            ii += 1
+        peak_idxs.append(int(cpeakidx))
+        peak_snrs.append(float(cpeak))
+    return np.array(peak_idxs, dtype=np.int64), np.array(peak_snrs, dtype=np.float32)
+
+
+def spectrum_search_bounds(
+    size: int, bin_width: float, nh: int, min_freq: float, max_freq: float
+):
+    """Search window and frequency factor for a harmonic-summed spectrum.
+
+    Matches `peakfinder.hpp:77-94`: ``nh`` is the harmonic level (0 for
+    the fundamental spectrum, k for the 2^k-harmonic sum); returned
+    ``freq_factor`` converts a bin index to the fundamental frequency.
+    """
+    nyquist = bin_width * size
+    orig_size = 2.0 * (size - 1.0)
+    max_bin = int((max_freq / bin_width) * 2.0 ** nh)
+    start_idx = int(orig_size * (min_freq / nyquist) * 2.0 ** nh)
+    stop_idx = min(size, max_bin)
+    freq_factor = 1.0 / size * nyquist / 2.0 ** nh
+    return start_idx, stop_idx, freq_factor

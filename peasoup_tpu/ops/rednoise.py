@@ -1,0 +1,80 @@
+"""Red-noise running-median estimation and dereddening.
+
+Reference semantics: the Heimdall-style median-scrunch-by-5 cascade and
+linear stretch (`src/kernels.cu:875-1011`) spliced at two boundary
+frequencies (`include/transforms/dereddener.hpp:40-62`), then complex
+division of the Fourier series by the median curve with bins 0-4 zeroed
+(`src/kernels.cu:1013-1034`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def median_scrunch5(x: jnp.ndarray) -> jnp.ndarray:
+    """Median of each consecutive group of 5; truncates the remainder.
+
+    For inputs shorter than 5 the reference returns a single value
+    (median / mean-of-middle pair), `src/kernels.cu:947-981`.
+    """
+    n = x.shape[0]
+    if n >= 5:
+        groups = x[: (n // 5) * 5].reshape(-1, 5)
+        return jnp.sort(groups, axis=1)[:, 2]
+    if n == 1:
+        return x[:1]
+    if n == 2:
+        return jnp.mean(x, keepdims=True)
+    s = jnp.sort(x)
+    if n == 3:
+        return s[1:2]
+    return jnp.mean(s[1:3], keepdims=True)  # n == 4
+
+
+def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
+    """Linear-interpolation stretch to ``out_count`` points.
+
+    Matches `src/kernels.cu:983-1011`: float32 step arithmetic, and the
+    interpolation term is dropped when the fractional part is <= 1e-5.
+    """
+    in_count = x.shape[0]
+    step = jnp.float32(in_count - 1) / jnp.float32(out_count - 1)
+    xi = jnp.arange(out_count, dtype=jnp.float32) * step
+    j = xi.astype(jnp.int32)
+    frac = xi - j.astype(jnp.float32)
+    nxt = x[jnp.minimum(j + 1, in_count - 1)]
+    base = x[j]
+    return jnp.where(frac > 1e-5, base + frac * (nxt - base), base)
+
+
+def running_median(
+    powers: jnp.ndarray,
+    bin_width: float,
+    boundary_5_freq: float = 0.05,
+    boundary_25_freq: float = 0.5,
+) -> jnp.ndarray:
+    """Three-level scrunch5 cascade spliced at the boundary frequencies.
+
+    Below ``boundary_5_freq`` the (stretched) scrunch-by-5 median is
+    used, below ``boundary_25_freq`` the scrunch-by-25, above it the
+    scrunch-by-125 (`dereddener.hpp:40-62`).
+    """
+    size = powers.shape[0]
+    pos5 = int(boundary_5_freq / bin_width)
+    pos25 = int(boundary_25_freq / bin_width)
+    m5 = median_scrunch5(powers)
+    m25 = median_scrunch5(m5)
+    m125 = median_scrunch5(m25)
+    s5 = linear_stretch(m5, size)
+    s25 = linear_stretch(m25, size)
+    s125 = linear_stretch(m125, size)
+    idx = jnp.arange(size)
+    return jnp.where(idx < pos5, s5, jnp.where(idx < pos25, s25, s125))
+
+
+def deredden(fseries: jnp.ndarray, median: jnp.ndarray) -> jnp.ndarray:
+    """Divide the complex series by the real median; zero bins 0-4."""
+    out = fseries / median.astype(fseries.real.dtype)
+    idx = jnp.arange(fseries.shape[0])
+    return jnp.where(idx < 5, jnp.zeros((), dtype=fseries.dtype), out)

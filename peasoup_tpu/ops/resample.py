@@ -1,0 +1,52 @@
+"""Time-domain acceleration resampling.
+
+Reference semantics: `src/kernels.cu:308-379`.  Two index maps:
+
+* ``resample`` (kernel I, used for folding): read index
+  ``rn(i + af*((i - n/2)^2 - (n/2)^2))`` — symmetric about the midpoint;
+* ``resample2`` (kernel II, used by the shipped search binary): read
+  index ``rn(i + i*af*(i - n))`` — zero shift at both ends;
+
+with ``af = a * tsamp / (2c)`` and rn = round-half-to-even
+(``__double2ull_rn``).  The index ramp must be evaluated in float64:
+``i*(i-n)`` reaches ~2^45 for 2^23-point series, far beyond float32's
+24-bit mantissa, and a 1-sample index error moves power between Fourier
+bins.  float64 is software-emulated on TPU but this is 3 flops/element
+against an O(n log n) FFT chain, so it is off the critical path.
+
+The gather itself stays monotone and near-linear, which XLA lowers to a
+dynamic-slice-like access pattern rather than a random gather.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+def _accel_fact(accel, tsamp) -> jnp.ndarray:
+    return (
+        jnp.asarray(accel, jnp.float64)
+        * jnp.asarray(tsamp, jnp.float64)
+        / (2.0 * SPEED_OF_LIGHT)
+    )
+
+
+def resample(tim: jnp.ndarray, accel, tsamp) -> jnp.ndarray:
+    """Kernel-I resampling, symmetric about the midpoint."""
+    n = tim.shape[0]
+    af = _accel_fact(accel, tsamp)
+    i = jnp.arange(n, dtype=jnp.float64)
+    half = jnp.float64(n) / 2.0
+    idx = jnp.rint(i + af * ((i - half) ** 2 - half * half)).astype(jnp.int32)
+    return tim[jnp.clip(idx, 0, n - 1)]
+
+
+def resample2(tim: jnp.ndarray, accel, tsamp) -> jnp.ndarray:
+    """Kernel-II resampling (zero shift at both ends); the search path."""
+    n = tim.shape[0]
+    af = _accel_fact(accel, tsamp)
+    i = jnp.arange(n, dtype=jnp.float64)
+    idx = jnp.rint(i + i * af * (i - jnp.float64(n))).astype(jnp.int32)
+    return tim[jnp.clip(idx, 0, n - 1)]

@@ -1,0 +1,34 @@
+"""Power-spectrum forming (plain and interbinned).
+
+Reference semantics: `src/kernels.cu:215-252` via
+`include/transforms/spectrumformer.hpp:6-24`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def form_power(fseries: jnp.ndarray) -> jnp.ndarray:
+    """Plain amplitude spectrum: sqrt(re^2 + im^2).
+
+    (The reference computes ``z * rsqrtf(z)`` which is sqrt(z) except it
+    produces NaN at exact zeros; we produce 0 there.)
+    """
+    z = jnp.real(fseries) ** 2 + jnp.imag(fseries) ** 2
+    return jnp.sqrt(z).astype(jnp.float32)
+
+
+def form_interpolated(fseries: jnp.ndarray) -> jnp.ndarray:
+    """Interbinned spectrum: sqrt(max(|X_k|^2, 0.5*|X_k - X_{k-1}|^2)).
+
+    Recovers scalloping loss for signals between Fourier bins
+    (`src/kernels.cu:231-252`); X_{-1} is taken as 0.
+    """
+    re = jnp.real(fseries).astype(jnp.float32)
+    im = jnp.imag(fseries).astype(jnp.float32)
+    re_l = jnp.concatenate([jnp.zeros((1,), re.dtype), re[:-1]])
+    im_l = jnp.concatenate([jnp.zeros((1,), im.dtype), im[:-1]])
+    ampsq = re * re + im * im
+    ampsq_diff = 0.5 * ((re - re_l) ** 2 + (im - im_l) ** 2)
+    return jnp.sqrt(jnp.maximum(ampsq, ampsq_diff)).astype(jnp.float32)

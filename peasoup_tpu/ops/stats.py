@@ -1,0 +1,23 @@
+"""Array statistics and normalisation.
+
+Reference semantics: Thrust reductions `src/kernels.cu:420-494` wrapped
+by `include/utils/stats.hpp`: mean = sum/n, rms = sqrt(sumsq/n),
+std = sqrt(rms^2 - mean^2); normalise maps x -> (x - mean) / sigma.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def mean_rms_std(x: jnp.ndarray, min_bin: int = 0):
+    n = x.shape[0] - min_bin
+    xs = x[min_bin:]
+    mean = jnp.sum(xs) / n
+    rms = jnp.sqrt(jnp.sum(xs * xs) / n)
+    std = jnp.sqrt(rms * rms - mean * mean)
+    return mean.astype(jnp.float32), rms.astype(jnp.float32), std.astype(jnp.float32)
+
+
+def normalise(x: jnp.ndarray, mean, sigma) -> jnp.ndarray:
+    return ((x - mean) / sigma).astype(jnp.float32)

