@@ -1,0 +1,71 @@
+"""Candidate records and collections.
+
+Mirrors `include/data_types/candidates.hpp:10-166`: a detection with
+(dm, dm_idx, acc, nh, snr, freq), optional folded results, and a
+recursive ``assoc`` list of related detections absorbed by the
+distillers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class Candidate:
+    dm: float = 0.0
+    dm_idx: int = 0
+    acc: float = 0.0
+    nh: int = 0
+    snr: float = 0.0
+    freq: float = 0.0
+    folded_snr: float = 0.0
+    opt_period: float = 0.0
+    is_adjacent: bool = False
+    is_physical: bool = False
+    ddm_count_ratio: float = 0.0
+    ddm_snr_ratio: float = 0.0
+    assoc: list["Candidate"] = field(default_factory=list)
+    fold: np.ndarray | None = None
+    nbins: int = 0
+    nints: int = 0
+
+    @property
+    def period(self) -> float:
+        return 1.0 / self.freq
+
+    def append(self, other: "Candidate") -> None:
+        self.assoc.append(other)
+
+    def count_assoc(self) -> int:
+        return sum(1 + a.count_assoc() for a in self.assoc)
+
+    def collect(self) -> list["Candidate"]:
+        """Flatten self + the assoc tree (pre-order, like the reference
+        ``collect_candidates``)."""
+        out = [self]
+        for a in self.assoc:
+            out.extend(a.collect())
+        return out
+
+
+class CandidateCollection:
+    def __init__(self, cands: list[Candidate] | None = None):
+        self.cands: list[Candidate] = list(cands) if cands else []
+
+    def append(self, other) -> None:
+        if isinstance(other, CandidateCollection):
+            self.cands.extend(other.cands)
+        else:
+            self.cands.extend(other)
+
+    def __len__(self) -> int:
+        return len(self.cands)
+
+    def __iter__(self):
+        return iter(self.cands)
+
+    def __getitem__(self, i):
+        return self.cands[i]

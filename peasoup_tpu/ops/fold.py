@@ -1,0 +1,160 @@
+"""Phase folding and Fourier-domain fold optimisation.
+
+Reference semantics:
+
+* ``fold_time_series`` — `src/kernels.cu:597-651`: nsubints x nbins
+  profile; sample j lands in phase bin floor(frac(j*tsamp/period)*nbins)
+  of subint j // (nsamps//nsubints); each bin's accumulator is divided
+  by (count+1) (the reference initialises its counter to 1).
+* ``optimise_fold`` — `include/transforms/folder.hpp:65-335` +
+  `src/kernels.cu:655-865`: FFT the subints along phase, apply nshifts
+  per-subint linear phase ramps (a period-derivative search), collapse
+  subints, multiply by FFT'd boxcar templates of every width / sqrt(w),
+  inverse FFT, and take the argmax over (template, shift, bin).  The
+  S/N of the optimised profile is computed on-host from on/off-pulse
+  statistics (`folder.hpp:140-183`), and the optimised period is
+  ``p * (((32 - opt_shift) * p) / (nbins * tobs) + 1)`` — the hardcoded
+  32 ( = nbins/2 only when nbins=64) is reproduced as-is and flagged
+  here: REFERENCE-QUIRK(folder.hpp:330).
+
+Deviation: jnp's normalised ifft replaces cuFFT's unnormalised inverse;
+every consumer (argmax, on/off-pulse S/N) is scale-invariant.  Negative
+profile-rotation indices use true modulo where the reference's C ``%``
+would read out of bounds (UB) — REFERENCE-QUIRK(folder.hpp:153-155).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("nbins", "nints"))
+def fold_time_series(
+    tim: jnp.ndarray, period, tsamp, nbins: int = 64, nints: int = 16
+) -> jnp.ndarray:
+    """Fold a time series into an (nints, nbins) sub-integration profile."""
+    nsamps = tim.shape[0]
+    nper = nsamps // nints
+    used = nper * nints
+    j = jnp.arange(used, dtype=jnp.float64)
+    tbp = jnp.asarray(tsamp, jnp.float64) / jnp.asarray(period, jnp.float64)
+    phase = j * tbp
+    frac = phase - jnp.floor(phase)
+    binidx = jnp.floor(frac * nbins).astype(jnp.int32)
+    subint = (jnp.arange(used, dtype=jnp.int32) // nper).astype(jnp.int32)
+    flat = subint * nbins + binidx
+    sums = jax.ops.segment_sum(tim[:used], flat, num_segments=nints * nbins)
+    counts = jax.ops.segment_sum(
+        jnp.ones((used,), jnp.float32), flat, num_segments=nints * nbins
+    )
+    prof = sums / (counts + 1.0)  # reference counter starts at 1
+    return prof.reshape(nints, nbins).astype(jnp.float32)
+
+
+@partial(jax.jit, static_argnames=())
+def _optimise_core(subints: jnp.ndarray):
+    """Device part of the fold optimisation.
+
+    Returns (argmax_flat, opt_subints_real, opt_profiles_real) where the
+    per-shift real profiles/subints are produced for all shifts (the
+    host then selects the optimum — nbins*nints*nshifts is tiny).
+    """
+    nints, nbins = subints.shape
+    nshifts = nbins
+    ntemplates = nbins - 1
+    fsub = jnp.fft.fft(subints.astype(jnp.complex64), axis=1)
+
+    shifts = (jnp.arange(nshifts, dtype=jnp.float32) - nshifts // 2)
+    m = jnp.arange(nints, dtype=jnp.float32)
+    b = jnp.arange(nbins, dtype=jnp.float32)
+    ramp = b * (2.0 * np.float32(np.pi)) / nbins
+    ramp = jnp.where(b > nbins // 2, ramp - 2.0 * np.float32(np.pi), ramp)
+    # shift amount per (s, m): (m/nints) * shifts[s]
+    amount = (m[None, :] / nints) * shifts[:, None]  # (s, m)
+    phase = -ramp[None, None, :] * amount[:, :, None]  # (s, m, b)
+    shiftar = jnp.exp(1j * phase.astype(jnp.float32)).astype(jnp.complex64)
+
+    post_shift = fsub[None, :, :] * shiftar  # (s, m, b)
+    profiles = jnp.sum(post_shift, axis=1)  # (s, b)
+
+    w = jnp.arange(ntemplates, dtype=jnp.int32)
+    templates = (b[None, :].astype(jnp.int32) <= w[:, None]).astype(jnp.complex64)
+    ftemp = jnp.fft.fft(templates, axis=1)  # (w, b)
+
+    norm = jnp.sqrt(w.astype(jnp.float32) + 1.0)
+    final = (
+        profiles[None, :, :] * ftemp[:, None, :] / norm[:, None, None]
+    )  # (w, s, b)
+    final = final.at[:, :, 0].set(0.0)
+    td = jnp.fft.ifft(final, axis=2)
+    absarr = jnp.abs(td)
+    argmax = jnp.argmax(absarr.reshape(-1))
+
+    opt_subints_all = jnp.real(jnp.fft.ifft(post_shift, axis=2))  # (s, m, b)
+    opt_profiles_all = jnp.real(jnp.fft.ifft(profiles, axis=1))  # (s, b)
+    return argmax, opt_subints_all, opt_profiles_all
+
+
+def calculate_sn(prof: np.ndarray, bin_: int, width: int, nbins: int):
+    """On/off-pulse S/N of a profile (`folder.hpp:140-183`)."""
+    edge = int(width * 0.3 + 0.5)
+    width_by_2 = int(width / 2.0 + 0.5)
+    rprof = np.array([prof[(bin_ - nbins // 2 + ii) % nbins] for ii in range(nbins)])
+    bin_ = nbins // 2 - 1
+    upper_edge = bin_ + (width_by_2 + edge)
+    lower_edge = bin_ - (width_by_2 + edge)
+    sel = np.arange(nbins)
+    on = rprof[(sel <= upper_edge) & (sel >= lower_edge)]
+    off = rprof[(sel > upper_edge) | (sel < lower_edge)]
+    on_mean = on.mean()
+    off_mean = off.mean()
+    off_std = np.sqrt(((off - off_mean) ** 2).mean())
+    with np.errstate(divide="ignore", invalid="ignore"):
+        sn1 = (on_mean - off_mean) * np.sqrt(width) / off_std
+        sn2 = ((rprof - off_mean) / off_std).sum() / np.sqrt(width)
+    if not np.isfinite(sn1) or sn1 > 99999:
+        sn1 = 0.0
+    if not np.isfinite(sn2) or sn2 > 99999:
+        sn2 = 0.0
+    return float(sn1), float(sn2)
+
+
+@dataclass
+class OptimisedFold:
+    opt_sn: float
+    opt_period: float
+    opt_width: int
+    opt_bin: int
+    opt_prof: np.ndarray     # (nbins,)
+    opt_fold: np.ndarray     # (nints, nbins)
+
+
+def optimise_fold(subints: np.ndarray, period: float, tobs: float) -> OptimisedFold:
+    """Full fold optimisation for one folded candidate."""
+    nints, nbins = subints.shape
+    nshifts = nbins
+    argmax, opt_subints_all, opt_profiles_all = _optimise_core(
+        jnp.asarray(subints, jnp.float32)
+    )
+    argmax = int(argmax)
+    opt_template = argmax // (nbins * nshifts)
+    opt_bin = argmax % nbins - opt_template // 2
+    opt_shift = (argmax // nbins) % nbins
+    opt_prof = np.asarray(opt_profiles_all)[opt_shift]
+    opt_fold = np.asarray(opt_subints_all)[opt_shift]
+    sn1, sn2 = calculate_sn(opt_prof, opt_bin, opt_template, nbins)
+    # REFERENCE-QUIRK(folder.hpp:330): hardcoded 32 (nbins/2 for nbins=64)
+    opt_period = period * ((((32.0 - opt_shift) * period) / (nbins * tobs)) + 1.0)
+    return OptimisedFold(
+        opt_sn=max(sn1, sn2),
+        opt_period=float(opt_period),
+        opt_width=opt_template + 1,
+        opt_bin=int(opt_bin),
+        opt_prof=opt_prof,
+        opt_fold=opt_fold,
+    )

@@ -1,0 +1,3 @@
+from .plan import AccelerationPlan, SearchConfig, prev_power_of_two
+from .distill import HarmonicDistiller, AccelerationDistiller, DMDistiller
+from .score import CandidateScorer

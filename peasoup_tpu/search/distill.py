@@ -1,0 +1,120 @@
+"""Candidate distillation (de-duplication) passes.
+
+Host-side greedy SNR-sorted dedup, exact semantics of
+`include/transforms/distiller.hpp:16-197`:
+
+* ``BaseDistiller.distill``: sort by SNR descending; walk the survivors
+  in order, letting each "fundamental" absorb (mark non-unique, and
+  optionally append to its ``assoc`` list) everything its ``condition``
+  matches further down the list.
+* ``HarmonicDistiller``: absorbs candidates whose frequency is a
+  (fractional, up to 2^nh denominators) harmonic ratio of the
+  fundamental within tolerance.
+* ``AccelerationDistiller``: absorbs candidates whose frequency lies
+  within the Doppler drift window f*da*tobs/c of the fundamental.
+* ``DMDistiller``: absorbs candidates with matching frequency ratio
+  regardless of DM.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data.candidates import Candidate
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+class BaseDistiller:
+    def __init__(self, keep_related: bool):
+        self.keep_related = keep_related
+
+    def condition(self, cands, idx, unique):
+        raise NotImplementedError
+
+    def distill(self, cands: list[Candidate]) -> list[Candidate]:
+        size = len(cands)
+        # std::sort with snr-greater comparator; stable for determinism
+        cands = sorted(cands, key=lambda c: -c.snr)
+        unique = np.ones(size, dtype=bool)
+        for idx in range(size):
+            if unique[idx]:
+                self.condition(cands, idx, unique)
+        return [cands[i] for i in range(size) if unique[i]]
+
+
+class HarmonicDistiller(BaseDistiller):
+    def __init__(self, tol: float, max_harm: int, keep_related: bool,
+                 fractional_harms: bool = True):
+        super().__init__(keep_related)
+        self.tolerance = tol
+        self.max_harm = int(max_harm)
+        self.fractional_harms = fractional_harms
+
+    def condition(self, cands, idx, unique):
+        fundi_freq = cands[idx].freq
+        upper = 1 + self.tolerance
+        lower = 1 - self.tolerance
+        # like the reference, already-absorbed candidates are still
+        # tested (and may be appended to this fundamental's assoc too)
+        for ii in range(idx + 1, len(cands)):
+            freq = cands[ii].freq
+            nh = cands[ii].nh
+            max_denominator = int(2.0 ** nh) if self.fractional_harms else 1
+            matched = False
+            for jj in range(1, self.max_harm + 1):
+                for kk in range(1, max_denominator + 1):
+                    ratio = kk * freq / (jj * fundi_freq)
+                    if lower < ratio < upper:
+                        matched = True
+                        break
+                if matched:
+                    break
+            if matched:
+                if self.keep_related:
+                    cands[idx].append(cands[ii])
+                unique[ii] = False
+
+
+class AccelerationDistiller(BaseDistiller):
+    def __init__(self, tobs: float, tolerance: float, keep_related: bool):
+        super().__init__(keep_related)
+        self.tobs = tobs
+        self.tobs_over_c = tobs / SPEED_OF_LIGHT
+        self.tolerance = tolerance
+
+    def correct_for_acceleration(self, freq, delta_acc):
+        return freq + delta_acc * freq * self.tobs_over_c
+
+    def condition(self, cands, idx, unique):
+        fundi_freq = cands[idx].freq
+        fundi_acc = cands[idx].acc
+        edge = fundi_freq * self.tolerance
+        for ii in range(idx + 1, len(cands)):
+            delta_acc = fundi_acc - cands[ii].acc
+            acc_freq = self.correct_for_acceleration(fundi_freq, delta_acc)
+            if acc_freq > fundi_freq:
+                hit = fundi_freq - edge < cands[ii].freq < acc_freq + edge
+            else:
+                hit = acc_freq - edge < cands[ii].freq < fundi_freq + edge
+            if hit:
+                if self.keep_related:
+                    cands[idx].append(cands[ii])
+                unique[ii] = False
+
+
+class DMDistiller(BaseDistiller):
+    def __init__(self, tolerance: float, keep_related: bool):
+        super().__init__(keep_related)
+        self.tolerance = tolerance
+
+    def condition(self, cands, idx, unique):
+        fundi_freq = cands[idx].freq
+        upper = 1 + self.tolerance
+        lower = 1 - self.tolerance
+        for ii in range(idx + 1, len(cands)):
+            ratio = cands[ii].freq / fundi_freq
+            if lower < ratio < upper:
+                if self.keep_related:
+                    cands[idx].append(cands[ii])
+                unique[ii] = False

@@ -1,0 +1,376 @@
+"""The end-to-end pulsar search pipeline.
+
+TPU-native re-design of `src/pipeline_multi.cu`: instead of a pthread
+worker pool dispensing one DM trial at a time to each GPU
+(`pipeline_multi.cu:33-81,100-252`), the whole per-DM whitening chain
+and the acceleration-trial loop are jitted XLA programs — the accel
+axis is a vmapped batch axis processed in chunks — and the DM axis is a
+host loop here (or a sharded mesh axis in ``peasoup_tpu.parallel``).
+
+Per-DM chain (reference walk-through at `pipeline_multi.cu:145-244`):
+rfft -> plain power spectrum -> running-median -> deredden -> [zap] ->
+interbin spectrum -> stats -> irfft, then per accel trial: resampleII ->
+rfft -> interbin -> normalise -> harmonic sums -> thresholded peaks.
+
+Scaling note: cuFFT's unnormalised C2R multiplies the reference's
+whitened series by ``size``, which it undoes by normalising spectra
+with (mean*size, std*size) (`pipeline_multi.cu:224`).  jnp's irfft is
+normalised, so plain (mean, std) give the identical normalised spectra.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..data.candidates import Candidate, CandidateCollection
+from ..io.sigproc import Filterbank
+from ..ops import (
+    dedisperse,
+    delay_table,
+    delays_in_samples,
+    extract_above_threshold,
+    form_interpolated,
+    form_power,
+    generate_dm_list,
+    harmonic_sums,
+    identify_unique_peaks,
+    max_delay,
+    mean_rms_std,
+    resample,
+    resample2,
+    running_median,
+    spectrum_search_bounds,
+    zap_birdies,
+    deredden,
+)
+from ..ops.fold import fold_time_series, optimise_fold
+from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
+from .plan import AccelerationPlan, SearchConfig, prev_power_of_two
+from .score import CandidateScorer
+
+
+# --------------------------------------------------------------------------
+# jitted building blocks
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("bin_width", "b5", "b25", "use_zap"))
+def whiten_trial(tim, birdies, widths, bin_width, b5, b25, use_zap):
+    """Whiten one DM trial; returns (whitened tim, mean, std).
+
+    ``bin_width`` is static: it only depends on the fft size and tsamp,
+    and the running-median splice positions derive from it.
+    """
+    fseries = jnp.fft.rfft(tim.astype(jnp.float32))
+    fseries = fseries.astype(jnp.complex64)
+    pspec = form_power(fseries)
+    median = running_median(pspec, bin_width, b5, b25)
+    fseries = deredden(fseries, median)
+    if use_zap:
+        fseries = zap_birdies(fseries, birdies, widths, bin_width)
+    pspec_i = form_interpolated(fseries)
+    mean, _, std = mean_rms_std(pspec_i)
+    tim_w = jnp.fft.irfft(fseries, n=tim.shape[0]).astype(jnp.float32)
+    return tim_w, mean, std
+
+
+def _search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
+                      min_snr):
+    tim_r = resample2(tim_w, accel, tsamp)
+    fs = jnp.fft.rfft(tim_r).astype(jnp.complex64)
+    pspec = form_interpolated(fs)
+    pspec = ((pspec - mean) / std).astype(jnp.float32)
+    spectra = [pspec] + harmonic_sums(pspec, nharms)
+    idxs, snrs, counts = [], [], []
+    for spec, (start, stop, _f) in zip(spectra, bounds):
+        i, s, c = extract_above_threshold(spec, min_snr, start, stop, capacity)
+        idxs.append(i)
+        snrs.append(s)
+        counts.append(c)
+    return jnp.stack(idxs), jnp.stack(snrs), jnp.stack(counts)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("tsamp", "nharms", "bounds", "capacity", "min_snr"),
+)
+def search_accel_chunk(tim_w, accels, mean, std, tsamp, nharms, bounds,
+                       capacity, min_snr):
+    """vmapped acceleration-trial batch: (chunk,) accels -> peak buffers."""
+    fn = lambda a: _search_one_accel(
+        tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr
+    )
+    return jax.vmap(fn)(accels)
+
+
+# --------------------------------------------------------------------------
+# host orchestration
+# --------------------------------------------------------------------------
+
+@dataclass
+class SearchResult:
+    candidates: CandidateCollection
+    dm_list: np.ndarray
+    acc_list_dm0: np.ndarray
+    timers: dict = field(default_factory=dict)
+    config: SearchConfig | None = None
+    header: object | None = None
+
+
+class PulsarSearch:
+    """Single-host search driver (multi-device version in parallel/)."""
+
+    def __init__(self, fil: Filterbank, config: SearchConfig):
+        self.fil = fil
+        self.config = config
+        hdr = fil.header
+        self.dm_list = generate_dm_list(
+            config.dm_start, config.dm_end, hdr.tsamp, config.dm_pulse_width,
+            hdr.fch1, hdr.foff, fil.nchans, config.dm_tol,
+        )
+        self.delay_tab = delay_table(fil.nchans, hdr.tsamp, hdr.fch1, hdr.foff)
+        self.delays = delays_in_samples(self.dm_list, self.delay_tab)
+        self.max_delay = max_delay(self.dm_list, self.delay_tab)
+        self.out_nsamps = fil.nsamps - self.max_delay
+        self.size = config.size or prev_power_of_two(fil.nsamps)
+        self.tobs = self.size * hdr.tsamp
+        self.bin_width = 1.0 / self.tobs
+        self.acc_plan = AccelerationPlan(
+            config.acc_start, config.acc_end, config.acc_tol,
+            config.acc_pulse_width, self.size, hdr.tsamp, hdr.cfreq, hdr.foff,
+        )
+        self.killmask = None
+        if config.killfilename:
+            self.killmask = load_killmask(config.killfilename, fil.nchans)
+        self.birdies = np.zeros((0,), np.float32)
+        self.bwidths = np.zeros((0,), np.float32)
+        if config.zapfilename:
+            from ..ops.zap import load_zaplist
+
+            zl = load_zaplist(config.zapfilename)
+            self.birdies = zl[:, 0].copy()
+            self.bwidths = zl[:, 1].copy()
+        nh_levels = range(config.nharmonics + 1)
+        self.bounds = tuple(
+            spectrum_search_bounds(
+                self.size // 2 + 1, self.bin_width, nh,
+                config.min_freq, config.max_freq,
+            )
+            for nh in nh_levels
+        )
+
+    # -- stages ------------------------------------------------------------
+
+    def dedisperse(self) -> jax.Array:
+        data = jnp.asarray(self.fil.data.T, dtype=jnp.float32)
+        km = None if self.killmask is None else jnp.asarray(self.killmask)
+        trials = dedisperse(
+            data, jnp.asarray(self.delays), self.out_nsamps, km
+        )
+        return trials
+
+    def _trial_tim(self, trials: jax.Array, idx: int) -> jax.Array:
+        if self.out_nsamps >= self.size:
+            return jax.lax.dynamic_slice(
+                trials, (idx, 0), (1, self.size)
+            ).reshape(self.size)
+        tim = trials[idx]
+        pad_mean = jnp.mean(tim)
+        pad = jnp.full((self.size - self.out_nsamps,), pad_mean, jnp.float32)
+        return jnp.concatenate([tim, pad])
+
+    def search_dm_trial(self, trials: jax.Array, idx: int) -> list[Candidate]:
+        cfg = self.config
+        dm = float(self.dm_list[idx])
+        tim = self._trial_tim(trials, idx)
+        tim_w, mean, std = whiten_trial(
+            tim,
+            jnp.asarray(self.birdies),
+            jnp.asarray(self.bwidths),
+            self.bin_width,
+            cfg.boundary_5_freq,
+            cfg.boundary_25_freq,
+            bool(len(self.birdies)),
+        )
+        acc_list = self.acc_plan.generate_accel_list(dm)
+        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, False)
+        accel_trial_cands: list[Candidate] = []
+        n = len(acc_list)
+        chunk = max(1, min(cfg.accel_chunk, n))
+        padded = int(np.ceil(n / chunk)) * chunk
+        accs = np.zeros(padded, np.float32)
+        accs[:n] = acc_list
+        for c0 in range(0, padded, chunk):
+            batch = jnp.asarray(accs[c0 : c0 + chunk])
+            idxs, snrs, counts = search_accel_chunk(
+                tim_w, batch, mean, std, float(self.fil.tsamp),
+                cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
+            )
+            idxs = np.asarray(idxs)
+            snrs = np.asarray(snrs)
+            counts = np.asarray(counts)
+            for j in range(chunk):
+                k = c0 + j
+                if k >= n:
+                    break
+                cands = self._peaks_to_candidates(
+                    idxs[j], snrs[j], counts[j], dm, idx, float(accs[k])
+                )
+                accel_trial_cands.extend(harm_still.distill(cands))
+        acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
+        return acc_still.distill(accel_trial_cands)
+
+    def _peaks_to_candidates(self, idxs, snrs, counts, dm, dm_idx, acc):
+        cands: list[Candidate] = []
+        for level, (start, stop, factor) in enumerate(self.bounds):
+            cnt = int(counts[level])
+            cap = self.config.peak_capacity
+            take = min(cnt, cap)
+            if cnt > cap:
+                import warnings
+
+                warnings.warn(
+                    f"peak buffer overflow: {cnt} > capacity {cap} "
+                    f"(dm={dm}, acc={acc}, nh={level}); raise peak_capacity"
+                )
+            bi = idxs[level][:take]
+            bs = snrs[level][:take]
+            pidx, psnr = identify_unique_peaks(bi, bs)
+            for p, s in zip(pidx, psnr):
+                cands.append(
+                    Candidate(dm=dm, dm_idx=dm_idx, acc=acc, nh=level,
+                              snr=float(s), freq=float(p * factor))
+                )
+        return cands
+
+    # -- full run ----------------------------------------------------------
+
+    def run(self) -> SearchResult:
+        cfg = self.config
+        timers: dict[str, float] = {}
+        t_total = time.time()
+        t0 = time.time()
+        trials = self.dedisperse()
+        trials.block_until_ready()
+        timers["dedispersion"] = time.time() - t0
+
+        t0 = time.time()
+        dm_cands = CandidateCollection()
+        for ii in range(len(self.dm_list)):
+            dm_cands.append(self.search_dm_trial(trials, ii))
+        timers["searching"] = time.time() - t0
+
+        dm_still = DMDistiller(cfg.freq_tol, True)
+        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
+        cands = dm_still.distill(dm_cands.cands)
+        cands = harm_still.distill(cands)
+
+        hdr = self.fil.header
+        scorer = CandidateScorer(
+            hdr.tsamp, hdr.cfreq, hdr.foff, abs(hdr.foff) * self.fil.nchans
+        )
+        scorer.score_all(cands)
+
+        t0 = time.time()
+        if cfg.npdmp > 0:
+            fold_candidates(
+                cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
+                boundary_5_freq=cfg.boundary_5_freq,
+                boundary_25_freq=cfg.boundary_25_freq,
+            )
+        timers["folding"] = time.time() - t0
+
+        cands = cands[: cfg.limit]
+        timers["total"] = time.time() - t_total
+        return SearchResult(
+            candidates=CandidateCollection(cands),
+            dm_list=self.dm_list,
+            acc_list_dm0=self.acc_plan.generate_accel_list(0.0),
+            timers=timers,
+            config=cfg,
+            header=hdr,
+        )
+
+
+# --------------------------------------------------------------------------
+# folding (MultiFolder equivalent, folder.hpp:337-442)
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("bin_width",))
+def _rewhiten_for_fold(tim, bin_width):
+    """The fold path re-whitens without zapping or interbinning
+    (`folder.hpp:382-389`)."""
+    fseries = jnp.fft.rfft(tim.astype(jnp.float32)).astype(jnp.complex64)
+    pspec = form_power(fseries)
+    median = running_median(pspec, bin_width)
+    fseries = deredden(fseries, median)
+    return jnp.fft.irfft(fseries, n=tim.shape[0]).astype(jnp.float32)
+
+
+def fold_candidates(
+    cands: list[Candidate],
+    trials: jax.Array,
+    trials_nsamps: int,
+    tsamp: float,
+    npdmp: int,
+    nbins: int = 64,
+    nints: int = 16,
+    min_period: float = 0.001,
+    max_period: float = 10.0,
+    boundary_5_freq: float = 0.05,
+    boundary_25_freq: float = 0.5,
+) -> None:
+    """Fold + optimise the top ``npdmp`` candidates in place, then sort
+    by max(snr, folded_snr) (`folder.hpp:424-434,25-31`)."""
+    nsamps = prev_power_of_two(trials_nsamps)
+    tobs = nsamps * tsamp
+    bin_width = 1.0 / tobs
+    dm_to_cands: dict[int, list[int]] = {}
+    for ii in range(min(npdmp, len(cands))):
+        p = 1.0 / cands[ii].freq
+        if min_period < p < max_period:
+            dm_to_cands.setdefault(cands[ii].dm_idx, []).append(ii)
+    for dm_idx, cand_ids in dm_to_cands.items():
+        tim = jax.lax.dynamic_slice(
+            trials, (dm_idx, 0), (1, min(nsamps, trials.shape[1]))
+        ).reshape(-1)
+        if tim.shape[0] < nsamps:
+            tim = jnp.pad(tim, (0, nsamps - tim.shape[0]))
+        tim_w = _rewhiten_for_fold(tim, bin_width)
+        for ci in cand_ids:
+            cand = cands[ci]
+            period = 1.0 / cand.freq
+            tim_r = resample(tim_w, cand.acc, tsamp)
+            subints = np.asarray(
+                fold_time_series(tim_r, period, tsamp, nbins, nints)
+            )
+            opt = optimise_fold(subints, period, tobs)
+            cand.folded_snr = opt.opt_sn
+            cand.fold = opt.opt_fold
+            cand.nbins = nbins
+            cand.nints = nints
+            cand.opt_period = opt.opt_period
+    cands.sort(key=lambda c: -max(c.snr, c.folded_snr))
+
+
+def load_killmask(filename: str, nchans: int) -> np.ndarray:
+    """Parse a one-0/1-per-line channel mask (`dedisperser.hpp:71-95`)."""
+    vals: list[int] = []
+    with open(filename) as f:
+        for line in f:
+            if len(vals) >= nchans:
+                break
+            line = line.strip()
+            if line:
+                vals.append(int(line))
+    if len(vals) != nchans:
+        import warnings
+
+        warnings.warn("killmask is not the same size as nchans; ignoring")
+        return np.ones(nchans, np.float32)
+    return np.array(vals, dtype=np.float32)

@@ -1,0 +1,105 @@
+"""Search configuration and trial-grid planning."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+def prev_power_of_two(val: int) -> int:
+    """Exact reference semantics (`include/utils/utils.hpp:12-18`):
+    doubles n while 2n < val — the largest power of two strictly below
+    val; note an exact power of two maps to its *half*."""
+    n = 1
+    while n * 2 < val:
+        n *= 2
+    return n
+
+
+@dataclass
+class SearchConfig:
+    """All tunables of the search, defaults matching the reference CLI
+    (`include/utils/cmdline.hpp:95-173`)."""
+
+    outdir: str = ""
+    killfilename: str = ""
+    zapfilename: str = ""
+    max_num_threads: int = 14
+    limit: int = 1000
+    size: int = 0  # fft length; 0 -> prev_power_of_two(nsamps)
+    dm_start: float = 0.0
+    dm_end: float = 100.0
+    dm_tol: float = 1.10
+    dm_pulse_width: float = 64.0  # us
+    acc_start: float = 0.0
+    acc_end: float = 0.0
+    acc_tol: float = 1.10
+    acc_pulse_width: float = 64.0  # us
+    boundary_5_freq: float = 0.05
+    boundary_25_freq: float = 0.5
+    nharmonics: int = 4
+    npdmp: int = 0
+    min_snr: float = 9.0
+    min_freq: float = 0.1
+    max_freq: float = 1100.0
+    max_harm: int = 16
+    freq_tol: float = 0.0001
+    verbose: bool = False
+    progress_bar: bool = False
+    # TPU-build extras (no reference equivalent)
+    peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
+    accel_chunk: int = 16      # accel trials batched per device step
+    infilename: str = ""
+
+
+class AccelerationPlan:
+    """DM-dependent acceleration trial grid.
+
+    Faithful to `include/utils/utils.hpp:140-193` including its quirks:
+    ``pulse_width`` is divided by 1e3 on construction (so the effective
+    pulse width is pulse_width/1e3 us), the DM-smearing term uses the
+    centre frequency in MHz (making it negligible), and ``tsamp`` enters
+    in seconds while the other smearing terms are microseconds.  The
+    2014-era golden output (example_output/overview.xml, 3 accel trials
+    for -5..5) corresponds to passing ``pulse_width=64000``.
+    """
+
+    def __init__(self, acc_lo, acc_hi, tol, pulse_width, nsamps, tsamp,
+                 cfreq, bw):
+        self.acc_lo = np.float32(acc_lo)
+        self.acc_hi = np.float32(acc_hi)
+        self.tol = np.float32(tol)
+        self.pulse_width = np.float32(pulse_width) / np.float32(1.0e3)
+        self.nsamps = int(nsamps)
+        self.tsamp = np.float32(tsamp)
+        self.cfreq = np.float32(cfreq)
+        self.bw = np.float32(abs(bw))
+        self.tobs = np.float32(nsamps) * np.float32(tsamp)
+
+    def generate_accel_list(self, dm: float) -> np.ndarray:
+        if self.acc_hi == self.acc_lo:
+            return np.array([0.0], dtype=np.float32)
+        tdm = np.float32(
+            (8.3 * float(self.bw) / float(self.cfreq) ** 3 * float(dm)) ** 2
+        )
+        tpulse = self.pulse_width * self.pulse_width
+        ttsamp = self.tsamp * self.tsamp
+        w_us = np.float32(np.sqrt(np.float32(tdm + tpulse + ttsamp)))
+        alt_a = np.float32(
+            2.0 * float(w_us) * 1.0e-6 * 24.0 * 299792458.0
+            / float(self.tobs) / float(self.tobs)
+            * np.sqrt(float(self.tol) * float(self.tol) - 1.0)
+        )
+        out: list[np.float32] = []
+        if self.acc_hi != 0 and self.acc_lo != 0:
+            out.append(np.float32(0.0))  # explicitly force zero acceleration
+        acc = self.acc_lo
+        while acc < self.acc_hi:
+            out.append(acc)
+            acc = np.float32(acc + alt_a)
+        out.append(self.acc_hi)
+        return np.array(out, dtype=np.float32)
+
+    def max_trials(self, dm_list: np.ndarray) -> int:
+        return max(len(self.generate_accel_list(dm)) for dm in dm_list)

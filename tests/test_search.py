@@ -1,0 +1,122 @@
+import numpy as np
+import pytest
+
+from peasoup_tpu.data import Candidate
+from peasoup_tpu.search import (
+    AccelerationPlan,
+    AccelerationDistiller,
+    CandidateScorer,
+    DMDistiller,
+    HarmonicDistiller,
+    SearchConfig,
+    prev_power_of_two,
+)
+
+
+def test_prev_power_of_two():
+    assert prev_power_of_two(187520) == 131072
+    # reference quirk: an exact power of two maps to its half (the loop
+    # condition is n*2 < val, utils.hpp:12-18)
+    assert prev_power_of_two(131072) == 65536
+    assert prev_power_of_two(131073) == 131072
+    assert prev_power_of_two(3) == 2
+
+
+class TestAccelerationPlan:
+    # tutorial.fil params: size=131072, tsamp=0.00032, cfreq=1475.12,
+    # foff=-1.09
+    ARGS = dict(tol=1.10, nsamps=131072, tsamp=0.00032,
+                cfreq=1510.0 - 1.09 * 32, bw=-1.09)
+
+    def test_equal_range_gives_zero_only(self):
+        plan = AccelerationPlan(acc_lo=0.0, acc_hi=0.0, pulse_width=64.0,
+                                **self.ARGS)
+        np.testing.assert_array_equal(plan.generate_accel_list(0.0), [0.0])
+
+    def test_golden_grid_with_2014_pulse_width(self):
+        # The 2014 golden output (acceleration_trials count=3: 0,-5,5)
+        # corresponds to pulse_width=64000 under the current formula
+        # (utils.hpp:165 divides by 1e3).
+        plan = AccelerationPlan(acc_lo=-5.0, acc_hi=5.0, pulse_width=64000.0,
+                                **self.ARGS)
+        acc = plan.generate_accel_list(0.0)
+        np.testing.assert_allclose(acc, [0.0, -5.0, 5.0])
+
+    def test_zero_always_included_and_ends_capped(self):
+        plan = AccelerationPlan(acc_lo=-5.0, acc_hi=5.0, pulse_width=64.0,
+                                **self.ARGS)
+        acc = plan.generate_accel_list(0.0)
+        assert acc[0] == 0.0  # explicitly forced
+        assert acc[1] == -5.0
+        assert acc[-1] == 5.0
+        assert len(acc) > 3  # ~0.24 m/s^2 steps with the current formula
+        steps = np.diff(acc[1:])
+        assert np.all(steps > 0)
+
+    def test_step_grows_with_dm(self):
+        plan = AccelerationPlan(acc_lo=-50.0, acc_hi=50.0, pulse_width=64.0,
+                                **self.ARGS)
+        assert len(plan.generate_accel_list(0.0)) >= len(
+            plan.generate_accel_list(5000.0)
+        )
+
+
+class TestDistillers:
+    def mk(self, freq, snr, dm=10.0, dm_idx=3, acc=0.0, nh=0):
+        return Candidate(dm=dm, dm_idx=dm_idx, acc=acc, nh=nh, snr=snr,
+                         freq=freq)
+
+    def test_harmonic_distiller_absorbs_harmonics(self):
+        cands = [
+            self.mk(4.0, 50.0),       # fundamental
+            self.mk(8.0, 20.0),       # 2nd harmonic
+            self.mk(2.0, 10.0, nh=1),  # 1/2 fractional harmonic
+            self.mk(5.3, 15.0),       # unrelated
+        ]
+        out = HarmonicDistiller(1e-4, 16, keep_related=True).distill(cands)
+        freqs = sorted(c.freq for c in out)
+        assert freqs == [4.0, 5.3]
+        fund = [c for c in out if c.freq == 4.0][0]
+        assert fund.count_assoc() == 2
+
+    def test_acceleration_distiller(self):
+        tobs = 41.94304
+        f = 4.0
+        drift = 5.0 * f * tobs / 299792458.0  # df for da=5
+        cands = [
+            self.mk(f, 50.0, acc=0.0),
+            self.mk(f + 0.5 * drift, 20.0, acc=5.0),  # inside drift window
+            self.mk(f + 1.0, 15.0, acc=5.0),          # far outside
+        ]
+        out = AccelerationDistiller(tobs, 1e-4, True).distill(cands)
+        assert len(out) == 2
+        assert out[0].count_assoc() == 1
+
+    def test_dm_distiller(self):
+        cands = [
+            self.mk(4.0, 50.0, dm=10.0),
+            self.mk(4.00001, 20.0, dm=20.0),
+            self.mk(4.2, 15.0, dm=20.0),
+        ]
+        out = DMDistiller(1e-4, True).distill(cands)
+        assert len(out) == 2
+
+    def test_distill_sorts_by_snr(self):
+        cands = [self.mk(3.0, 10.0), self.mk(7.0, 30.0), self.mk(5.0, 20.0)]
+        out = HarmonicDistiller(1e-4, 16, False).distill(cands)
+        assert [c.snr for c in out] == [30.0, 20.0, 10.0]
+
+
+class TestScorer:
+    def test_scoring(self):
+        scorer = CandidateScorer(0.00032, 1475.12, -1.09, 1.09 * 64)
+        cand = Candidate(dm=30.0, dm_idx=9, acc=0.0, nh=4, snr=80.0, freq=4.0)
+        cand.assoc = [
+            Candidate(dm=29.6, dm_idx=9, snr=40.0, freq=4.0),
+            Candidate(dm=33.0, dm_idx=10, snr=30.0, freq=4.0),
+        ]
+        scorer.score(cand)
+        assert cand.is_physical  # foff < 0 makes the smear delay negative
+        assert cand.is_adjacent  # dm_idx 10 is adjacent to 9
+        assert 0 < cand.ddm_count_ratio <= 1
+        assert 0 < cand.ddm_snr_ratio <= 1
