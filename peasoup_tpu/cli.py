@@ -1,0 +1,140 @@
+"""peasoup-compatible command-line interface.
+
+Flags and defaults match the reference CLI
+(`include/utils/cmdline.hpp:69-209`); the default output directory is
+``./YYYY-MM-DD-HH:MM_peasoup/`` (UTC), like ``get_utc_str``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def default_outdir() -> str:
+    return time.strftime("./%Y-%m-%d-%H:%M_peasoup/", time.gmtime())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu",
+        description="Peasoup-TPU - a TPU pulsar search pipeline",
+    )
+    p.add_argument("-i", "--inputfile", required=True, dest="infilename",
+                   help="File to process (.fil)")
+    p.add_argument("-o", "--outdir", default=None, help="The output directory")
+    p.add_argument("-k", "--killfile", default="", dest="killfilename",
+                   help="Channel mask file")
+    p.add_argument("-z", "--zapfile", default="", dest="zapfilename",
+                   help="Birdie list file")
+    p.add_argument("-t", "--num_threads", type=int, default=14,
+                   dest="max_num_threads",
+                   help="The number of devices to use")
+    p.add_argument("--limit", type=int, default=1000,
+                   help="upper limit on number of candidates to write out")
+    p.add_argument("--fft_size", type=int, default=0, dest="size",
+                   help="Transform size to use (defaults to lower power of two)")
+    p.add_argument("--dm_start", type=float, default=0.0)
+    p.add_argument("--dm_end", type=float, default=100.0)
+    p.add_argument("--dm_tol", type=float, default=1.10)
+    p.add_argument("--dm_pulse_width", type=float, default=64.0)
+    p.add_argument("--acc_start", type=float, default=0.0)
+    p.add_argument("--acc_end", type=float, default=0.0)
+    p.add_argument("--acc_tol", type=float, default=1.10)
+    p.add_argument("--acc_pulse_width", type=float, default=64.0)
+    p.add_argument("--boundary_5_freq", type=float, default=0.05)
+    p.add_argument("--boundary_25_freq", type=float, default=0.5)
+    p.add_argument("-n", "--nharmonics", type=int, default=4)
+    p.add_argument("--npdmp", type=int, default=0)
+    p.add_argument("-m", "--min_snr", type=float, default=9.0)
+    p.add_argument("--min_freq", type=float, default=0.1)
+    p.add_argument("--max_freq", type=float, default=1100.0)
+    p.add_argument("--max_harm_match", type=int, default=16, dest="max_harm")
+    p.add_argument("--freq_tol", type=float, default=0.0001)
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-p", "--progress_bar", action="store_true")
+    # TPU-build extras
+    p.add_argument("--peak_capacity", type=int, default=1024)
+    p.add_argument("--accel_chunk", type=int, default=16)
+    p.add_argument("--single_device", action="store_true",
+                   help="disable mesh sharding even with multiple devices")
+    return p
+
+
+def args_to_config(args):
+    from .search.plan import SearchConfig
+
+    cfg = SearchConfig()
+    for key in vars(args):
+        if hasattr(cfg, key) and getattr(args, key) is not None:
+            setattr(cfg, key, getattr(args, key))
+    if args.outdir is None:
+        cfg.outdir = default_outdir()
+    return cfg
+
+
+def write_search_output(result, outdir: str) -> None:
+    """Write candidates.peasoup + overview.xml for a SearchResult."""
+    from .output.binary import write_candidate_binary
+    from .output.xml_writer import OutputFileWriter
+
+    os.makedirs(outdir, exist_ok=True)
+    byte_mapping = write_candidate_binary(
+        result.candidates, os.path.join(outdir, "candidates.peasoup")
+    )
+    writer = OutputFileWriter()
+    writer.add_misc_info()
+    writer.add_header(result.header)
+    writer.add_search_parameters(result.config)
+    writer.add_dm_list(result.dm_list)
+    writer.add_acc_list(result.acc_list_dm0)
+    writer.add_device_info()
+    writer.add_candidates(result.candidates, byte_mapping)
+    writer.add_timing_info(result.timers)
+    writer.to_file(os.path.join(outdir, "overview.xml"))
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = args_to_config(args)
+
+    import time as _time
+
+    t_total = _time.time()
+    t0 = _time.time()
+    from .io import read_filterbank
+
+    fil = read_filterbank(cfg.infilename)
+    t_read = _time.time() - t0
+
+    if args.verbose:
+        print(f"Read {cfg.infilename}: {fil.nsamps} samples x "
+              f"{fil.nchans} chans, {fil.header.nbits}-bit", file=sys.stderr)
+
+    import jax
+
+    from .search.pipeline import PulsarSearch
+
+    ndevices = len(jax.devices())
+    if ndevices > 1 and not args.single_device:
+        from .parallel.mesh import MeshPulsarSearch
+
+        search = MeshPulsarSearch(
+            fil, cfg, max_devices=args.max_num_threads
+        )
+    else:
+        search = PulsarSearch(fil, cfg)
+    result = search.run()
+    result.timers["reading"] = t_read
+    result.timers["total"] = _time.time() - t_total
+    write_search_output(result, cfg.outdir)
+    if args.verbose:
+        print(f"Wrote {len(result.candidates)} candidates to {cfg.outdir}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,3 @@
+from .xml_writer import XMLElement, OutputFileWriter
+from .binary import write_candidate_binary, CandidateFileParser
+from .parsers import OverviewFile

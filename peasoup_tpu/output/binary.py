@@ -1,0 +1,80 @@
+"""candidates.peasoup binary writer/reader.
+
+Byte-compatible with `include/utils/output_stats.hpp:237-270`: per
+candidate, an optional ``FOLD`` magic + int32 nbins + int32 nints +
+float32 fold[nbins*nints], then int32 ndets followed by ndets packed
+CandidatePOD records (float32 dm, int32 dm_idx, float32 acc, int32 nh,
+float32 snr, float32 freq) — the candidate itself first, then its
+flattened assoc tree in pre-order.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+POD_DTYPE = np.dtype(
+    [
+        ("dm", "<f4"),
+        ("dm_idx", "<i4"),
+        ("acc", "<f4"),
+        ("nh", "<i4"),
+        ("snr", "<f4"),
+        ("freq", "<f4"),
+    ]
+)
+
+
+def write_candidate_binary(candidates, filename: str) -> dict[int, int]:
+    """Write candidates; returns {candidate_index: byte_offset}."""
+    byte_mapping: dict[int, int] = {}
+    with open(filename, "wb") as f:
+        for ii, cand in enumerate(candidates):
+            byte_mapping[ii] = f.tell()
+            if cand.fold is not None and np.size(cand.fold) > 0:
+                f.write(b"FOLD")
+                f.write(struct.pack("<ii", cand.nbins, cand.nints))
+                f.write(
+                    np.ascontiguousarray(cand.fold, dtype=np.float32).tobytes()
+                )
+            dets = cand.collect()
+            f.write(struct.pack("<i", len(dets)))
+            pods = np.empty(len(dets), dtype=POD_DTYPE)
+            for jj, d in enumerate(dets):
+                pods[jj] = (d.dm, d.dm_idx, d.acc, d.nh, d.snr, d.freq)
+            f.write(pods.tobytes())
+    return byte_mapping
+
+
+class CandidateFileParser:
+    """Reader mirroring ``tools/peasoup_tools.py:46-80``."""
+
+    def __init__(self, filename: str):
+        self._f = open(filename, "rb")
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def cand_from_offset(self, offset: int):
+        self._f.seek(offset)
+        magic = self._f.read(4)
+        fold = None
+        if magic == b"FOLD":
+            nbins, nints = struct.unpack("<ii", self._f.read(8))
+            fold = np.frombuffer(
+                self._f.read(4 * nbins * nints), dtype=np.float32
+            ).reshape(nints, nbins)
+        else:
+            self._f.seek(offset)
+        (count,) = struct.unpack("<i", self._f.read(4))
+        hits = np.frombuffer(
+            self._f.read(POD_DTYPE.itemsize * count), dtype=POD_DTYPE
+        )
+        return fold, hits

@@ -1,0 +1,67 @@
+"""overview.xml parser (modern replacement for
+``tools/peasoup_tools.py:83-164``, stdlib-only)."""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+CAND_DTYPE = np.dtype(
+    [
+        ("cand_num", "<i4"),
+        ("period", "<f4"),
+        ("opt_period", "<f4"),
+        ("dm", "<f4"),
+        ("acc", "<f4"),
+        ("nh", "<f4"),
+        ("snr", "<f4"),
+        ("folded_snr", "<f4"),
+        ("is_adjacent", "u1"),
+        ("is_physical", "u1"),
+        ("ddm_count_ratio", "<f4"),
+        ("ddm_snr_ratio", "<f4"),
+        ("nassoc", "<i4"),
+        ("byte_offset", "<i4"),
+    ]
+)
+
+
+class OverviewFile:
+    def __init__(self, filename: str):
+        self._tree = ET.parse(filename)
+        self._root = self._tree.getroot()
+        self._candidates = self._root.find("candidates").findall("candidate")
+
+    @property
+    def ncands(self) -> int:
+        return len(self._candidates)
+
+    def section(self, name: str) -> dict:
+        el = self._root.find(name)
+        return {child.tag: child.text for child in el} if el is not None else {}
+
+    def dm_list(self) -> np.ndarray:
+        el = self._root.find("dedispersion_trials")
+        return np.array([float(t.text) for t in el.findall("trial")])
+
+    def acc_list(self) -> np.ndarray:
+        el = self._root.find("acceleration_trials")
+        return np.array([float(t.text) for t in el.findall("trial")])
+
+    def as_array(self) -> np.ndarray:
+        out = np.recarray(self.ncands, dtype=CAND_DTYPE)
+        for rec, cand in zip(out, self._candidates):
+            rec["cand_num"] = int(cand.attrib["id"])
+            for tag, _ in CAND_DTYPE.descr:
+                if tag != "cand_num":
+                    rec[tag] = float(cand.find(tag).text)
+        return out
+
+    def get_candidate(self, idx: int) -> dict:
+        cand = self._candidates[idx]
+        out = {"cand_num": int(cand.attrib["id"])}
+        for tag, typename in CAND_DTYPE.descr:
+            if tag != "cand_num":
+                out[tag] = np.array([cand.find(tag).text]).astype(typename)[0]
+        return out

@@ -1,0 +1,170 @@
+"""overview.xml writer.
+
+Format-compatible with the reference's minimal XML tree writer
+(`include/utils/xml_util.hpp:13-91` + the section layout of
+`include/utils/output_stats.hpp:17-218`): 15-significant-digit values,
+single-quoted attributes, two-space indentation, ISO-8859-1 prologue —
+so the reference's own ``tools/peasoup_tools.py`` can parse our output
+unchanged.
+"""
+
+from __future__ import annotations
+
+import getpass
+import time
+
+import numpy as np
+
+
+def _fmt(value) -> str:
+    if isinstance(value, bool):
+        return str(int(value))
+    if isinstance(value, (float, np.floating)):
+        return f"{float(value):.15g}"
+    return str(value)
+
+
+class XMLElement:
+    def __init__(self, name: str, value=None):
+        self.name = name
+        self.attributes: dict[str, str] = {}
+        self.children: list[XMLElement] = []
+        self.text = "" if value is None else _fmt(value)
+
+    def append(self, child: "XMLElement") -> "XMLElement":
+        self.children.append(child)
+        return child
+
+    def add_attribute(self, key: str, value) -> None:
+        self.attributes[key] = f"'{_fmt(value)}'"
+
+    def set_text(self, value) -> None:
+        self.text = _fmt(value)
+
+    def to_string(self, header: bool = False, level: int = 0) -> str:
+        parts = []
+        if header:
+            parts.append("<?xml version='1.0' encoding='ISO-8859-1'?>\n")
+        indent = "  " * level
+        attrs = "".join(f" {k}={v}" for k, v in self.attributes.items())
+        parts.append(f"{indent}<{self.name}{attrs}>")
+        if not self.children:
+            parts.append(self.text)
+        else:
+            parts.append("\n")
+            for child in self.children:
+                parts.append(child.to_string(False, level + 1))
+            parts.append(indent)
+        parts.append(f"</{self.name}>\n")
+        return "".join(parts)
+
+
+class OutputFileWriter:
+    """Build the overview.xml report (`output_stats.hpp:17-218`)."""
+
+    def __init__(self):
+        self.root = XMLElement("peasoup_search")
+
+    def to_string(self) -> str:
+        return self.root.to_string(header=True)
+
+    def to_file(self, filename: str) -> None:
+        with open(filename, "w", encoding="latin-1") as f:
+            f.write(self.to_string())
+
+    def add_misc_info(self) -> None:
+        info = self.root.append(XMLElement("misc_info"))
+        try:
+            user = getpass.getuser()
+        except Exception:
+            user = "unknown"
+        info.append(XMLElement("username", user))
+        t = time.time()
+        info.append(
+            XMLElement("local_datetime",
+                       time.strftime("%Y-%m-%d-%H:%M", time.localtime(t)))
+        )
+        info.append(
+            XMLElement("utc_datetime",
+                       time.strftime("%Y-%m-%d-%H:%M", time.gmtime(t)))
+        )
+
+    def add_header(self, hdr) -> None:
+        el = self.root.append(XMLElement("header_parameters"))
+        el.append(XMLElement("source_name", hdr.source_name))
+        el.append(XMLElement("rawdatafile", hdr.rawdatafile))
+        for key in ("az_start", "za_start", "src_raj", "src_dej", "tstart",
+                    "tsamp", "period", "fch1", "foff", "nchans",
+                    "telescope_id", "machine_id", "data_type", "ibeam",
+                    "nbeams", "nbits", "barycentric", "pulsarcentric",
+                    "nbins", "nsamples", "nifs", "npuls", "refdm"):
+            el.append(XMLElement(key, getattr(hdr, key)))
+        el.append(XMLElement("signed", int(hdr.signed_data)))
+
+    def add_search_parameters(self, cfg) -> None:
+        el = self.root.append(XMLElement("search_parameters"))
+        el.append(XMLElement("infilename", cfg.infilename))
+        el.append(XMLElement("outdir", cfg.outdir))
+        el.append(XMLElement("killfilename", cfg.killfilename))
+        el.append(XMLElement("zapfilename", cfg.zapfilename))
+        el.append(XMLElement("max_num_threads", cfg.max_num_threads))
+        el.append(XMLElement("size", cfg.size))
+        for key in ("dm_start", "dm_end", "dm_tol", "dm_pulse_width",
+                    "acc_start", "acc_end", "acc_tol", "acc_pulse_width",
+                    "boundary_5_freq", "boundary_25_freq", "nharmonics",
+                    "npdmp", "min_snr", "min_freq", "max_freq", "max_harm",
+                    "freq_tol", "verbose", "progress_bar"):
+            el.append(XMLElement(key, getattr(cfg, key)))
+
+    def add_dm_list(self, dms) -> None:
+        el = self.root.append(XMLElement("dedispersion_trials"))
+        el.add_attribute("count", len(dms))
+        for ii, dm in enumerate(dms):
+            trial = el.append(XMLElement("trial", float(dm)))
+            trial.add_attribute("id", ii)
+
+    def add_acc_list(self, accs, dm=0) -> None:
+        el = self.root.append(XMLElement("acceleration_trials"))
+        el.add_attribute("count", len(accs))
+        el.add_attribute("DM", dm)
+        for ii, acc in enumerate(accs):
+            trial = el.append(XMLElement("trial", float(acc)))
+            trial.add_attribute("id", ii)
+
+    def add_device_info(self, devices=None) -> None:
+        """TPU stand-in for the reference's cuda_device_parameters."""
+        import jax
+
+        el = self.root.append(XMLElement("device_parameters"))
+        el.append(XMLElement("backend", jax.default_backend()))
+        el.append(XMLElement("jax_version", jax.__version__))
+        devices = devices if devices is not None else jax.devices()
+        for ii, dev in enumerate(devices):
+            d = el.append(XMLElement("device"))
+            d.add_attribute("id", ii)
+            d.append(XMLElement("name", str(dev.device_kind)))
+            d.append(XMLElement("platform", str(dev.platform)))
+
+    def add_candidates(self, candidates, byte_mapping) -> None:
+        el = self.root.append(XMLElement("candidates"))
+        for ii, c in enumerate(candidates):
+            cand = el.append(XMLElement("candidate"))
+            cand.add_attribute("id", ii)
+            cand.append(XMLElement("period", 1.0 / c.freq))
+            cand.append(XMLElement("opt_period", c.opt_period))
+            cand.append(XMLElement("dm", c.dm))
+            cand.append(XMLElement("acc", c.acc))
+            cand.append(XMLElement("nh", c.nh))
+            cand.append(XMLElement("snr", c.snr))
+            cand.append(XMLElement("folded_snr", c.folded_snr))
+            cand.append(XMLElement("is_adjacent", c.is_adjacent))
+            cand.append(XMLElement("is_physical", c.is_physical))
+            cand.append(XMLElement("ddm_count_ratio", c.ddm_count_ratio))
+            cand.append(XMLElement("ddm_snr_ratio", c.ddm_snr_ratio))
+            cand.append(XMLElement("nassoc", c.count_assoc()))
+            cand.append(XMLElement("byte_offset", byte_mapping.get(ii, 0)))
+
+    def add_timing_info(self, timers: dict) -> None:
+        el = self.root.append(XMLElement("execution_times"))
+        for key in sorted(timers):
+            el.append(XMLElement(key, float(timers[key])))

@@ -1,0 +1,243 @@
+"""Device-mesh parallelism for the trial grid.
+
+TPU-native replacement of the reference's multi-GPU strategy: where
+`src/pipeline_multi.cu:33-81` runs a mutex-guarded DM-trial work queue
+over pthread workers (one per GPU) and merges candidate vectors after
+join, here the DM axis is a named mesh axis:
+
+* dedispersion is one jitted program whose delay table and output
+  carry a ``NamedSharding`` over ``("dm",)`` — XLA partitions the
+  channel sweep so each device produces only its DM rows (the input
+  filterbank block is replicated, as dedisp's multi-GPU plan does);
+* the search is a ``shard_map`` program: each device scans its local
+  block of DM trials (whiten -> accel-batch search) and emits
+  fixed-capacity peak buffers, which are device-local outputs of the
+  same sharding — a single device->host gather replaces the pthread
+  join + append of the reference;
+* the dynamic DM dispenser becomes a static balanced assignment: DM
+  trials cost the same per trial, and ragged accel lists are padded to
+  a rectangle with a validity mask (SURVEY.md section 7).
+
+On multi-host systems the same program runs under
+``jax.distributed.initialize`` with a global mesh: the per-shard peak
+buffers are all-gathered over ICI/DCN by the final host transfer, and
+candidate distillation remains a (cheap) host-side pass.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dedisperse import dedisperse
+from ..search.pipeline import (
+    PulsarSearch,
+    SearchResult,
+    search_one_accel,
+    whiten_core,
+    fold_candidates,
+)
+from ..search.distill import DMDistiller, HarmonicDistiller
+from ..search.plan import SearchConfig
+from ..search.score import CandidateScorer
+from ..data.candidates import CandidateCollection
+
+
+def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
+    devs = jax.devices()
+    if max_devices:
+        devs = devs[: max_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def sharded_search_program(
+    mesh: Mesh,
+    size: int,
+    bin_width: float,
+    tsamp: float,
+    nharms: int,
+    bounds: tuple,
+    capacity: int,
+    min_snr: float,
+    b5: float,
+    b25: float,
+    use_zap: bool,
+):
+    """Build the jitted shard_map search over the ``dm`` mesh axis.
+
+    Returns a callable (trials, accs, birdies, widths) -> (idxs, snrs,
+    counts) where trials is (ndm_padded, size) sharded over dm, accs is
+    (ndm_padded, naccel_max) with NaN padding, and outputs have leading
+    dim ndm_padded (sharded over dm).
+    """
+
+    def per_dm(carry, inp):
+        tim, accs = inp
+        birdies, widths = carry
+        tim_w, mean, std = whiten_core(
+            tim, birdies, widths, bin_width, b5, b25, use_zap
+        )
+        search = lambda a: search_one_accel(
+            tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
+            capacity, min_snr,
+        )
+        idxs, snrs, counts = jax.vmap(search)(accs)
+        # mask out padded accel slots entirely
+        valid = ~jnp.isnan(accs)
+        idxs = jnp.where(valid[:, None, None], idxs, -1)
+        snrs = jnp.where(valid[:, None, None], snrs, 0.0)
+        counts = jnp.where(valid[:, None], counts, 0)
+        return carry, (idxs, snrs, counts)
+
+    def shard_fn(trials, accs, birdies, widths):
+        # trials: (ndm_local, size); accs: (ndm_local, naccel_max)
+        _, outs = lax.scan(per_dm, (birdies, widths), (trials, accs))
+        return outs
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P("dm", None), P("dm", None), P(None), P(None)),
+        out_specs=(P("dm", None, None), P("dm", None, None), P("dm", None)),
+    )
+    return jax.jit(mapped)
+
+
+class MeshPulsarSearch(PulsarSearch):
+    """Multi-device search: DM trials sharded over a 1-D device mesh."""
+
+    def __init__(self, fil, config: SearchConfig, max_devices=None,
+                 mesh: Mesh | None = None):
+        super().__init__(fil, config)
+        self.mesh = mesh if mesh is not None else make_mesh(max_devices)
+        self.ndev = self.mesh.devices.size
+
+    def _padded_trial_count(self) -> int:
+        ndm = len(self.dm_list)
+        return int(np.ceil(ndm / self.ndev)) * self.ndev
+
+    def dedisperse_sharded(self) -> jax.Array:
+        """Dedisperse with the DM axis sharded across the mesh."""
+        ndm = len(self.dm_list)
+        ndm_p = self._padded_trial_count()
+        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+        delays[:ndm] = self.delays
+        data = jnp.asarray(self.fil.data.T, dtype=jnp.float32)
+        km = (
+            jnp.asarray(self.killmask)
+            if self.killmask is not None
+            else None
+        )
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P("dm", None))
+        data = jax.device_put(data, rep)
+        delays_d = jax.device_put(jnp.asarray(delays), shard)
+        fn = jax.jit(
+            partial(dedisperse, out_nsamps=self.out_nsamps),
+            out_shardings=shard,
+        )
+        if km is not None:
+            return fn(data, delays_d, killmask=jax.device_put(km, rep))
+        return fn(data, delays_d)
+
+    def run(self) -> SearchResult:
+        import time
+
+        cfg = self.config
+        timers: dict[str, float] = {}
+        t_total = time.time()
+        t0 = time.time()
+        trials = self.dedisperse_sharded()
+        trials.block_until_ready()
+        timers["dedispersion"] = time.time() - t0
+
+        t0 = time.time()
+        ndm = len(self.dm_list)
+        ndm_p = self._padded_trial_count()
+        acc_lists = [
+            self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
+        ]
+        namax = max(len(a) for a in acc_lists)
+        accs = np.full((ndm_p, namax), np.nan, np.float32)
+        for i, a in enumerate(acc_lists):
+            accs[i, : len(a)] = a
+
+        # trim/pad trials to (ndm_p, size)
+        if self.out_nsamps >= self.size:
+            trials_sz = trials[:, : self.size]
+        else:
+            pad_means = jnp.mean(trials, axis=1, keepdims=True)
+            pad = jnp.broadcast_to(
+                pad_means, (trials.shape[0], self.size - self.out_nsamps)
+            )
+            trials_sz = jnp.concatenate([trials, pad], axis=1)
+        if trials_sz.shape[0] < ndm_p:
+            trials_sz = jnp.pad(
+                trials_sz, ((0, ndm_p - trials_sz.shape[0]), (0, 0))
+            )
+
+        shard = NamedSharding(self.mesh, P("dm", None))
+        trials_sz = jax.device_put(trials_sz, shard)
+        accs_d = jax.device_put(
+            jnp.asarray(accs), NamedSharding(self.mesh, P("dm", None))
+        )
+
+        program = sharded_search_program(
+            self.mesh, self.size, self.bin_width, float(self.fil.tsamp),
+            cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
+            cfg.boundary_5_freq, cfg.boundary_25_freq,
+            bool(len(self.birdies)),
+        )
+        idxs, snrs, counts = program(
+            trials_sz, accs_d, jnp.asarray(self.birdies),
+            jnp.asarray(self.bwidths),
+        )
+        idxs = np.asarray(idxs)   # gather over ICI -> host
+        snrs = np.asarray(snrs)
+        counts = np.asarray(counts)
+
+        dm_cands = CandidateCollection()
+        for ii in range(ndm):
+            dm_cands.append(
+                self.process_dm_peaks(
+                    float(self.dm_list[ii]), ii, acc_lists[ii],
+                    idxs[ii], snrs[ii], counts[ii],
+                )
+            )
+        timers["searching"] = time.time() - t0
+
+        dm_still = DMDistiller(cfg.freq_tol, True)
+        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
+        cands = dm_still.distill(dm_cands.cands)
+        cands = harm_still.distill(cands)
+
+        hdr = self.fil.header
+        scorer = CandidateScorer(
+            hdr.tsamp, hdr.cfreq, hdr.foff, abs(hdr.foff) * self.fil.nchans
+        )
+        scorer.score_all(cands)
+
+        t0 = time.time()
+        if cfg.npdmp > 0:
+            fold_candidates(
+                cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
+                boundary_5_freq=cfg.boundary_5_freq,
+                boundary_25_freq=cfg.boundary_25_freq,
+            )
+        timers["folding"] = time.time() - t0
+
+        cands = cands[: cfg.limit]
+        timers["total"] = time.time() - t_total
+        return SearchResult(
+            candidates=CandidateCollection(cands),
+            dm_list=self.dm_list,
+            acc_list_dm0=self.acc_plan.generate_accel_list(0.0),
+            timers=timers,
+            config=cfg,
+            header=hdr,
+        )

@@ -59,8 +59,7 @@ from .score import CandidateScorer
 # jitted building blocks
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("bin_width", "b5", "b25", "use_zap"))
-def whiten_trial(tim, birdies, widths, bin_width, b5, b25, use_zap):
+def whiten_core(tim, birdies, widths, bin_width, b5, b25, use_zap):
     """Whiten one DM trial; returns (whitened tim, mean, std).
 
     ``bin_width`` is static: it only depends on the fft size and tsamp,
@@ -79,8 +78,13 @@ def whiten_trial(tim, birdies, widths, bin_width, b5, b25, use_zap):
     return tim_w, mean, std
 
 
-def _search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
-                      min_snr):
+whiten_trial = jax.jit(
+    whiten_core, static_argnames=("bin_width", "b5", "b25", "use_zap")
+)
+
+
+def search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
+                     min_snr):
     tim_r = resample2(tim_w, accel, tsamp)
     fs = jnp.fft.rfft(tim_r).astype(jnp.complex64)
     pspec = form_interpolated(fs)
@@ -102,7 +106,7 @@ def _search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
 def search_accel_chunk(tim_w, accels, mean, std, tsamp, nharms, bounds,
                        capacity, min_snr):
     """vmapped acceleration-trial batch: (chunk,) accels -> peak buffers."""
-    fn = lambda a: _search_one_accel(
+    fn = lambda a: search_one_accel(
         tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr
     )
     return jax.vmap(fn)(accels)
@@ -198,30 +202,40 @@ class PulsarSearch:
             bool(len(self.birdies)),
         )
         acc_list = self.acc_plan.generate_accel_list(dm)
-        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, False)
-        accel_trial_cands: list[Candidate] = []
         n = len(acc_list)
         chunk = max(1, min(cfg.accel_chunk, n))
         padded = int(np.ceil(n / chunk)) * chunk
         accs = np.zeros(padded, np.float32)
         accs[:n] = acc_list
+        all_idxs, all_snrs, all_counts = [], [], []
         for c0 in range(0, padded, chunk):
             batch = jnp.asarray(accs[c0 : c0 + chunk])
             idxs, snrs, counts = search_accel_chunk(
                 tim_w, batch, mean, std, float(self.fil.tsamp),
                 cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
             )
-            idxs = np.asarray(idxs)
-            snrs = np.asarray(snrs)
-            counts = np.asarray(counts)
-            for j in range(chunk):
-                k = c0 + j
-                if k >= n:
-                    break
-                cands = self._peaks_to_candidates(
-                    idxs[j], snrs[j], counts[j], dm, idx, float(accs[k])
-                )
-                accel_trial_cands.extend(harm_still.distill(cands))
+            all_idxs.append(np.asarray(idxs))
+            all_snrs.append(np.asarray(snrs))
+            all_counts.append(np.asarray(counts))
+        return self.process_dm_peaks(
+            dm, idx, acc_list,
+            np.concatenate(all_idxs), np.concatenate(all_snrs),
+            np.concatenate(all_counts),
+        )
+
+    def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts):
+        """Turn per-(accel, spectrum) peak buffers into distilled per-DM
+        candidates: harmonic distillation within each accel trial
+        (`pipeline_multi.cu:238`), acceleration distillation across them
+        (`pipeline_multi.cu:243`)."""
+        cfg = self.config
+        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, False)
+        accel_trial_cands: list[Candidate] = []
+        for j, acc in enumerate(acc_list):
+            cands = self._peaks_to_candidates(
+                idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc)
+            )
+            accel_trial_cands.extend(harm_still.distill(cands))
         acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
         return acc_still.distill(accel_trial_cands)
 

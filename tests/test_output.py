@@ -1,0 +1,101 @@
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.cli import build_parser, args_to_config, write_search_output, main
+from peasoup_tpu.data import Candidate
+from peasoup_tpu.output import (
+    CandidateFileParser,
+    OutputFileWriter,
+    OverviewFile,
+    XMLElement,
+    write_candidate_binary,
+)
+
+
+def mk_cand(freq=4.0, dm=30.0, snr=50.0, with_fold=False, nassoc=0):
+    c = Candidate(dm=dm, dm_idx=9, acc=0.0, nh=2, snr=snr, freq=freq,
+                  opt_period=1.0 / freq)
+    for i in range(nassoc):
+        c.append(Candidate(dm=dm + i, dm_idx=9 + i, snr=snr / 2, freq=freq * 2))
+    if with_fold:
+        c.fold = np.arange(64 * 16, dtype=np.float32).reshape(16, 64)
+        c.nbins, c.nints = 64, 16
+    return c
+
+
+def test_xml_element_formatting():
+    el = XMLElement("trial", 3.3133590221405)
+    el.add_attribute("id", 1)
+    assert el.to_string() == "<trial id='1'>3.3133590221405</trial>\n"
+    root = XMLElement("root")
+    root.append(XMLElement("child", 0.10000000149011612))
+    out = root.to_string(header=True)
+    assert out.startswith("<?xml version='1.0' encoding='ISO-8859-1'?>\n")
+    assert "<child>0.100000001490116</child>" in out  # 15 sig digits
+
+
+def test_binary_roundtrip(tmp_path):
+    cands = [mk_cand(with_fold=True, nassoc=3), mk_cand(freq=7.0, nassoc=0)]
+    path = str(tmp_path / "candidates.peasoup")
+    mapping = write_candidate_binary(cands, path)
+    assert mapping[0] == 0
+    with CandidateFileParser(path) as parser:
+        fold, hits = parser.cand_from_offset(mapping[0])
+        assert fold.shape == (16, 64)
+        np.testing.assert_array_equal(fold, cands[0].fold)
+        assert len(hits) == 4  # candidate + 3 assoc
+        assert hits[0]["dm"] == pytest.approx(30.0)
+        assert hits[0]["snr"] == pytest.approx(50.0)
+        fold2, hits2 = parser.cand_from_offset(mapping[1])
+        assert fold2 is None
+        assert len(hits2) == 1
+        assert hits2[0]["freq"] == pytest.approx(7.0)
+
+
+def test_golden_overview_parses(golden_overview):
+    ov = OverviewFile(golden_overview)
+    assert ov.ncands == 10
+    assert len(ov.dm_list()) == 59
+    arr = ov.as_array()
+    assert arr["snr"][0] == pytest.approx(86.9626083374023)
+
+
+def test_cli_end_to_end(tutorial_fil, tmp_path):
+    outdir = str(tmp_path / "out")
+    rc = main([
+        "-i", tutorial_fil, "-o", outdir,
+        "--dm_start", "0", "--dm_end", "20",
+        "--acc_start", "-5", "--acc_end", "5",
+        "--acc_pulse_width", "64000", "--npdmp", "2", "--limit", "10",
+        "--single_device",
+    ])
+    assert rc == 0
+    ov = OverviewFile(os.path.join(outdir, "overview.xml"))
+    arr = ov.as_array()
+    assert ov.ncands > 0
+    # binary offsets must be consistent with the XML
+    with CandidateFileParser(os.path.join(outdir, "candidates.peasoup")) as p:
+        for rec in arr:
+            fold, hits = p.cand_from_offset(int(rec["byte_offset"]))
+            assert hits[0]["snr"] == pytest.approx(float(rec["snr"]), rel=1e-5)
+            assert 1 + rec["nassoc"] == len(hits)
+    # sections present
+    assert "tsamp" in ov.section("header_parameters")
+    assert "dm_start" in ov.section("search_parameters")
+    assert "total" in ov.section("execution_times")
+
+
+def test_cli_defaults_match_reference():
+    args = build_parser().parse_args(["-i", "x.fil"])
+    cfg = args_to_config(args)
+    assert cfg.dm_end == 100.0
+    assert cfg.dm_tol == pytest.approx(1.10)
+    assert cfg.nharmonics == 4
+    assert cfg.min_snr == 9.0
+    assert cfg.max_freq == 1100.0
+    assert cfg.max_harm == 16
+    assert cfg.freq_tol == pytest.approx(1e-4)
+    assert cfg.limit == 1000
+    assert cfg.outdir.endswith("_peasoup/")
