@@ -25,7 +25,7 @@ TUTORIAL = "/root/reference/example_data/tutorial.fil"
 
 def main() -> None:
     from peasoup_tpu.io import read_filterbank
-    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
     from peasoup_tpu.search.plan import SearchConfig
 
     if not os.path.exists(TUTORIAL):
@@ -44,10 +44,10 @@ def main() -> None:
 
     # Warm-up run: XLA compilation is cached per-process; the reference's
     # 0.770 s likewise excludes CUDA context/module setup costs.
-    PulsarSearch(fil, cfg).run()
+    MeshPulsarSearch(fil, cfg).run()
 
     t0 = time.time()
-    search = PulsarSearch(fil, cfg)
+    search = MeshPulsarSearch(fil, cfg)
     result = search.run()
     elapsed = time.time() - t0
 

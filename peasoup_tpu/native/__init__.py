@@ -15,7 +15,7 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_HERE, "_peasoup_native.so")
-_SOURCES = [os.path.join(_HERE, "unpack.cpp")]
+_SOURCES = [os.path.join(_HERE, "unpack.cpp"), os.path.join(_HERE, "peaks.cpp")]
 
 
 def _build() -> str:
@@ -36,6 +36,12 @@ class _NativeLib:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         self._dll.unpack_bits.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int, u8p]
         self._dll.pack_bits.argtypes = [u8p, ctypes.c_size_t, ctypes.c_int, u8p]
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        self._dll.unique_peaks.argtypes = [
+            i64p, f32p, ctypes.c_size_t, ctypes.c_int64, i64p, f32p,
+        ]
+        self._dll.unique_peaks.restype = ctypes.c_size_t
 
     def unpack_bits(self, raw: np.ndarray, nbits: int) -> np.ndarray:
         raw = np.ascontiguousarray(raw, dtype=np.uint8)
@@ -45,6 +51,21 @@ class _NativeLib:
             raw.ctypes.data_as(u8p), raw.size, nbits, out.ctypes.data_as(u8p)
         )
         return out
+
+    def unique_peaks(self, idxs: np.ndarray, snrs: np.ndarray, min_gap: int):
+        idxs = np.ascontiguousarray(idxs, dtype=np.int64)
+        snrs = np.ascontiguousarray(snrs, dtype=np.float32)
+        n = idxs.size
+        out_idx = np.empty(n, dtype=np.int64)
+        out_snr = np.empty(n, dtype=np.float32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        nout = self._dll.unique_peaks(
+            idxs.ctypes.data_as(i64p), snrs.ctypes.data_as(f32p), n,
+            min_gap, out_idx.ctypes.data_as(i64p),
+            out_snr.ctypes.data_as(f32p),
+        )
+        return out_idx[:nout], out_snr[:nout]
 
     def pack_bits(self, samples: np.ndarray, nbits: int) -> np.ndarray:
         samples = np.ascontiguousarray(samples, dtype=np.uint8)

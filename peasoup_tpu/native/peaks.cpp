@@ -1,0 +1,39 @@
+// Native unique-peak merge for the candidate extraction hot path.
+//
+// Exact semantics of the reference's host-side peak grouping
+// (include/transforms/peakfinder.hpp:27-56): walking bins in ascending
+// index order, a group keeps absorbing bins while the next bin is
+// within min_gap of the index of the group's current best peak (the
+// "last" index only advances when a higher value is found).  The walk
+// is inherently sequential, so it lives in C++ rather than NumPy.
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+size_t unique_peaks(const int64_t* idxs, const float* snrs, size_t n,
+                    int64_t min_gap, int64_t* out_idx, float* out_snr) {
+    size_t nout = 0;
+    size_t ii = 0;
+    while (ii < n) {
+        float cpeak = snrs[ii];
+        int64_t cpeakidx = idxs[ii];
+        int64_t lastidx = idxs[ii];
+        ++ii;
+        while (ii < n && (idxs[ii] - lastidx) < min_gap) {
+            if (snrs[ii] > cpeak) {
+                cpeak = snrs[ii];
+                cpeakidx = idxs[ii];
+                lastidx = idxs[ii];
+            }
+            ++ii;
+        }
+        out_idx[nout] = cpeakidx;
+        out_snr[nout] = cpeak;
+        ++nout;
+    }
+    return nout;
+}
+
+}  // extern "C"

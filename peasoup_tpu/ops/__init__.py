@@ -16,3 +16,4 @@ from .peaks import (
     identify_unique_peaks,
     spectrum_search_bounds,
 )
+from .unpack import unpack_bits_device

@@ -120,7 +120,11 @@ def dedisperse(
         )(d)
         return acc + sliced, None
 
-    init = jnp.zeros((ndm, out_nsamps), dtype=jnp.float32)
+    # derive the zero init from ``delays`` so that under shard_map it
+    # carries the same varying-manual-axes annotation as the scanned
+    # slices (XLA folds the broadcast-of-zeros away)
+    init = jnp.zeros((ndm, out_nsamps), dtype=jnp.float32) \
+        + delays[:, :1].astype(jnp.float32) * 0.0
     out, _ = lax.scan(chan_step, init, (data, delays.T))
     return out
 

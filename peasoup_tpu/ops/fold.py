@@ -33,8 +33,7 @@ import jax
 import jax.numpy as jnp
 
 
-@partial(jax.jit, static_argnames=("nbins", "nints"))
-def fold_time_series(
+def fold_time_series_core(
     tim: jnp.ndarray, period, tsamp, nbins: int = 64, nints: int = 16
 ) -> jnp.ndarray:
     """Fold a time series into an (nints, nbins) sub-integration profile."""
@@ -56,13 +55,31 @@ def fold_time_series(
     return prof.reshape(nints, nbins).astype(jnp.float32)
 
 
-@partial(jax.jit, static_argnames=())
-def _optimise_core(subints: jnp.ndarray):
-    """Device part of the fold optimisation.
+fold_time_series = jax.jit(
+    fold_time_series_core, static_argnames=("nbins", "nints")
+)
 
-    Returns (argmax_flat, opt_subints_real, opt_profiles_real) where the
-    per-shift real profiles/subints are produced for all shifts (the
-    host then selects the optimum — nbins*nints*nshifts is tiny).
+
+def optimise_device(subints: jnp.ndarray):
+    """Device part of the fold optimisation, optimum selected on device.
+
+    Returns (argmax_flat, opt_fold (nints, nbins), opt_prof (nbins,)) —
+    only the optimal shift's real subints/profile, so a batched caller
+    ships home O(nbins*nints) per candidate instead of O(nbins^2*nints).
+    """
+    nints, nbins = subints.shape
+    nshifts = nbins
+    argmax, post_shift, profiles = _matched_filter(subints)
+    opt_shift = (argmax // nbins) % nshifts
+    opt_fold = jnp.real(jnp.fft.ifft(post_shift[opt_shift], axis=1))
+    opt_prof = jnp.real(jnp.fft.ifft(profiles[opt_shift]))
+    return argmax, opt_fold, opt_prof
+
+
+def _matched_filter(subints: jnp.ndarray):
+    """Shift x template matched filter over the FFT'd subints.
+
+    Returns (argmax_flat, post_shift (s, m, b), profiles (s, b)).
     """
     nints, nbins = subints.shape
     nshifts = nbins
@@ -94,7 +111,14 @@ def _optimise_core(subints: jnp.ndarray):
     td = jnp.fft.ifft(final, axis=2)
     absarr = jnp.abs(td)
     argmax = jnp.argmax(absarr.reshape(-1))
+    return argmax, post_shift, profiles
 
+
+@jax.jit
+def _optimise_core(subints: jnp.ndarray):
+    """All-shifts variant (host selects the optimum); kept for the
+    single-candidate ``optimise_fold`` path and its tests."""
+    argmax, post_shift, profiles = _matched_filter(subints)
     opt_subints_all = jnp.real(jnp.fft.ifft(post_shift, axis=2))  # (s, m, b)
     opt_profiles_all = jnp.real(jnp.fft.ifft(profiles, axis=1))  # (s, b)
     return argmax, opt_subints_all, opt_profiles_all
@@ -134,19 +158,20 @@ class OptimisedFold:
     opt_fold: np.ndarray     # (nints, nbins)
 
 
-def optimise_fold(subints: np.ndarray, period: float, tobs: float) -> OptimisedFold:
-    """Full fold optimisation for one folded candidate."""
-    nints, nbins = subints.shape
+def finalise_fold(
+    argmax: int,
+    opt_prof: np.ndarray,
+    opt_fold: np.ndarray,
+    period: float,
+    tobs: float,
+) -> OptimisedFold:
+    """Host tail of the optimisation: S/N + optimised period from the
+    device-selected optimum (`folder.hpp:308-332`)."""
+    nbins = opt_prof.shape[0]
     nshifts = nbins
-    argmax, opt_subints_all, opt_profiles_all = _optimise_core(
-        jnp.asarray(subints, jnp.float32)
-    )
-    argmax = int(argmax)
     opt_template = argmax // (nbins * nshifts)
     opt_bin = argmax % nbins - opt_template // 2
     opt_shift = (argmax // nbins) % nbins
-    opt_prof = np.asarray(opt_profiles_all)[opt_shift]
-    opt_fold = np.asarray(opt_subints_all)[opt_shift]
     sn1, sn2 = calculate_sn(opt_prof, opt_bin, opt_template, nbins)
     # REFERENCE-QUIRK(folder.hpp:330): hardcoded 32 (nbins/2 for nbins=64)
     opt_period = period * ((((32.0 - opt_shift) * period) / (nbins * tobs)) + 1.0)
@@ -158,3 +183,16 @@ def optimise_fold(subints: np.ndarray, period: float, tobs: float) -> OptimisedF
         opt_prof=opt_prof,
         opt_fold=opt_fold,
     )
+
+
+def optimise_fold(subints: np.ndarray, period: float, tobs: float) -> OptimisedFold:
+    """Full fold optimisation for one folded candidate."""
+    nints, nbins = subints.shape
+    argmax, opt_subints_all, opt_profiles_all = _optimise_core(
+        jnp.asarray(subints, jnp.float32)
+    )
+    argmax = int(argmax)
+    opt_shift = (argmax // nbins) % nbins
+    opt_prof = np.asarray(opt_profiles_all)[opt_shift]
+    opt_fold = np.asarray(opt_subints_all)[opt_shift]
+    return finalise_fold(argmax, opt_prof, opt_fold, period, tobs)

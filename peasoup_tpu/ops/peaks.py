@@ -54,8 +54,15 @@ def identify_unique_peaks(
     Exact reproduction of `peakfinder.hpp:27-56`: walking in index
     order, a group keeps absorbing bins while the next bin is within
     ``min_gap`` of the index of the group's current best peak (the
-    "last" index only advances when a higher value is found).
+    "last" index only advances when a higher value is found).  The walk
+    is sequential, so a native C++ fast path is used when available.
     """
+    try:
+        from ..native import lib as _native
+    except Exception:
+        _native = None
+    if _native is not None:
+        return _native.unique_peaks(idxs, snrs, min_gap)
     peak_idxs: list[int] = []
     peak_snrs: list[float] = []
     count = len(idxs)

@@ -1,0 +1,32 @@
+"""Device-side bit unpacking for 1/2/4/8-bit filterbank words.
+
+Host→device transfer of a whole filterbank is bandwidth-bound; shipping
+the *packed* bytes and unpacking on device cuts the transfer by 8/nbits.
+Bit order matches ``peasoup_tpu.io.unpack`` (little-endian within each
+byte), which mirrors what the reference feeds to ``dedisp_execute``
+(`include/transforms/dedisperser.hpp:104-112`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def unpack_bits_device(raw: jnp.ndarray, nbits: int) -> jnp.ndarray:
+    """Unpack a uint8 byte vector to one value per sample (on device).
+
+    Returns an int32 vector of length ``len(raw) * (8 // nbits)``.
+    32-bit input is already one float per sample and passes through.
+    """
+    if nbits == 32:
+        return raw
+    if nbits == 8:
+        return raw.astype(jnp.int32)
+    if nbits not in (1, 2, 4):
+        raise ValueError(f"unsupported nbits: {nbits}")
+    spb = 8 // nbits
+    mask = (1 << nbits) - 1
+    b = raw.astype(jnp.int32)
+    shifts = jnp.arange(spb, dtype=jnp.int32) * nbits
+    vals = (b[:, None] >> shifts[None, :]) & mask  # (nbytes, spb)
+    return vals.reshape(-1)

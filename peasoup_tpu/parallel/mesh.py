@@ -45,7 +45,9 @@ from ..search.pipeline import (
 from ..search.distill import DMDistiller, HarmonicDistiller
 from ..search.plan import SearchConfig
 from ..search.score import CandidateScorer
-from ..data.candidates import CandidateCollection
+from ..data.candidates import Candidate, CandidateCollection
+from ..io.unpack import pack_bits
+from ..ops.peaks import identify_unique_peaks
 
 
 def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
@@ -53,6 +55,28 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
     if max_devices:
         devs = devs[: max_devices]
     return Mesh(np.array(devs), (axis,))
+
+
+def _search_dm_row(tim, accs_row, birdies, widths, *, bin_width, tsamp,
+                   nharms, bounds, capacity, min_snr, b5, b25, use_zap):
+    """Whiten one DM trial and search its (NaN-padded) accel batch.
+
+    Shared body of both sharded programs: returns (idxs, snrs, counts)
+    with padded accel slots fully masked out.
+    """
+    tim_w, mean, std = whiten_core(
+        tim, birdies, widths, bin_width, b5, b25, use_zap
+    )
+    search = lambda a: search_one_accel(
+        tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
+        capacity, min_snr,
+    )
+    idxs, snrs, counts = jax.vmap(search)(accs_row)
+    valid = ~jnp.isnan(accs_row)
+    idxs = jnp.where(valid[:, None, None], idxs, -1)
+    snrs = jnp.where(valid[:, None, None], snrs, 0.0)
+    counts = jnp.where(valid[:, None], counts, 0)
+    return idxs, snrs, counts
 
 
 def sharded_search_program(
@@ -79,20 +103,12 @@ def sharded_search_program(
     def per_dm(carry, inp):
         tim, accs = inp
         birdies, widths = carry
-        tim_w, mean, std = whiten_core(
-            tim, birdies, widths, bin_width, b5, b25, use_zap
+        outs = _search_dm_row(
+            tim, accs, birdies, widths, bin_width=bin_width, tsamp=tsamp,
+            nharms=nharms, bounds=bounds, capacity=capacity,
+            min_snr=min_snr, b5=b5, b25=b25, use_zap=use_zap,
         )
-        search = lambda a: search_one_accel(
-            tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
-            capacity, min_snr,
-        )
-        idxs, snrs, counts = jax.vmap(search)(accs)
-        # mask out padded accel slots entirely
-        valid = ~jnp.isnan(accs)
-        idxs = jnp.where(valid[:, None, None], idxs, -1)
-        snrs = jnp.where(valid[:, None, None], snrs, 0.0)
-        counts = jnp.where(valid[:, None], counts, 0)
-        return carry, (idxs, snrs, counts)
+        return carry, outs
 
     def shard_fn(trials, accs, birdies, widths):
         # trials: (ndm_local, size); accs: (ndm_local, naccel_max)
@@ -108,6 +124,124 @@ def sharded_search_program(
     return jax.jit(mapped)
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def build_fused_search(
+    mesh: Mesh,
+    *,
+    nbits: int,
+    nchans: int,
+    nsamps: int,
+    out_nsamps: int,
+    size: int,
+    bin_width: float,
+    tsamp: float,
+    nharms: int,
+    bounds: tuple,
+    capacity: int,
+    min_snr: float,
+    b5: float,
+    b25: float,
+    use_zap: bool,
+    use_killmask: bool,
+    compact_k: int,
+):
+    """One jitted program for the ENTIRE device side of the search.
+
+    packed filterbank bytes (replicated) -> device bit-unpack ->
+    dedisperse (DM rows sharded over the mesh) -> per-DM whiten ->
+    batched accel trials -> harmonic sums -> thresholded peaks ->
+    global compaction of all (dm, accel, level) peak buffers into one
+    small tagged buffer per shard.
+
+    This exists because device->host transfers and program dispatches
+    dominate wall-clock on a remote-attached TPU: the reference pays
+    neither (its host loop talks to a local PCIe GPU per DM trial,
+    `src/pipeline_multi.cu:145-244`), so the TPU-native design moves the
+    whole search into one dispatch and ships home only:
+
+    * ``sel_pos``  (compact_k,) int32 — flat position tags (encode
+      dm_local, accel trial, harmonic level, slot)
+    * ``sel_bin``  (compact_k,) int32 — spectrum bin indices
+    * ``sel_snr``  (compact_k,) f32   — SNR values
+    * ``nvalid``   (1,) int32 — true total peak count (overflow check)
+    * ``counts``   (ndm_local, naccel, nlevels) int32 — per-spectrum
+      above-threshold counts (per-spectrum overflow check)
+    * ``trials``   (ndm_local, out_nsamps) f32 — full-width, stays
+      device-resident for the folding phase; never copied to host.
+
+    Returns a jitted callable
+    ``fn(raw, delays, killmask, accs, birdies, widths)``.
+    """
+    from ..ops.unpack import unpack_bits_device
+
+    nlevels = nharms + 1
+
+    def shard_fn(raw, delays, killmask, accs, birdies, widths):
+        vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
+        data = vals.reshape(nsamps, nchans).T.astype(jnp.float32)
+        if use_killmask:
+            data = data * killmask[:, None]
+        # full-width trials are returned for the folding phase (which
+        # must see prev_power_of_two(out_nsamps) real samples exactly
+        # like the single-device path, `folder.hpp:352-406`); the
+        # search itself runs on the fft-size-truncated/padded view
+        trials = dedisperse(data, delays, out_nsamps)
+        if out_nsamps >= size:
+            trials_sz = trials[:, :size]
+        else:
+            pad_mean = jnp.mean(trials, axis=1, keepdims=True)
+            pad = jnp.broadcast_to(
+                pad_mean, (trials.shape[0], size - out_nsamps)
+            )
+            trials_sz = jnp.concatenate([trials, pad], axis=1)
+
+        def per_dm(carry, inp):
+            tim, accs_row = inp
+            outs = _search_dm_row(
+                tim, accs_row, birdies, widths, bin_width=bin_width,
+                tsamp=tsamp, nharms=nharms, bounds=bounds,
+                capacity=capacity, min_snr=min_snr, b5=b5, b25=b25,
+                use_zap=use_zap,
+            )
+            return carry, outs
+
+        _, (idxs, snrs, counts) = lax.scan(per_dm, 0, (trials_sz, accs))
+
+        flat_bin = idxs.reshape(-1)
+        flat_snr = snrs.reshape(-1)
+        n = flat_bin.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        valid = flat_bin >= 0
+        sentinel = jnp.int32(-n - 1)
+        score = jnp.where(valid, -pos, sentinel)
+        top, _ = lax.top_k(score, compact_k)  # first compact_k valid slots
+        got = top != sentinel
+        sel = jnp.where(got, -top, 0)
+        # the host reconstructs each entry's (dm, accel, level, slot) tag
+        # from ``counts`` alone: valid slots appear in flat spectrum
+        # order, so only bins+snrs are shipped
+        sel_bin = jnp.where(got, flat_bin[sel], -1)
+        sel_snr = jnp.where(got, flat_snr[sel], 0.0).astype(jnp.float32)
+        nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
+        return sel_bin, sel_snr, nvalid, counts, trials
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(
+            P(), P("dm", None), P(), P("dm", None), P(), P(),
+        ),
+        out_specs=(
+            P("dm"), P("dm"), P("dm"),
+            P("dm", None, None), P("dm", None),
+        ),
+    )
+    return jax.jit(mapped)
+
+
 class MeshPulsarSearch(PulsarSearch):
     """Multi-device search: DM trials sharded over a 1-D device mesh."""
 
@@ -116,6 +250,31 @@ class MeshPulsarSearch(PulsarSearch):
         super().__init__(fil, config)
         self.mesh = mesh if mesh is not None else make_mesh(max_devices)
         self.ndev = self.mesh.devices.size
+
+    def _entries_to_dm_cands(self, dm, dm_idx, acc_list, ebins, esnrs,
+                             eacc, elvl):
+        """Sparse equivalent of ``PulsarSearch.process_dm_peaks``: turn
+        this DM's compacted peak entries into distilled candidates.
+        Entry order within each (accel, level) spectrum is ascending bin
+        index (compaction preserves slot order), as the unique-peak
+        merge requires."""
+        groups: list[list[Candidate]] = []
+        for j, acc in enumerate(acc_list):
+            m_acc = eacc == j
+            cands: list[Candidate] = []
+            for level, (_start, _stop, factor) in enumerate(self.bounds):
+                m = m_acc & (elvl == level)
+                if not m.any():
+                    continue
+                pidx, psnr = identify_unique_peaks(ebins[m], esnrs[m])
+                for p, s in zip(pidx, psnr):
+                    cands.append(
+                        Candidate(dm=dm, dm_idx=dm_idx, acc=float(acc),
+                                  nh=level, snr=float(s),
+                                  freq=float(p * factor))
+                    )
+            groups.append(cands)
+        return self._distill_accel_groups(groups)
 
     def _padded_trial_count(self) -> int:
         ndm = len(self.dm_list)
@@ -147,18 +306,16 @@ class MeshPulsarSearch(PulsarSearch):
 
     def run(self) -> SearchResult:
         import time
+        import warnings
 
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
-        t0 = time.time()
-        trials = self.dedisperse_sharded()
-        trials.block_until_ready()
-        timers["dedispersion"] = time.time() - t0
 
-        t0 = time.time()
         ndm = len(self.dm_list)
         ndm_p = self._padded_trial_count()
+        ndev = self.ndev
+        ndm_local = ndm_p // ndev
         acc_lists = [
             self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
         ]
@@ -166,47 +323,110 @@ class MeshPulsarSearch(PulsarSearch):
         accs = np.full((ndm_p, namax), np.nan, np.float32)
         for i, a in enumerate(acc_lists):
             accs[i, : len(a)] = a
-
-        # trim/pad trials to (ndm_p, size)
-        if self.out_nsamps >= self.size:
-            trials_sz = trials[:, : self.size]
+        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+        delays[:ndm] = self.delays
+        killmask = (
+            self.killmask
+            if self.killmask is not None
+            else np.ones(self.fil.nchans, np.float32)
+        )
+        nbits = self.fil.header.nbits
+        if nbits == 32:  # float data: nothing to pack
+            raw = np.ascontiguousarray(self.fil.data, np.float32).ravel()
         else:
-            pad_means = jnp.mean(trials, axis=1, keepdims=True)
-            pad = jnp.broadcast_to(
-                pad_means, (trials.shape[0], self.size - self.out_nsamps)
-            )
-            trials_sz = jnp.concatenate([trials, pad], axis=1)
-        if trials_sz.shape[0] < ndm_p:
-            trials_sz = jnp.pad(
-                trials_sz, ((0, ndm_p - trials_sz.shape[0]), (0, 0))
-            )
+            raw = pack_bits(self.fil.data.ravel(), nbits)
+        nlevels = cfg.nharmonics + 1
+        cap = cfg.peak_capacity
+        # clamp to the shard's total slot count (small configs)
+        compact_k = min(
+            cfg.compact_capacity, ndm_local * namax * nlevels * cap
+        )
 
+        program = build_fused_search(
+            self.mesh,
+            nbits=nbits,
+            nchans=self.fil.nchans,
+            nsamps=self.fil.nsamps,
+            out_nsamps=self.out_nsamps,
+            size=self.size,
+            bin_width=self.bin_width,
+            tsamp=float(self.fil.tsamp),
+            nharms=cfg.nharmonics,
+            bounds=self.bounds,
+            capacity=cap,
+            min_snr=cfg.min_snr,
+            b5=cfg.boundary_5_freq,
+            b25=cfg.boundary_25_freq,
+            use_zap=bool(len(self.birdies)),
+            use_killmask=self.killmask is not None,
+            compact_k=compact_k,
+        )
+
+        t0 = time.time()
+        rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
-        trials_sz = jax.device_put(trials_sz, shard)
-        accs_d = jax.device_put(
-            jnp.asarray(accs), NamedSharding(self.mesh, P("dm", None))
+        raw_d = jax.device_put(jnp.asarray(raw), rep)
+        delays_d = jax.device_put(jnp.asarray(delays), shard)
+        km_d = jax.device_put(jnp.asarray(killmask, dtype=jnp.float32), rep)
+        accs_d = jax.device_put(jnp.asarray(accs), shard)
+        sel_bin, sel_snr, nvalid, counts, trials = program(
+            raw_d, delays_d, km_d, accs_d,
+            jnp.asarray(self.birdies), jnp.asarray(self.bwidths),
         )
-
-        program = sharded_search_program(
-            self.mesh, self.size, self.bin_width, float(self.fil.tsamp),
-            cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
-            cfg.boundary_5_freq, cfg.boundary_25_freq,
-            bool(len(self.birdies)),
-        )
-        idxs, snrs, counts = program(
-            trials_sz, accs_d, jnp.asarray(self.birdies),
-            jnp.asarray(self.bwidths),
-        )
-        idxs = np.asarray(idxs)   # gather over ICI -> host
-        snrs = np.asarray(snrs)
+        # tiny gathers over ICI -> host; ``trials`` stays on device
+        sel_bin = np.asarray(sel_bin)
+        sel_snr = np.asarray(sel_snr)
+        nvalid = np.asarray(nvalid)
         counts = np.asarray(counts)
+        timers["dedispersion"] = 0.0  # fused into the search program
+        # sub-span of "searching" (which covers device + host decode)
+        timers["searching_device"] = time.time() - t0
+
+        if counts.max(initial=0) > cap:
+            warnings.warn(
+                f"peak buffer overflow: max count {counts.max()} > "
+                f"capacity {cap}; raise peak_capacity"
+            )
+
+        # reconstruct each entry's (dm_local, accel, level) tag from
+        # counts: the device compaction keeps valid slots in flat
+        # (dm_local, accel, level, slot) order
+        per_dm_entries: dict[int, tuple] = {}
+        nspec_local = ndm_local * namax * nlevels
+        for s in range(ndev):
+            if nvalid[s] > compact_k:
+                warnings.warn(
+                    f"compacted peak buffer overflow on shard {s}: "
+                    f"{nvalid[s]} > {compact_k}; raise compact_capacity"
+                )
+            k = np.minimum(
+                counts[s * ndm_local : (s + 1) * ndm_local], cap
+            ).reshape(-1)
+            spec = np.repeat(
+                np.arange(nspec_local, dtype=np.int64), k
+            )[:compact_k]
+            nent = spec.shape[0]
+            blk = slice(s * compact_k, s * compact_k + nent)
+            bins = sel_bin[blk]
+            snrs = sel_snr[blk]
+            lvl = spec % nlevels
+            acc_i = (spec // nlevels) % namax
+            dml = spec // (nlevels * namax)
+            for d in np.unique(dml):
+                m = dml == d
+                per_dm_entries[int(s * ndm_local + d)] = (
+                    bins[m], snrs[m], acc_i[m], lvl[m]
+                )
 
         dm_cands = CandidateCollection()
         for ii in range(ndm):
+            if ii not in per_dm_entries:
+                continue
+            ebins, esnrs, eacc, elvl = per_dm_entries[ii]
             dm_cands.append(
-                self.process_dm_peaks(
+                self._entries_to_dm_cands(
                     float(self.dm_list[ii]), ii, acc_lists[ii],
-                    idxs[ii], snrs[ii], counts[ii],
+                    ebins, esnrs, eacc, elvl,
                 )
             )
         timers["searching"] = time.time() - t0

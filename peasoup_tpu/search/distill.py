@@ -5,8 +5,10 @@ Host-side greedy SNR-sorted dedup, exact semantics of
 
 * ``BaseDistiller.distill``: sort by SNR descending; walk the survivors
   in order, letting each "fundamental" absorb (mark non-unique, and
-  optionally append to its ``assoc`` list) everything its ``condition``
-  matches further down the list.
+  optionally append to its ``assoc`` list) everything its match
+  predicate hits further down the list.  Like the reference,
+  already-absorbed candidates are still tested (and may be appended to
+  several fundamentals' ``assoc`` lists).
 * ``HarmonicDistiller``: absorbs candidates whose frequency is a
   (fractional, up to 2^nh denominators) harmonic ratio of the
   fundamental within tolerance.
@@ -14,6 +16,9 @@ Host-side greedy SNR-sorted dedup, exact semantics of
   within the Doppler drift window f*da*tobs/c of the fundamental.
 * ``DMDistiller``: absorbs candidates with matching frequency ratio
   regardless of DM.
+
+The O(n^2) pair predicates are vectorised over the trailing candidates
+(the reference's inner loops, `distiller.hpp:69-197`, are per-pair).
 """
 
 from __future__ import annotations
@@ -29,17 +34,28 @@ class BaseDistiller:
     def __init__(self, keep_related: bool):
         self.keep_related = keep_related
 
-    def condition(self, cands, idx, unique):
+    def matches(self, idx: int) -> np.ndarray:
+        """Bool array over candidates idx+1.. that this fundamental
+        absorbs."""
         raise NotImplementedError
+
+    def setup(self, cands: list[Candidate]) -> None:
+        self.freqs = np.array([c.freq for c in cands], np.float64)
 
     def distill(self, cands: list[Candidate]) -> list[Candidate]:
         size = len(cands)
         # std::sort with snr-greater comparator; stable for determinism
         cands = sorted(cands, key=lambda c: -c.snr)
+        self.setup(cands)
         unique = np.ones(size, dtype=bool)
         for idx in range(size):
-            if unique[idx]:
-                self.condition(cands, idx, unique)
+            if not unique[idx]:
+                continue
+            hit = np.nonzero(self.matches(idx))[0] + idx + 1
+            if self.keep_related:
+                for ii in hit:
+                    cands[idx].append(cands[ii])
+            unique[hit] = False
         return [cands[i] for i in range(size) if unique[i]]
 
 
@@ -51,29 +67,31 @@ class HarmonicDistiller(BaseDistiller):
         self.max_harm = int(max_harm)
         self.fractional_harms = fractional_harms
 
-    def condition(self, cands, idx, unique):
-        fundi_freq = cands[idx].freq
-        upper = 1 + self.tolerance
-        lower = 1 - self.tolerance
-        # like the reference, already-absorbed candidates are still
-        # tested (and may be appended to this fundamental's assoc too)
-        for ii in range(idx + 1, len(cands)):
-            freq = cands[ii].freq
-            nh = cands[ii].nh
-            max_denominator = int(2.0 ** nh) if self.fractional_harms else 1
-            matched = False
-            for jj in range(1, self.max_harm + 1):
-                for kk in range(1, max_denominator + 1):
-                    ratio = kk * freq / (jj * fundi_freq)
-                    if lower < ratio < upper:
-                        matched = True
-                        break
-                if matched:
-                    break
-            if matched:
-                if self.keep_related:
-                    cands[idx].append(cands[ii])
-                unique[ii] = False
+    def setup(self, cands):
+        super().setup(cands)
+        if self.fractional_harms:
+            self.max_denoms = np.array(
+                [int(2.0 ** c.nh) for c in cands], np.int64
+            )
+            kmax = int(self.max_denoms.max(initial=1))
+        else:
+            self.max_denoms = np.ones(len(cands), np.int64)
+            kmax = 1
+        self.jj = np.arange(1, self.max_harm + 1, dtype=np.float64)
+        self.kk = np.arange(1, kmax + 1, dtype=np.float64)
+
+    def matches(self, idx):
+        fundi_freq = self.freqs[idx]
+        freqs = self.freqs[idx + 1 :]
+        # ratio[i, k, j] = kk[k] * f_i / (jj[j] * f0)
+        ratio = (
+            self.kk[None, :, None]
+            * freqs[:, None, None]
+            / (self.jj[None, None, :] * fundi_freq)
+        )
+        ok = (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
+        ok &= self.kk[None, :, None] <= self.max_denoms[idx + 1 :, None, None]
+        return ok.any(axis=(1, 2))
 
 
 class AccelerationDistiller(BaseDistiller):
@@ -83,24 +101,19 @@ class AccelerationDistiller(BaseDistiller):
         self.tobs_over_c = tobs / SPEED_OF_LIGHT
         self.tolerance = tolerance
 
-    def correct_for_acceleration(self, freq, delta_acc):
-        return freq + delta_acc * freq * self.tobs_over_c
+    def setup(self, cands):
+        super().setup(cands)
+        self.accs = np.array([c.acc for c in cands], np.float64)
 
-    def condition(self, cands, idx, unique):
-        fundi_freq = cands[idx].freq
-        fundi_acc = cands[idx].acc
+    def matches(self, idx):
+        fundi_freq = self.freqs[idx]
+        freqs = self.freqs[idx + 1 :]
+        delta_acc = self.accs[idx] - self.accs[idx + 1 :]
+        acc_freq = fundi_freq + delta_acc * fundi_freq * self.tobs_over_c
         edge = fundi_freq * self.tolerance
-        for ii in range(idx + 1, len(cands)):
-            delta_acc = fundi_acc - cands[ii].acc
-            acc_freq = self.correct_for_acceleration(fundi_freq, delta_acc)
-            if acc_freq > fundi_freq:
-                hit = fundi_freq - edge < cands[ii].freq < acc_freq + edge
-            else:
-                hit = acc_freq - edge < cands[ii].freq < fundi_freq + edge
-            if hit:
-                if self.keep_related:
-                    cands[idx].append(cands[ii])
-                unique[ii] = False
+        lo = np.minimum(acc_freq, fundi_freq) - edge
+        hi = np.maximum(acc_freq, fundi_freq) + edge
+        return (freqs > lo) & (freqs < hi)
 
 
 class DMDistiller(BaseDistiller):
@@ -108,13 +121,6 @@ class DMDistiller(BaseDistiller):
         super().__init__(keep_related)
         self.tolerance = tolerance
 
-    def condition(self, cands, idx, unique):
-        fundi_freq = cands[idx].freq
-        upper = 1 + self.tolerance
-        lower = 1 - self.tolerance
-        for ii in range(idx + 1, len(cands)):
-            ratio = cands[ii].freq / fundi_freq
-            if lower < ratio < upper:
-                if self.keep_related:
-                    cands[idx].append(cands[ii])
-                unique[ii] = False
+    def matches(self, idx):
+        ratio = self.freqs[idx + 1 :] / self.freqs[idx]
+        return (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)

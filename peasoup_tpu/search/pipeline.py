@@ -49,7 +49,11 @@ from ..ops import (
     zap_birdies,
     deredden,
 )
-from ..ops.fold import fold_time_series, optimise_fold
+from ..ops.fold import (
+    finalise_fold,
+    fold_time_series_core,
+    optimise_device,
+)
 from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
 from .plan import AccelerationPlan, SearchConfig, prev_power_of_two
 from .score import CandidateScorer
@@ -225,16 +229,26 @@ class PulsarSearch:
 
     def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts):
         """Turn per-(accel, spectrum) peak buffers into distilled per-DM
-        candidates: harmonic distillation within each accel trial
-        (`pipeline_multi.cu:238`), acceleration distillation across them
-        (`pipeline_multi.cu:243`)."""
+        candidates."""
+        groups = [
+            self._peaks_to_candidates(
+                idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc)
+            )
+            for j, acc in enumerate(acc_list)
+        ]
+        return self._distill_accel_groups(groups)
+
+    def _distill_accel_groups(
+        self, groups: list[list[Candidate]]
+    ) -> list[Candidate]:
+        """Per-DM distillation tail shared by the host-loop and mesh
+        paths: harmonic distillation within each accel trial
+        (`pipeline_multi.cu:238`), acceleration distillation across
+        them (`pipeline_multi.cu:243`)."""
         cfg = self.config
         harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, False)
         accel_trial_cands: list[Candidate] = []
-        for j, acc in enumerate(acc_list):
-            cands = self._peaks_to_candidates(
-                idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc)
-            )
+        for cands in groups:
             accel_trial_cands.extend(harm_still.distill(cands))
         acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
         return acc_still.distill(accel_trial_cands)
@@ -315,8 +329,7 @@ class PulsarSearch:
 # folding (MultiFolder equivalent, folder.hpp:337-442)
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("bin_width",))
-def _rewhiten_for_fold(tim, bin_width):
+def _rewhiten_core(tim, bin_width):
     """The fold path re-whitens without zapping or interbinning
     (`folder.hpp:382-389`)."""
     fseries = jnp.fft.rfft(tim.astype(jnp.float32)).astype(jnp.complex64)
@@ -324,6 +337,39 @@ def _rewhiten_for_fold(tim, bin_width):
     median = running_median(pspec, bin_width)
     fseries = deredden(fseries, median)
     return jnp.fft.irfft(fseries, n=tim.shape[0]).astype(jnp.float32)
+
+
+_rewhiten_for_fold = jax.jit(_rewhiten_core, static_argnames=("bin_width",))
+
+
+@partial(
+    jax.jit,
+    static_argnames=("bin_width", "fold_nsamps", "tsamp", "nbins", "nints"),
+)
+def _batched_fold_program(
+    trials, dm_idxs, accs, periods, bin_width, fold_nsamps, tsamp, nbins,
+    nints,
+):
+    """Re-whiten + resample + fold + optimise every candidate in ONE
+    dispatch (vmapped); ships home only the optimum per candidate.
+
+    The reference re-whitens once per distinct DM trial
+    (`folder.hpp:376-389`); here each candidate redundantly re-whitens
+    its row — identical numerics, and a few duplicate FFTs are far
+    cheaper than per-candidate program dispatches on a remote TPU.
+    """
+
+    def one(dm_idx, acc, period):
+        # the caller guarantees fold_nsamps <= trials.shape[1]
+        tim = jax.lax.dynamic_slice(
+            trials, (dm_idx, jnp.int32(0)), (1, fold_nsamps)
+        ).reshape(-1)
+        tim_w = _rewhiten_core(tim, bin_width)
+        tim_r = resample(tim_w, acc, tsamp)
+        subints = fold_time_series_core(tim_r, period, tsamp, nbins, nints)
+        return optimise_device(subints)
+
+    return jax.vmap(one)(dm_idxs, accs, periods)
 
 
 def fold_candidates(
@@ -341,34 +387,45 @@ def fold_candidates(
 ) -> None:
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
     by max(snr, folded_snr) (`folder.hpp:424-434,25-31`)."""
-    nsamps = prev_power_of_two(trials_nsamps)
+    # clamp to the columns actually present: the fused mesh path hands
+    # over fft-size-truncated trials, and folding must never read its
+    # mean-padding (or zero-pad) instead of real samples
+    nsamps = min(prev_power_of_two(trials_nsamps), trials.shape[1])
     tobs = nsamps * tsamp
     bin_width = 1.0 / tobs
-    dm_to_cands: dict[int, list[int]] = {}
-    for ii in range(min(npdmp, len(cands))):
-        p = 1.0 / cands[ii].freq
-        if min_period < p < max_period:
-            dm_to_cands.setdefault(cands[ii].dm_idx, []).append(ii)
-    for dm_idx, cand_ids in dm_to_cands.items():
-        tim = jax.lax.dynamic_slice(
-            trials, (dm_idx, 0), (1, min(nsamps, trials.shape[1]))
-        ).reshape(-1)
-        if tim.shape[0] < nsamps:
-            tim = jnp.pad(tim, (0, nsamps - tim.shape[0]))
-        tim_w = _rewhiten_for_fold(tim, bin_width)
-        for ci in cand_ids:
-            cand = cands[ci]
-            period = 1.0 / cand.freq
-            tim_r = resample(tim_w, cand.acc, tsamp)
-            subints = np.asarray(
-                fold_time_series(tim_r, period, tsamp, nbins, nints)
-            )
-            opt = optimise_fold(subints, period, tobs)
-            cand.folded_snr = opt.opt_sn
-            cand.fold = opt.opt_fold
-            cand.nbins = nbins
-            cand.nints = nints
-            cand.opt_period = opt.opt_period
+    fold_ids = [
+        ii
+        for ii in range(min(npdmp, len(cands)))
+        if min_period < 1.0 / cands[ii].freq < max_period
+    ]
+    if not fold_ids:
+        cands.sort(key=lambda c: -max(c.snr, c.folded_snr))
+        return
+    dm_idxs = jnp.asarray([cands[i].dm_idx for i in fold_ids], jnp.int32)
+    accs = jnp.asarray([cands[i].acc for i in fold_ids], jnp.float32)
+    # f32: x64 is disabled on TPU and the relative phase error over a
+    # 2^17-sample fold (~1e-7) is far below one phase bin
+    periods = jnp.asarray(
+        [1.0 / cands[i].freq for i in fold_ids], jnp.float32
+    )
+    argmaxes, opt_folds, opt_profs = _batched_fold_program(
+        trials, dm_idxs, accs, periods, bin_width, nsamps, float(tsamp),
+        nbins, nints,
+    )
+    argmaxes = np.asarray(argmaxes)
+    opt_folds = np.asarray(opt_folds)
+    opt_profs = np.asarray(opt_profs)
+    for k, ci in enumerate(fold_ids):
+        cand = cands[ci]
+        period = 1.0 / cand.freq
+        opt = finalise_fold(
+            int(argmaxes[k]), opt_profs[k], opt_folds[k], period, tobs
+        )
+        cand.folded_snr = opt.opt_sn
+        cand.fold = opt.opt_fold
+        cand.nbins = nbins
+        cand.nints = nints
+        cand.opt_period = opt.opt_period
     cands.sort(key=lambda c: -max(c.snr, c.folded_snr))
 
 

@@ -50,6 +50,7 @@ class SearchConfig:
     # TPU-build extras (no reference equivalent)
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step
+    compact_capacity: int = 131072  # per-shard compacted peak buffer (fused)
     infilename: str = ""
 
 

@@ -32,6 +32,29 @@ def test_mesh_search_matches_single_device(tutorial_fil):
         assert a.count_assoc() == b.count_assoc()
 
 
+def test_mesh_search_accepts_float32_filterbank(tmp_path):
+    """The fused program's pack/unpack path must pass 32-bit (float)
+    filterbanks straight through."""
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(0)
+    hdr = SigprocHeader(nbits=32, nchans=16, tsamp=0.000256, fch1=1510.0,
+                        foff=-10.0, nsamples=4096)
+    data = rng.normal(size=(4096, 16)).astype(np.float32)
+    path = str(tmp_path / "f32.fil")
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    fil = read_filterbank(path)
+    cfg = SearchConfig(dm_start=0.0, dm_end=20.0, npdmp=0, min_snr=6.0)
+    single = PulsarSearch(fil, cfg).run()
+    mesh = MeshPulsarSearch(fil, cfg).run()
+    assert len(single.candidates) == len(mesh.candidates)
+    for a, b in zip(single.candidates, mesh.candidates):
+        assert a.freq == pytest.approx(b.freq, rel=1e-6)
+        assert a.snr == pytest.approx(b.snr, rel=1e-5)
+
+
 def test_sharded_dedispersion_matches(tutorial_fil):
     fil = read_filterbank(tutorial_fil)
     cfg = SearchConfig(dm_start=0.0, dm_end=30.0)
