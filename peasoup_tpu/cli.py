@@ -58,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
     # TPU-build extras
     p.add_argument("--peak_capacity", type=int, default=1024)
     p.add_argument("--accel_chunk", type=int, default=16)
+    p.add_argument("--compact_capacity", type=int, default=131072,
+                   help="per-shard compacted peak buffer (fused search)")
     p.add_argument("--single_device", action="store_true",
                    help="disable mesh sharding even with multiple devices")
     return p
@@ -97,6 +99,11 @@ def write_search_output(result, outdir: str) -> None:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch: `peasoup-tpu coincidencer <filterbanks...>`
+    if argv and argv[0] == "coincidencer":
+        return coincidencer_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 
@@ -135,6 +142,43 @@ def main(argv=None) -> int:
     if args.verbose:
         print(f"Wrote {len(result.candidates)} candidates to {cfg.outdir}",
               file=sys.stderr)
+    return 0
+
+
+def coincidencer_main(argv=None) -> int:
+    """Multibeam RFI coincidencer CLI (`src/coincidencer.cpp:46-120`)."""
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-coincidencer",
+        description="Peasoup-TPU - multibeam RFI coincidencer",
+    )
+    p.add_argument("filterbanks", nargs="+", help="File names")
+    p.add_argument("--o", dest="samp_outfilename", default="rfi.eb_mask",
+                   help="Sample mask output filename")
+    p.add_argument("--o2", dest="spec_outfilename", default="birdies.txt",
+                   help="Birdie list output filename")
+    p.add_argument("-l", "--boundary_5_freq", type=float, default=0.05)
+    p.add_argument("-a", "--boundary_25_freq", type=float, default=0.5)
+    p.add_argument("--thresh", type=float, default=4.0,
+                   help="S/N threshold for coincidence matching")
+    p.add_argument("--beam_thresh", type=int, default=4,
+                   help="number of beams a candidate must appear in")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    from .search.coincidence import CoincidencerConfig, run_coincidencer
+
+    cfg = CoincidencerConfig(
+        samp_outfilename=args.samp_outfilename,
+        spec_outfilename=args.spec_outfilename,
+        boundary_5_freq=args.boundary_5_freq,
+        boundary_25_freq=args.boundary_25_freq,
+        threshold=args.thresh,
+        beam_threshold=args.beam_thresh,
+        verbose=args.verbose,
+    )
+    run_coincidencer(args.filterbanks, cfg)
+    if args.verbose:
+        print(f"Wrote {cfg.samp_outfilename} and {cfg.spec_outfilename}")
     return 0
 
 

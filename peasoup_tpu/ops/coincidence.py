@@ -1,0 +1,67 @@
+"""Multibeam coincidence masking.
+
+Reference semantics: `src/kernels.cu:1073-1100` (per-bin count of beams
+whose value exceeds ``thresh``; mask bin = 1 if count < beam_thresh,
+else 0) and `include/transforms/coincidencer.hpp:42-78` (sample-mask
+and birdie-list writers).  The per-bin beam loop becomes a batched
+reduction over the beam axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def coincidence_mask(arrays: jnp.ndarray, thresh, beam_thresh) -> jnp.ndarray:
+    """0/1 mask over bins: 0 where >= ``beam_thresh`` beams exceed
+    ``thresh`` (multibeam RFI), 1 elsewhere.
+
+    Args:
+        arrays: (nbeams, size) float32.
+    """
+    count = jnp.sum(arrays > thresh, axis=0)
+    return (count < beam_thresh).astype(jnp.float32)
+
+
+def birdie_list_from_mask(mask: np.ndarray, bin_width: float) -> np.ndarray:
+    """Collapse zero-runs of a spectral mask into (freq, width) birdies.
+
+    Matches `coincidencer.hpp:53-72`: a run of ``count`` zeroed bins
+    ending (exclusive) at ``end`` becomes freq = ((end-1) - count/2) *
+    bin_width, width = count * bin_width.  (The reference's inner scan
+    reads one element past the array when a run touches the end —
+    REFERENCE-QUIRK(coincidencer.hpp:64-67) — we stop at the boundary.)
+
+    Returns an (nbirdies, 2) float array.
+    """
+    mask = np.asarray(mask)
+    zero = mask == 0
+    if not zero.any():
+        return np.zeros((0, 2), np.float64)
+    # run-length encode the zero regions
+    padded = np.diff(np.concatenate([[0], zero.view(np.int8), [0]]))
+    starts = np.nonzero(padded == 1)[0]
+    ends = np.nonzero(padded == -1)[0]  # exclusive
+    counts = ends - starts
+    freqs = ((ends - 1) - counts / 2.0) * bin_width
+    widths = counts * bin_width
+    return np.stack([freqs, widths], axis=1)
+
+
+def write_samp_mask(mask: np.ndarray, filename: str) -> None:
+    """One 0/1 line per sample, '#0 1' header (`coincidencer.hpp:42-51`)."""
+    with open(filename, "w") as f:
+        f.write("#0 1\n")
+        for v in np.asarray(mask):
+            f.write(f"{int(v)}\n")
+
+
+def write_birdie_list(
+    mask: np.ndarray, bin_width: float, filename: str
+) -> None:
+    """'freq<TAB>width' per birdie (`coincidencer.hpp:73-77`)."""
+    birdies = birdie_list_from_mask(mask, bin_width)
+    with open(filename, "w") as f:
+        for freq, width in birdies:
+            f.write(f"{freq:.9f}\t{width:.6f}\n")

@@ -40,11 +40,8 @@ from ..search.pipeline import (
     SearchResult,
     search_one_accel,
     whiten_core,
-    fold_candidates,
 )
-from ..search.distill import DMDistiller, HarmonicDistiller
 from ..search.plan import SearchConfig
-from ..search.score import CandidateScorer
 from ..data.candidates import Candidate, CandidateCollection
 from ..io.unpack import pack_bits
 from ..ops.peaks import identify_unique_peaks
@@ -162,8 +159,6 @@ def build_fused_search(
     `src/pipeline_multi.cu:145-244`), so the TPU-native design moves the
     whole search into one dispatch and ships home only:
 
-    * ``sel_pos``  (compact_k,) int32 — flat position tags (encode
-      dm_local, accel trial, harmonic level, slot)
     * ``sel_bin``  (compact_k,) int32 — spectrum bin indices
     * ``sel_snr``  (compact_k,) f32   — SNR values
     * ``nvalid``   (1,) int32 — true total peak count (overflow check)
@@ -430,34 +425,4 @@ class MeshPulsarSearch(PulsarSearch):
                 )
             )
         timers["searching"] = time.time() - t0
-
-        dm_still = DMDistiller(cfg.freq_tol, True)
-        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
-        cands = dm_still.distill(dm_cands.cands)
-        cands = harm_still.distill(cands)
-
-        hdr = self.fil.header
-        scorer = CandidateScorer(
-            hdr.tsamp, hdr.cfreq, hdr.foff, abs(hdr.foff) * self.fil.nchans
-        )
-        scorer.score_all(cands)
-
-        t0 = time.time()
-        if cfg.npdmp > 0:
-            fold_candidates(
-                cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
-                boundary_5_freq=cfg.boundary_5_freq,
-                boundary_25_freq=cfg.boundary_25_freq,
-            )
-        timers["folding"] = time.time() - t0
-
-        cands = cands[: cfg.limit]
-        timers["total"] = time.time() - t_total
-        return SearchResult(
-            candidates=CandidateCollection(cands),
-            dm_list=self.dm_list,
-            acc_list_dm0=self.acc_plan.generate_accel_list(0.0),
-            timers=timers,
-            config=cfg,
-            header=hdr,
-        )
+        return self._finalise(dm_cands, trials, timers, t_total)

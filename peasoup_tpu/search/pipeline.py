@@ -292,7 +292,12 @@ class PulsarSearch:
         for ii in range(len(self.dm_list)):
             dm_cands.append(self.search_dm_trial(trials, ii))
         timers["searching"] = time.time() - t0
+        return self._finalise(dm_cands, trials, timers, t_total)
 
+    def _finalise(self, dm_cands, trials, timers, t_total) -> SearchResult:
+        """Shared tail of every driver (`pipeline_multi.cu:362-391`):
+        cross-DM distillation, scoring, folding, limit, result."""
+        cfg = self.config
         dm_still = DMDistiller(cfg.freq_tol, True)
         harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
         cands = dm_still.distill(dm_cands.cands)
@@ -303,6 +308,8 @@ class PulsarSearch:
             hdr.tsamp, hdr.cfreq, hdr.foff, abs(hdr.foff) * self.fil.nchans
         )
         scorer.score_all(cands)
+
+        import time
 
         t0 = time.time()
         if cfg.npdmp > 0:
@@ -387,10 +394,19 @@ def fold_candidates(
 ) -> None:
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
     by max(snr, folded_snr) (`folder.hpp:424-434,25-31`)."""
-    # clamp to the columns actually present: the fused mesh path hands
-    # over fft-size-truncated trials, and folding must never read its
-    # mean-padding (or zero-pad) instead of real samples
-    nsamps = min(prev_power_of_two(trials_nsamps), trials.shape[1])
+    # both drivers hand over trials with >= prev_power_of_two(
+    # trials_nsamps) real columns, so this clamp is a guard only; if a
+    # future caller passes narrower trials the fold FFT length would
+    # silently stop being the reference's power of two — hence the check
+    nsamps = prev_power_of_two(trials_nsamps)
+    if nsamps > trials.shape[1]:
+        import warnings
+
+        warnings.warn(
+            f"trials narrower than the fold length ({trials.shape[1]} < "
+            f"{nsamps}); folding on a non-reference FFT length"
+        )
+        nsamps = trials.shape[1]
     tobs = nsamps * tsamp
     bin_width = 1.0 / tobs
     fold_ids = [

@@ -1,0 +1,30 @@
+"""Post-processing tools (python3 equivalents of the reference's
+``tools/`` directory: peasoup_tools.py, peasoup_as_text.py,
+peasoup_plot_cand.py)."""
+
+from .postprocess import (
+    JoinedCandidate,
+    PeasoupOutput,
+    as_text,
+    as_text_main,
+    radec_to_str,
+)
+
+__all__ = [
+    "JoinedCandidate",
+    "PeasoupOutput",
+    "as_text",
+    "as_text_main",
+    "radec_to_str",
+    "CandidatePlotter",
+    "plot_cand_main",
+]
+
+
+def __getattr__(name):
+    # lazy: plotting pulls in matplotlib
+    if name in ("CandidatePlotter", "plot_cand_main"):
+        from . import plot_cand
+
+        return getattr(plot_cand, name)
+    raise AttributeError(name)

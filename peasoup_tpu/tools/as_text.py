@@ -1,0 +1,7 @@
+"""CLI runner: dump an overview.xml as a text table
+(`tools/peasoup_as_text.py`)."""
+
+from .postprocess import as_text_main
+
+if __name__ == "__main__":
+    raise SystemExit(as_text_main())

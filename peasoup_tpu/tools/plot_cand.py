@@ -1,0 +1,186 @@
+"""Candidate diagnostic plot (`tools/peasoup_tools.py:167-383`).
+
+One page per candidate: folded profile, sub-integration waterfall and
+per-subint statistics, a parameter table, DM/S-N and acceleration/S-N
+scatter of the candidate's associated hits, a DM-acceleration map, and
+a period-DM overview of all hits.  Matplotlib is imported lazily so the
+search pipeline has no hard plotting dependency.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .postprocess import JoinedCandidate, PeasoupOutput, radec_to_str
+
+_HARM_COLORS = ["darkblue", "lightblue", "green", "orange", "darkred"]
+
+
+class CandidatePlotter:
+    def __init__(self, output: PeasoupOutput):
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        self._plt = plt
+        self.output = output
+        self.fig = plt.figure(figsize=[14, 12])
+        grid = [5, 9]
+        self.prof_ax = plt.subplot2grid(grid, [0, 1], colspan=2)
+        self.fold_ax = plt.subplot2grid(grid, [1, 1], colspan=2, rowspan=2,
+                                        sharex=self.prof_ax)
+        self.subs_ax = plt.subplot2grid(grid, [1, 0], rowspan=2,
+                                        sharey=self.fold_ax)
+        self.table_ax = plt.subplot2grid(grid, [0, 3], colspan=3, rowspan=3,
+                                         frameon=False)
+        self.dm_ax = plt.subplot2grid(grid, [0, 6], colspan=2)
+        self.acc_ax = plt.subplot2grid(grid, [1, 8], rowspan=2)
+        self.dm_acc_ax = plt.subplot2grid(grid, [1, 6], colspan=2, rowspan=2,
+                                          sharex=self.dm_ax,
+                                          sharey=self.acc_ax)
+        self.all_ax = plt.subplot2grid([6, 9], [4, 0], colspan=9, rowspan=3)
+
+    # -- panels ------------------------------------------------------------
+
+    def _plot_profile(self, ax, fold):
+        ax.plot(fold.sum(axis=0))
+        ax.set_ylabel("Flux")
+        ax.set_title("Profile")
+        ax.tick_params(labelbottom=False, labelleft=False)
+
+    def _plot_subints(self, ax, fold):
+        ax.imshow(fold, aspect="auto", interpolation="nearest")
+        ax.set_xlim(-0.5, fold.shape[1] - 0.5)
+        ax.set_xlabel("Phase bin")
+        ax.tick_params(labelleft=False)
+
+    def _plot_subint_stats(self, ax, fold):
+        y = np.arange(fold.shape[0])
+        mean = fold.mean(axis=1)
+        std = fold.std(axis=1)
+        ax.fill_betweenx(y, mean - 3 * std, mean + 3 * std, alpha=0.5,
+                         color="lightblue", label="+-3 sigma")
+        ax.plot(mean, y, lw=2, alpha=0.8, color="lightblue", label="mean")
+        ax.plot(fold.min(axis=1), y, lw=2, c="darkblue", label="min")
+        ax.plot(fold.max(axis=1), y, lw=2, c="darkred", label="max")
+        ax.legend(loc="lower left", bbox_to_anchor=(-0.2, 1.0),
+                  prop={"size": 10})
+        m1, m2 = ax.get_xlim()
+        ax.set_xlim(m2, m1)
+        ax.set_ylim(-0.5, fold.shape[0] - 0.5)
+        ax.tick_params(labelbottom=False)
+        ax.set_ylabel("Subintegration")
+
+    def _fill_table(self, ax, cand: JoinedCandidate):
+        ax.xaxis.set_visible(False)
+        ax.yaxis.set_visible(False)
+        hdr = self.output.overview.section("header_parameters")
+        s = cand.stats
+        rows = [
+            ("R.A.", radec_to_str(float(hdr.get("src_raj", 0.0)))),
+            ("Decl.", radec_to_str(float(hdr.get("src_dej", 0.0)))),
+            ("P0", "%.9f" % s["period"]),
+            ("Opt P0", "%.9f" % s["opt_period"]),
+            ("DM", "%.2f" % s["dm"]),
+            ("Acc", "%.2f" % s["acc"]),
+            ("Harmonic", "%d" % s["nh"]),
+            ("Spec S/N", "%.1f" % s["snr"]),
+            ("Fold S/N", "%.1f" % s["folded_snr"]),
+            ("Adjacent?", str(bool(s["is_adjacent"]))),
+            ("Physical?", str(bool(s["is_physical"]))),
+            ("DDM ratio 1", "%.3f" % s["ddm_count_ratio"]),
+            ("DDM ratio 2", "%.3f" % s["ddm_snr_ratio"]),
+            ("Nassoc", "%d" % s["nassoc"]),
+        ]
+        tab = ax.table(cellText=rows, cellLoc="left", colLoc="left",
+                       loc="center")
+        tab.scale(1.0, 2.0)
+
+    def _by_harmonic(self, ax, hits, xfield, yfield):
+        for ii, harm in enumerate(np.unique(hits["nh"])):
+            sub = hits[hits["nh"] == harm]
+            ax.scatter(sub[xfield], sub[yfield], edgecolor="none",
+                       facecolor=_HARM_COLORS[int(ii) % len(_HARM_COLORS)],
+                       label="Harm. %d" % harm)
+
+    def _plot_dm_scatter(self, ax, hits):
+        self._by_harmonic(ax, hits, "dm", "snr")
+        ax.yaxis.tick_right()
+        ax.yaxis.set_label_position("right")
+        ax.set_ylabel("S/N", rotation=-90)
+        ax.tick_params(labelbottom=False)
+
+    def _plot_acc_scatter(self, ax, hits):
+        self._by_harmonic(ax, hits, "snr", "acc")
+        ax.yaxis.tick_right()
+        ax.yaxis.set_label_position("right")
+        ax.set_ylabel("Acceleration (m/s/s)", rotation=-90)
+        ax.set_xlabel("S/N")
+        ax.legend(loc="lower left", bbox_to_anchor=(0.2, 1.0),
+                  prop={"size": 10})
+
+    def _plot_acc_dm_map(self, ax, hits):
+        snrs = hits["snr"].astype(float).copy()
+        ptp = snrs.max() - snrs.min()
+        sizes = 5 + 250 * (snrs - snrs.min()) / (ptp if ptp else 1.0)
+        for ii, harm in enumerate(np.unique(hits["nh"])):
+            m = hits["nh"] == harm
+            ax.scatter(hits["dm"][m], hits["acc"][m],
+                       facecolor=_HARM_COLORS[int(ii) % len(_HARM_COLORS)],
+                       edgecolor="none", s=sizes[m])
+        ax.tick_params(labelleft=False)
+        ax.set_xlabel("DM (pc cm^-3)")
+
+    def _plot_all_hits(self, ax, hits, period, dm):
+        ax.set_xscale("log")
+        ax.scatter(1.0 / hits["freq"], hits["dm"], s=hits["snr"])
+        ax.axvline(period, color="grey", lw=0.5)
+        ax.axhline(dm, color="grey", lw=0.5)
+        ax.set_xlabel("Period (s)")
+        ax.set_ylabel("DM (pc cm^-3)")
+
+    # -- page --------------------------------------------------------------
+
+    def plot_cand(self, idx: int, filename: str | None = None):
+        cand = self.output.get_candidate(idx)
+        hits = np.sort(cand.hits, order="snr")[::-1]
+        fold = cand.fold
+        if fold is not None:
+            fold = fold - fold.min()
+            peak = fold.max()
+            if peak:
+                fold = fold / peak
+            self._plot_profile(self.prof_ax, fold)
+            self._plot_subints(self.fold_ax, fold)
+            self._plot_subint_stats(self.subs_ax, fold)
+        self._fill_table(self.table_ax, cand)
+        if len(hits):
+            self._plot_dm_scatter(self.dm_ax, hits)
+            self._plot_acc_scatter(self.acc_ax, hits)
+            self._plot_acc_dm_map(self.dm_acc_ax, hits)
+            self._plot_all_hits(
+                self.all_ax, hits, cand.stats["period"], cand.stats["dm"]
+            )
+        if filename is not None:
+            self.fig.savefig(filename)
+        return self.fig
+
+
+def plot_cand_main(argv=None) -> int:
+    import sys
+
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) < 2:
+        print("usage: peasoup-tpu-plot-cand <overview.xml> <cand_id> [out.png]")
+        return 1
+    out = PeasoupOutput(args[0])
+    plotter = CandidatePlotter(out)
+    filename = args[2] if len(args) > 2 else f"Cand{int(args[1]):04d}.png"
+    plotter.plot_cand(int(args[1]), filename)
+    print(f"Wrote {filename}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(plot_cand_main())

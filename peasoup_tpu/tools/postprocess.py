@@ -1,0 +1,101 @@
+"""Joined XML+binary candidate access and predictor text.
+
+Modern (python3, stdlib+numpy) equivalents of the reference's
+post-processing helpers `tools/peasoup_tools.py:14-43,153-164`:
+``PeasoupOutput`` joins a candidate's ``overview.xml`` record with its
+fold/hits block in ``candidates.peasoup`` via the XML ``byte_offset``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..output.binary import CandidateFileParser
+from ..output.parsers import OverviewFile
+
+
+def radec_to_str(val: float) -> str:
+    """SIGPROC packed ddmmss.s / hhmmss.s float -> 'dd:mm:ss.ssss'
+    (`peasoup_tools.py:14-24`)."""
+    sign = -1 if val < 0 else 1
+    fractional, integral = np.modf(abs(val))
+    xx = (integral - (integral % 10000)) / 10000
+    yy = ((integral - (integral % 100)) / 100) - xx * 100
+    zz = integral - 100 * yy - 10000 * xx + fractional
+    return "%02d:%02d:%07.4f" % (sign * xx, yy, zz)
+
+
+@dataclass
+class JoinedCandidate:
+    """One candidate with its XML stats, fold array, and hit list."""
+
+    stats: dict
+    fold: np.ndarray | None
+    hits: np.ndarray = field(default_factory=lambda: np.zeros(0))
+
+    def __getattr__(self, name):
+        try:
+            return self.stats[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+class PeasoupOutput:
+    """Join overview.xml and candidates.peasoup
+    (`peasoup_tools.py:35-43`)."""
+
+    def __init__(self, overview_file: str, candidate_file: str | None = None):
+        if candidate_file is None:
+            candidate_file = os.path.join(
+                os.path.dirname(overview_file), "candidates.peasoup"
+            )
+        self.overview = OverviewFile(overview_file)
+        self._cand_file = candidate_file
+
+    @property
+    def ncands(self) -> int:
+        return self.overview.ncands
+
+    def get_candidate(self, idx: int) -> JoinedCandidate:
+        stats = self.overview.get_candidate(idx)
+        with CandidateFileParser(self._cand_file) as parser:
+            fold, hits = parser.cand_from_offset(int(stats["byte_offset"]))
+        return JoinedCandidate(stats=stats, fold=fold, hits=hits)
+
+    def make_predictor(self, idx: int) -> str:
+        """TEMPO-style predictor text (`peasoup_tools.py:153-164`)."""
+        stats = self.overview.get_candidate(idx)
+        hdr = self.overview.section("header_parameters")
+        return "\n".join((
+            "SOURCE: %s" % hdr.get("source_name", "unknown"),
+            "PERIOD: %.15f" % stats["period"],
+            "DM: %.3f" % stats["dm"],
+            "ACC: %.3f" % stats["acc"],
+            "RA: %s" % radec_to_str(float(hdr.get("src_raj", 0.0))),
+            "DEC: %s" % radec_to_str(float(hdr.get("src_dej", 0.0))),
+        ))
+
+
+def as_text(overview_file: str, sort_by: str = "period") -> str:
+    """Plain-text candidate table (`tools/peasoup_as_text.py`)."""
+    ar = OverviewFile(overview_file).as_array()
+    lines = ["    ".join(ar.dtype.names)]
+    order = np.argsort(ar[sort_by])
+    for row in ar[order]:
+        lines.append("    ".join(str(v) for v in row))
+    return "\n".join(lines)
+
+
+def as_text_main(argv=None) -> int:
+    import sys
+
+    args = argv if argv is not None else sys.argv[1:]
+    if not args:
+        print("usage: peasoup-tpu-as-text <overview.xml> [sort_field]")
+        return 1
+    sort_by = args[1] if len(args) > 1 else "period"
+    print(as_text(args[0], sort_by))
+    return 0

@@ -1,0 +1,151 @@
+"""Multibeam coincidencer tests (reference: `src/coincidencer.cpp`,
+`include/transforms/coincidencer.hpp`, `src/kernels.cu:1073-1100`)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from peasoup_tpu.io.sigproc import Filterbank, SigprocHeader, write_filterbank
+from peasoup_tpu.ops.coincidence import (
+    birdie_list_from_mask,
+    coincidence_mask,
+    write_birdie_list,
+    write_samp_mask,
+)
+from peasoup_tpu.search.coincidence import (
+    CoincidencerConfig,
+    run_coincidencer,
+)
+
+
+def _reference_birdie_walk(mask, bin_width):
+    """Direct port of the reference's run-length scan
+    (`coincidencer.hpp:59-72`), bounds-checked."""
+    out = []
+    ii = 0
+    size = len(mask)
+    while ii < size:
+        if mask[ii] == 0:
+            count = 0
+            while ii < size and mask[ii] == 0:
+                count += 1
+                ii += 1
+            out.append((((ii - 1) - count / 2.0) * bin_width,
+                        count * bin_width))
+        else:
+            ii += 1
+    return np.array(out).reshape(-1, 2)
+
+
+def test_coincidence_mask_counts_beams():
+    arrays = jnp.asarray(np.array([
+        [5.0, 1.0, 5.0, 5.0],
+        [5.0, 5.0, 1.0, 5.0],
+        [5.0, 1.0, 5.0, 1.0],
+    ], np.float32))
+    # thresh 4, beam_thresh 2: bin is masked (0) when >=2 beams exceed
+    mask = np.asarray(coincidence_mask(arrays, 4.0, 2))
+    np.testing.assert_array_equal(mask, [0.0, 1.0, 0.0, 0.0])
+
+
+@pytest.mark.parametrize("mask", [
+    np.array([1, 1, 0, 0, 0, 1, 1, 0, 1], np.float32),
+    np.array([0, 0, 1, 1], np.float32),
+    np.array([1, 1, 1], np.float32),
+    np.array([0, 0, 0], np.float32),
+    np.array([1, 0], np.float32),
+])
+def test_birdie_list_matches_reference_walk(mask):
+    got = birdie_list_from_mask(mask, 0.125)
+    want = _reference_birdie_walk(mask, 0.125)
+    np.testing.assert_allclose(got, want)
+
+
+def _make_beam(rng, nsamps, nchans, tsamp, signal=None, spikes=None):
+    data = rng.normal(96.0, 10.0, size=(nsamps, nchans))
+    t = np.arange(nsamps) * tsamp
+    if signal is not None:
+        freq, amp = signal
+        data += amp * np.sin(2 * np.pi * freq * t)[:, None]
+    if spikes is not None:
+        data[spikes] += 120.0
+    return np.clip(data, 0, 255).astype(np.uint8)
+
+
+def test_coincidencer_end_to_end(tmp_path):
+    rng = np.random.default_rng(42)
+    nsamps, nchans, tsamp = 4096, 8, 0.000512
+    nbeams = 6
+    birdie_freq = 120.0
+    spike_samples = [1000, 1001, 2500]
+    files = []
+    for b in range(nbeams):
+        # birdie + spikes in 5 of 6 beams (>= beam_thresh of 4);
+        # beam 5 gets a different, single-beam signal that must NOT
+        # be masked
+        if b < 5:
+            data = _make_beam(rng, nsamps, nchans, tsamp,
+                              signal=(birdie_freq, 30.0),
+                              spikes=spike_samples)
+        else:
+            data = _make_beam(rng, nsamps, nchans, tsamp,
+                              signal=(33.0, 30.0))
+        hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=tsamp,
+                            fch1=1510.0, foff=-10.0, nsamples=nsamps)
+        path = str(tmp_path / f"beam{b}.fil")
+        write_filterbank(path, Filterbank(header=hdr, data=data))
+        files.append(path)
+
+    # drive through the CLI subcommand so arg wiring is exercised
+    from peasoup_tpu.cli import main as cli_main
+
+    samp_out = str(tmp_path / "rfi.eb_mask")
+    spec_out = str(tmp_path / "birdies.txt")
+    rc = cli_main(["coincidencer", *files, "--o", samp_out,
+                   "--o2", spec_out])
+    assert rc == 0
+
+    cfg = CoincidencerConfig(
+        samp_outfilename=samp_out, spec_outfilename=spec_out,
+    )
+    samp_mask, spec_mask, bin_width = run_coincidencer(files, cfg)
+
+    # multibeam spikes are masked in the sample mask
+    assert samp_mask[1000] == 0.0
+    assert samp_mask[2500] == 0.0
+    # the whitened+normalised series should be mostly unmasked
+    assert samp_mask.mean() > 0.99
+
+    # the common birdie is masked in the spectral mask...
+    bbin = int(round(birdie_freq / bin_width))
+    assert spec_mask[bbin - 2 : bbin + 3].min() == 0.0
+    # ...but the single-beam signal is not
+    sbin = int(round(33.0 / bin_width))
+    assert spec_mask[sbin - 2 : sbin + 3].min() == 1.0
+
+    # output files: sample mask header + one line per sample
+    lines = open(cfg.samp_outfilename).read().splitlines()
+    assert lines[0] == "#0 1"
+    assert len(lines) == 1 + nsamps
+    assert set(lines[1:]) <= {"0", "1"}
+    # birdie list covers the birdie frequency
+    birdies = np.loadtxt(cfg.spec_outfilename).reshape(-1, 2)
+    assert len(birdies) >= 1
+    assert np.any(np.abs(birdies[:, 0] - birdie_freq) < 2.0)
+
+
+def test_coincidencer_rejects_mismatched_lengths(tmp_path):
+    rng = np.random.default_rng(0)
+    files = []
+    for b, nsamps in enumerate([1024, 2048]):
+        hdr = SigprocHeader(nbits=8, nchans=4, tsamp=0.001, fch1=1510.0,
+                            foff=-10.0, nsamples=nsamps)
+        data = rng.integers(0, 255, size=(nsamps, 4), dtype=np.uint8)
+        path = str(tmp_path / f"b{b}.fil")
+        write_filterbank(path, Filterbank(header=hdr, data=data))
+        files.append(path)
+    with pytest.raises(ValueError, match="same length"):
+        run_coincidencer(files, CoincidencerConfig(
+            samp_outfilename=str(tmp_path / "m"),
+            spec_outfilename=str(tmp_path / "b"),
+        ))

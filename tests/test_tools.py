@@ -1,0 +1,64 @@
+"""Post-processing tool tests (`tools/peasoup_tools.py` equivalents)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.cli import main
+from peasoup_tpu.tools import PeasoupOutput, as_text, radec_to_str
+
+
+@pytest.fixture(scope="module")
+def outdir(tutorial_fil, tmp_path_factory):
+    """A real (small) search output directory."""
+    d = str(tmp_path_factory.mktemp("tools") / "out")
+    rc = main([
+        "-i", tutorial_fil, "-o", d,
+        "--dm_start", "0", "--dm_end", "40",
+        "--acc_start", "-5", "--acc_end", "5",
+        "--acc_pulse_width", "64000", "--npdmp", "2", "--limit", "10",
+    ])
+    assert rc == 0
+    return d
+
+
+def test_radec_to_str():
+    # SIGPROC packed hhmmss.s: 12h 34m 56.7s
+    assert radec_to_str(123456.7) == "12:34:56.7000"
+    assert radec_to_str(-23456.7) == "-2:34:56.7000"
+
+
+def test_joined_candidate_and_predictor(outdir):
+    out = PeasoupOutput(os.path.join(outdir, "overview.xml"))
+    assert out.ncands > 0
+    cand = out.get_candidate(0)
+    # folded candidate: fold present and hit list consistent with nassoc
+    assert cand.fold is not None and cand.fold.shape == (16, 64)
+    assert len(cand.hits) == cand.nassoc + 1
+    assert cand.hits[0]["snr"] == pytest.approx(float(cand.snr), rel=1e-5)
+    pred = out.make_predictor(0)
+    assert pred.splitlines()[1].startswith("PERIOD: ")
+    assert "DM: %.3f" % cand.dm in pred
+
+
+def test_as_text_table(outdir):
+    text = as_text(os.path.join(outdir, "overview.xml"))
+    lines = text.splitlines()
+    assert lines[0].split()[0] == "cand_num"
+    out = PeasoupOutput(os.path.join(outdir, "overview.xml"))
+    assert len(lines) == 1 + out.ncands
+    # sorted by period ascending by default
+    periods = [float(l.split()[1]) for l in lines[1:]]
+    assert periods == sorted(periods)
+
+
+def test_candidate_plotter_writes_page(outdir, tmp_path):
+    pytest.importorskip("matplotlib")
+    from peasoup_tpu.tools import CandidatePlotter
+
+    out = PeasoupOutput(os.path.join(outdir, "overview.xml"))
+    plotter = CandidatePlotter(out)
+    png = str(tmp_path / "cand0.png")
+    plotter.plot_cand(0, png)
+    assert os.path.getsize(png) > 10000  # a real rendered page
