@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--accel_chunk", type=int, default=16)
     p.add_argument("--compact_capacity", type=int, default=131072,
                    help="per-shard compacted peak buffer (fused search)")
+    p.add_argument("--checkpoint_file", default="",
+                   help="candidate checkpoint for crash-resume")
+    p.add_argument("--checkpoint_interval", type=int, default=8,
+                   help="DM trials between checkpoint saves (host loop)")
+    p.add_argument("--profile_dir", default="",
+                   help="capture a jax.profiler trace into this directory")
     p.add_argument("--single_device", action="store_true",
                    help="disable mesh sharding even with multiple devices")
     return p
@@ -135,7 +141,17 @@ def main(argv=None) -> int:
         search = MeshPulsarSearch(
             fil, cfg, max_devices=args.max_num_threads
         )
-    result = search.run()
+    if args.profile_dir:
+        from .utils import start_trace
+
+        start_trace(args.profile_dir)
+    try:
+        result = search.run()
+    finally:
+        if args.profile_dir:
+            from .utils import stop_trace
+
+            stop_trace()
     result.timers["reading"] = t_read
     result.timers["total"] = _time.time() - t_total
     write_search_output(result, cfg.outdir)

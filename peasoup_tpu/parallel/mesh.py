@@ -308,6 +308,23 @@ class MeshPulsarSearch(PulsarSearch):
         t_total = time.time()
 
         ndm = len(self.dm_list)
+
+        # checkpoint resume: the mesh search is a single dispatch, so a
+        # complete checkpoint skips the device program entirely (trials
+        # are re-dedispersed only if folding needs them)
+        ckpt, ckpt_done = self._make_checkpoint()
+        if ckpt and len(ckpt_done) == ndm:
+            timers["dedispersion"] = 0.0
+            timers["searching"] = 0.0
+            dm_cands = CandidateCollection()
+            for ii in range(ndm):
+                dm_cands.append(ckpt_done[ii])
+            trials = (
+                self.dedisperse_sharded() if cfg.npdmp > 0 else None
+            )
+            result = self._finalise(dm_cands, trials, timers, t_total)
+            ckpt.remove()
+            return result
         ndm_p = self._padded_trial_count()
         ndev = self.ndev
         ndm_local = ndm_p // ndev
@@ -357,22 +374,27 @@ class MeshPulsarSearch(PulsarSearch):
             compact_k=compact_k,
         )
 
+        from ..utils import trace_range
+
         t0 = time.time()
-        rep = NamedSharding(self.mesh, P())
-        shard = NamedSharding(self.mesh, P("dm", None))
-        raw_d = jax.device_put(jnp.asarray(raw), rep)
-        delays_d = jax.device_put(jnp.asarray(delays), shard)
-        km_d = jax.device_put(jnp.asarray(killmask, dtype=jnp.float32), rep)
-        accs_d = jax.device_put(jnp.asarray(accs), shard)
-        sel_bin, sel_snr, nvalid, counts, trials = program(
-            raw_d, delays_d, km_d, accs_d,
-            jnp.asarray(self.birdies), jnp.asarray(self.bwidths),
-        )
-        # tiny gathers over ICI -> host; ``trials`` stays on device
-        sel_bin = np.asarray(sel_bin)
-        sel_snr = np.asarray(sel_snr)
-        nvalid = np.asarray(nvalid)
-        counts = np.asarray(counts)
+        with trace_range("Fused-Search"):
+            rep = NamedSharding(self.mesh, P())
+            shard = NamedSharding(self.mesh, P("dm", None))
+            raw_d = jax.device_put(jnp.asarray(raw), rep)
+            delays_d = jax.device_put(jnp.asarray(delays), shard)
+            km_d = jax.device_put(
+                jnp.asarray(killmask, dtype=jnp.float32), rep
+            )
+            accs_d = jax.device_put(jnp.asarray(accs), shard)
+            sel_bin, sel_snr, nvalid, counts, trials = program(
+                raw_d, delays_d, km_d, accs_d,
+                jnp.asarray(self.birdies), jnp.asarray(self.bwidths),
+            )
+            # tiny gathers over ICI -> host; ``trials`` stays on device
+            sel_bin = np.asarray(sel_bin)
+            sel_snr = np.asarray(sel_snr)
+            nvalid = np.asarray(nvalid)
+            counts = np.asarray(counts)
         timers["dedispersion"] = 0.0  # fused into the search program
         # sub-span of "searching" (which covers device + host decode)
         timers["searching_device"] = time.time() - t0
@@ -414,15 +436,22 @@ class MeshPulsarSearch(PulsarSearch):
                 )
 
         dm_cands = CandidateCollection()
+        ckpt_done = {}
         for ii in range(ndm):
             if ii not in per_dm_entries:
+                ckpt_done[ii] = []
                 continue
             ebins, esnrs, eacc, elvl = per_dm_entries[ii]
-            dm_cands.append(
-                self._entries_to_dm_cands(
-                    float(self.dm_list[ii]), ii, acc_lists[ii],
-                    ebins, esnrs, eacc, elvl,
-                )
+            cands_ii = self._entries_to_dm_cands(
+                float(self.dm_list[ii]), ii, acc_lists[ii],
+                ebins, esnrs, eacc, elvl,
             )
+            ckpt_done[ii] = cands_ii
+            dm_cands.append(cands_ii)
+        if ckpt:
+            ckpt.save(ckpt_done)
         timers["searching"] = time.time() - t0
-        return self._finalise(dm_cands, trials, timers, t_total)
+        result = self._finalise(dm_cands, trials, timers, t_total)
+        if ckpt:
+            ckpt.remove()
+        return result

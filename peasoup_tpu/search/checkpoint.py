@@ -1,0 +1,112 @@
+"""Per-DM-trial candidate checkpointing (no reference equivalent).
+
+The reference pipeline is single-shot: a crash in a multi-hour search
+loses everything (SURVEY.md section 5 — "No retry, no checkpoint, no
+partial-result recovery").  Here the host-loop driver checkpoints its
+per-DM candidate lists every ``interval`` trials and the mesh driver
+checkpoints once after its (single-dispatch) search, so a re-run with
+the same input and configuration resumes instead of recomputing.
+
+The checkpoint key ties the file to the exact search: input path,
+filterbank geometry, and every ``SearchConfig`` field.  A key mismatch
+silently invalidates the checkpoint (the search simply runs afresh).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import asdict
+
+from ..data.candidates import Candidate
+
+_FORMAT_VERSION = 1
+
+
+# presentation/runtime knobs that do not change the search's results
+# (note: compact_capacity and max_num_threads DO stay in the key — both
+# can alter the mesh driver's candidate set via buffer truncation)
+_NON_IDENTITY_FIELDS = {
+    "verbose", "progress_bar", "checkpoint_file", "checkpoint_interval",
+    "outdir", "accel_chunk",
+}
+
+
+def _file_digest(path: str) -> str:
+    """Content hash of a sidecar file (kill/zap list); '' if unset."""
+    if not path:
+        return ""
+    import hashlib
+
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return "<unreadable>"
+
+
+def search_key(infile: str, fil, config) -> str:
+    """Stable identity of a search (input + geometry + parameters).
+
+    Kill/zap sidecar files enter by CONTENT hash, not just path, so
+    editing them between crash and resume invalidates the checkpoint.
+    """
+    hdr = fil.header
+    cfg_items = sorted(
+        (k, v) for k, v in asdict(config).items()
+        if k not in _NON_IDENTITY_FIELDS
+    )
+    return repr((
+        _FORMAT_VERSION, os.path.abspath(infile or config.infilename),
+        fil.nsamps, fil.nchans, hdr.nbits, float(hdr.tsamp),
+        float(hdr.fch1), float(hdr.foff), cfg_items,
+        _file_digest(config.killfilename),
+        _file_digest(config.zapfilename),
+    ))
+
+
+class SearchCheckpoint:
+    """Atomic pickle checkpoint of {dm_idx: [Candidate]} progress."""
+
+    def __init__(self, path: str, key: str, interval: int = 8):
+        self.path = path
+        self.key = key
+        self.interval = max(int(interval), 1)
+        self._since_save = 0
+
+    def load(self) -> dict[int, list[Candidate]] | None:
+        """Return completed per-DM candidates, or None if absent/stale."""
+        if not self.path or not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                payload = pickle.load(f)
+        except Exception:
+            return None
+        if payload.get("key") != self.key:
+            return None
+        return payload["cands_by_dm"]
+
+    def save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"key": self.key, "cands_by_dm": cands_by_dm}, f)
+        os.replace(tmp, self.path)
+
+    def maybe_save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
+        """Save every ``interval`` calls (host-loop cadence control).
+
+        Each save re-pickles the whole accumulated dict, so total
+        checkpoint I/O over a run is O(ndm^2 / interval); keep
+        ``interval`` >= the default for searches with many DM trials
+        (interval=1 is for tests/tiny runs).
+        """
+        self._since_save += 1
+        if self._since_save >= self.interval:
+            self.save(cands_by_dm)
+            self._since_save = 0
+
+    def remove(self) -> None:
+        """Drop the checkpoint after a fully successful run."""
+        if self.path and os.path.exists(self.path):
+            os.remove(self.path)

@@ -278,21 +278,60 @@ class PulsarSearch:
 
     # -- full run ----------------------------------------------------------
 
+    def _make_checkpoint(self):
+        cfg = self.config
+        if not cfg.checkpoint_file:
+            return None, {}
+        from .checkpoint import SearchCheckpoint, search_key
+
+        ckpt = SearchCheckpoint(
+            cfg.checkpoint_file,
+            search_key(cfg.infilename, self.fil, cfg),
+            cfg.checkpoint_interval,
+        )
+        return ckpt, (ckpt.load() or {})
+
     def run(self) -> SearchResult:
+        from ..utils import ProgressBar, trace_range
+
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
-        t0 = time.time()
-        trials = self.dedisperse()
-        trials.block_until_ready()
-        timers["dedispersion"] = time.time() - t0
+
+        # consult the checkpoint BEFORE dedispersing: a fully-complete
+        # resume only needs trials if folding will run
+        ckpt, done = self._make_checkpoint()
+        complete = len(done) == len(self.dm_list)
+        trials = None
+        timers["dedispersion"] = 0.0
+        if not (complete and cfg.npdmp == 0):
+            t0 = time.time()
+            with trace_range("Dedisperse"):
+                trials = self.dedisperse()
+                trials.block_until_ready()
+            timers["dedispersion"] = time.time() - t0
 
         t0 = time.time()
         dm_cands = CandidateCollection()
-        for ii in range(len(self.dm_list)):
-            dm_cands.append(self.search_dm_trial(trials, ii))
+        pbar = ProgressBar(len(self.dm_list), "DM trials ",
+                           enabled=cfg.progress_bar)
+        pbar.start()
+        with trace_range("DM-Loop"):
+            for ii in range(len(self.dm_list)):
+                if ii not in done:
+                    done[ii] = self.search_dm_trial(trials, ii)
+                    if ckpt:
+                        ckpt.maybe_save(done)
+                dm_cands.append(done[ii])
+                pbar.update(ii + 1)
+        pbar.finish()
+        if ckpt:
+            ckpt.save(done)
         timers["searching"] = time.time() - t0
-        return self._finalise(dm_cands, trials, timers, t_total)
+        result = self._finalise(dm_cands, trials, timers, t_total)
+        if ckpt:
+            ckpt.remove()  # run completed; resume no longer needed
+        return result
 
     def _finalise(self, dm_cands, trials, timers, t_total) -> SearchResult:
         """Shared tail of every driver (`pipeline_multi.cu:362-391`):
@@ -311,13 +350,16 @@ class PulsarSearch:
 
         import time
 
+        from ..utils import trace_range
+
         t0 = time.time()
         if cfg.npdmp > 0:
-            fold_candidates(
-                cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
-                boundary_5_freq=cfg.boundary_5_freq,
-                boundary_25_freq=cfg.boundary_25_freq,
-            )
+            with trace_range("Folding"):
+                fold_candidates(
+                    cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
+                    boundary_5_freq=cfg.boundary_5_freq,
+                    boundary_25_freq=cfg.boundary_25_freq,
+                )
         timers["folding"] = time.time() - t0
 
         cands = cands[: cfg.limit]

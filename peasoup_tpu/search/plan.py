@@ -51,6 +51,8 @@ class SearchConfig:
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step
     compact_capacity: int = 131072  # per-shard compacted peak buffer (fused)
+    checkpoint_file: str = ""      # per-DM candidate checkpoint (resume)
+    checkpoint_interval: int = 8   # host-loop trials between checkpoint saves
     infilename: str = ""
 
 

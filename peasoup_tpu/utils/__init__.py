@@ -1,0 +1,10 @@
+"""Cross-cutting utilities: progress reporting, profiler tracing.
+
+Equivalents of the reference's `include/utils/` aux layer
+(`progress_bar.hpp`, `nvtx.hpp`, `stopwatch.hpp` — the timing map
+itself lives in each driver's ``timers`` dict)."""
+
+from .progress import ProgressBar
+from .tracing import trace_range, start_trace, stop_trace
+
+__all__ = ["ProgressBar", "trace_range", "start_trace", "stop_trace"]

@@ -1,0 +1,52 @@
+"""Terminal progress reporting with ETA.
+
+Equivalent of the reference's pthread progress bar
+(`include/utils/progress_bar.hpp:7-73`), which prints percent complete
+and an ETA extrapolated from elapsed wall-clock.  Here progress is
+driven by explicit ``update(done)`` calls from the search loop instead
+of a polling thread.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, total: int, label: str = "", stream=None,
+                 width: int = 40, enabled: bool = True):
+        self.total = max(int(total), 1)
+        self.label = label
+        self.stream = stream if stream is not None else sys.stderr
+        self.width = width
+        self.enabled = enabled
+        self._start = None
+        self._last_len = 0
+
+    def start(self) -> None:
+        self._start = time.time()
+        self.update(0)
+
+    def update(self, done: int) -> None:
+        if not self.enabled:
+            return
+        if self._start is None:
+            self._start = time.time()
+        frac = min(done / self.total, 1.0)
+        elapsed = time.time() - self._start
+        eta = elapsed * (1.0 - frac) / frac if frac > 0 else float("inf")
+        nfill = int(frac * self.width)
+        bar = "#" * nfill + "-" * (self.width - nfill)
+        eta_s = f"{eta:6.1f}s" if eta != float("inf") else "   ?  "
+        line = f"\r{self.label}[{bar}] {100 * frac:5.1f}%  ETA {eta_s}"
+        self.stream.write(line + " " * max(0, self._last_len - len(line)))
+        self._last_len = len(line)
+        self.stream.flush()
+
+    def finish(self) -> None:
+        if not self.enabled:
+            return
+        self.update(self.total)
+        self.stream.write("\n")
+        self.stream.flush()

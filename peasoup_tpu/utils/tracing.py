@@ -1,0 +1,35 @@
+"""Profiler trace annotations — the NVTX-range equivalent.
+
+The reference compiles NVTX push/pop ranges around "Dedisperse",
+"DM-Loop", "Acceleration-Loop" and "Harmonic summing"
+(`include/utils/nvtx.hpp:8-24`, `src/pipeline_multi.cu:144,207,318`).
+On TPU the analogue is ``jax.profiler``: ``trace_range`` annotates a
+host-side region so it shows up in TensorBoard/Perfetto traces captured
+with ``start_trace``/``stop_trace`` (or the CLI's ``--profile_dir``).
+Annotations are no-ops unless a trace is being captured.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def trace_range(name: str):
+    """Named profiler range (PUSH_NVTX_RANGE/POP_NVTX_RANGE analogue)."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def start_trace(log_dir: str) -> None:
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    import jax.profiler
+
+    jax.profiler.stop_trace()
