@@ -15,7 +15,11 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_HERE, "_peasoup_native.so")
-_SOURCES = [os.path.join(_HERE, "unpack.cpp"), os.path.join(_HERE, "peaks.cpp")]
+_SOURCES = [
+    os.path.join(_HERE, "unpack.cpp"),
+    os.path.join(_HERE, "peaks.cpp"),
+    os.path.join(_HERE, "distill.cpp"),
+]
 
 
 def _build() -> str:
@@ -42,6 +46,19 @@ class _NativeLib:
             i64p, f32p, ctypes.c_size_t, ctypes.c_int64, i64p, f32p,
         ]
         self._dll.unique_peaks.restype = ctypes.c_size_t
+        self._dll.unique_peaks_segmented.argtypes = [
+            i64p, f32p, i64p, ctypes.c_size_t, ctypes.c_int64,
+            i64p, f32p, i64p,
+        ]
+        self._dll.unique_peaks_segmented.restype = ctypes.c_size_t
+        f64p = ctypes.POINTER(ctypes.c_double)
+        u8pp = ctypes.POINTER(ctypes.c_uint8)
+        self._dll.distill_greedy.argtypes = [
+            ctypes.c_int, f64p, f64p, ctypes.c_size_t, ctypes.c_double,
+            ctypes.c_int64, ctypes.c_double, ctypes.c_int,
+            ctypes.c_size_t, u8pp, i64p, i64p,
+        ]
+        self._dll.distill_greedy.restype = ctypes.c_size_t
 
     def unpack_bits(self, raw: np.ndarray, nbits: int) -> np.ndarray:
         raw = np.ascontiguousarray(raw, dtype=np.uint8)
@@ -66,6 +83,56 @@ class _NativeLib:
             out_snr.ctypes.data_as(f32p),
         )
         return out_idx[:nout], out_snr[:nout]
+
+    def distill_greedy(self, type_: int, freqs, aux, tol: float,
+                       max_harm: int, tobs_over_c: float,
+                       record_pairs: bool):
+        freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+        aux = np.ascontiguousarray(aux, dtype=np.float64)
+        n = freqs.size
+        unique = np.empty(n, dtype=np.uint8)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+
+        def run(cap):
+            pf = np.empty(max(cap, 1), dtype=np.int64)
+            pa = np.empty(max(cap, 1), dtype=np.int64)
+            npairs = self._dll.distill_greedy(
+                type_, freqs.ctypes.data_as(f64p), aux.ctypes.data_as(f64p),
+                n, tol, max_harm, tobs_over_c, int(record_pairs), cap,
+                unique.ctypes.data_as(u8p), pf.ctypes.data_as(i64p),
+                pa.ctypes.data_as(i64p),
+            )
+            return npairs, pf, pa
+
+        # generous first guess; the C side keeps counting past capacity,
+        # so one exact-size retry covers the (rare) overflow instead of
+        # preallocating the O(n^2) worst case
+        cap = (16 * n + 1024) if record_pairs else 0
+        npairs, pf, pa = run(cap)
+        if record_pairs and npairs > cap:
+            npairs, pf, pa = run(npairs)
+        return unique.astype(bool), pf[:npairs], pa[:npairs]
+
+    def unique_peaks_segmented(self, idxs, snrs, seg_bounds, min_gap):
+        idxs = np.ascontiguousarray(idxs, dtype=np.int64)
+        snrs = np.ascontiguousarray(snrs, dtype=np.float32)
+        seg_bounds = np.ascontiguousarray(seg_bounds, dtype=np.int64)
+        nseg = seg_bounds.size - 1
+        n = idxs.size
+        out_idx = np.empty(n, dtype=np.int64)
+        out_snr = np.empty(n, dtype=np.float32)
+        out_counts = np.empty(max(nseg, 1), dtype=np.int64)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        nout = self._dll.unique_peaks_segmented(
+            idxs.ctypes.data_as(i64p), snrs.ctypes.data_as(f32p),
+            seg_bounds.ctypes.data_as(i64p), nseg, min_gap,
+            out_idx.ctypes.data_as(i64p), out_snr.ctypes.data_as(f32p),
+            out_counts.ctypes.data_as(i64p),
+        )
+        return out_idx[:nout], out_snr[:nout], out_counts[:nseg]
 
     def pack_bits(self, samples: np.ndarray, nbits: int) -> np.ndarray:
         samples = np.ascontiguousarray(samples, dtype=np.uint8)

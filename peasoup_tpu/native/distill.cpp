@@ -1,0 +1,78 @@
+// Native candidate distillation: the greedy SNR-sorted dedup of
+// include/transforms/distiller.hpp:16-197, with the same IEEE-double
+// pair predicates.  Candidates arrive pre-sorted by SNR descending;
+// the walk marks absorbed candidates non-unique and records
+// (fundamental, absorbed) pairs for the host to append assoc lists.
+//
+// Predicate types:
+//   0 harmonic:      exists j<=max_harm, k<=max_denom[ii] with
+//                    1-tol < k*f/(j*f0) < 1+tol      (distiller.hpp:69-103)
+//   1 acceleration:  f within [min(f0,fa)-edge, max(f0,fa)+edge], where
+//                    fa = f0 + (a0-a)*f0*tobs/c      (distiller.hpp:115-163)
+//   2 dm:            1-tol < f/f0 < 1+tol            (distiller.hpp:168-197)
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// Records at most pair_capacity pairs but always returns the TRUE pair
+// count, so the caller can retry with an exact-size buffer instead of
+// preallocating the O(n^2) worst case.
+size_t distill_greedy(int type, const double* freqs, const double* aux,
+                      size_t n, double tol, int64_t max_harm,
+                      double tobs_over_c, int record_pairs,
+                      size_t pair_capacity, uint8_t* unique,
+                      int64_t* pair_fundi, int64_t* pair_absorbed) {
+    for (size_t i = 0; i < n; ++i) unique[i] = 1;
+    size_t npairs = 0;
+    const double lower = 1.0 - tol;
+    const double upper = 1.0 + tol;
+    for (size_t idx = 0; idx < n; ++idx) {
+        if (!unique[idx]) continue;
+        const double f0 = freqs[idx];
+        for (size_t ii = idx + 1; ii < n; ++ii) {
+            const double f = freqs[ii];
+            bool hit = false;
+            if (type == 0) {
+                const int64_t max_denom = static_cast<int64_t>(aux[ii]);
+                for (int64_t j = 1; j <= max_harm && !hit; ++j) {
+                    for (int64_t k = 1; k <= max_denom; ++k) {
+                        const double ratio =
+                            static_cast<double>(k) * f /
+                            (static_cast<double>(j) * f0);
+                        if (ratio > lower && ratio < upper) {
+                            hit = true;
+                            break;
+                        }
+                    }
+                }
+            } else if (type == 1) {
+                const double delta_acc = aux[idx] - aux[ii];
+                const double fa = f0 + delta_acc * f0 * tobs_over_c;
+                const double edge = f0 * tol;
+                if (fa > f0) {
+                    hit = (f > f0 - edge) && (f < fa + edge);
+                } else {
+                    hit = (f > fa - edge) && (f < f0 + edge);
+                }
+            } else {
+                const double ratio = f / f0;
+                hit = (ratio > lower) && (ratio < upper);
+            }
+            if (hit) {
+                if (record_pairs) {
+                    if (npairs < pair_capacity) {
+                        pair_fundi[npairs] = static_cast<int64_t>(idx);
+                        pair_absorbed[npairs] = static_cast<int64_t>(ii);
+                    }
+                    ++npairs;
+                }
+                unique[ii] = 0;
+            }
+        }
+    }
+    return npairs;
+}
+
+}  // extern "C"

@@ -36,4 +36,27 @@ size_t unique_peaks(const int64_t* idxs, const float* snrs, size_t n,
     return nout;
 }
 
+// Batched variant: merge every segment of a concatenated entry list in
+// one call (segments = per-(dm, accel, level) spectra).  seg_bounds has
+// nseg+1 entries delimiting [seg_bounds[s], seg_bounds[s+1]).  Outputs
+// are written contiguously; out_counts[s] = merged peaks in segment s.
+// Returns the total number of merged peaks.
+
+size_t unique_peaks_segmented(const int64_t* idxs, const float* snrs,
+                              const int64_t* seg_bounds, size_t nseg,
+                              int64_t min_gap, int64_t* out_idx,
+                              float* out_snr, int64_t* out_counts) {
+    size_t nout = 0;
+    for (size_t s = 0; s < nseg; ++s) {
+        const size_t lo = static_cast<size_t>(seg_bounds[s]);
+        const size_t hi = static_cast<size_t>(seg_bounds[s + 1]);
+        const size_t n = hi - lo;
+        const size_t before = nout;
+        nout += unique_peaks(idxs + lo, snrs + lo, n, min_gap,
+                             out_idx + nout, out_snr + nout);
+        out_counts[s] = static_cast<int64_t>(nout - before);
+    }
+    return nout;
+}
+
 }  // extern "C"

@@ -1,0 +1,82 @@
+"""FFT cross-correlation delay finding (experimental tool).
+
+Reference: ``DelayFinder`` (`include/transforms/correlator.hpp:33-92`,
+driven only by the stale ``accmap.cpp``): for every antenna baseline
+(i, j>i) it forms ifft(conj(fft(x_i)) * fft(x_j)), keeps the first and
+last ``max_delay`` lags, and reports the argmax of |c|^2 within that
+window ("Distance", an index in [0, 2*max_delay)).
+
+TPU redesign: all antenna FFTs are computed once and every baseline's
+correlation/argmax is evaluated in a single vmapped jitted program
+(the reference loops baselines serially with one FFT per visit,
+`correlator.hpp:63-88`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("max_delay",))
+def _baseline_delays(arrays: jnp.ndarray, ii: jnp.ndarray, jj: jnp.ndarray,
+                     max_delay: int):
+    """arrays: (n, size) complex64; ii/jj: (nbase,) baseline indices."""
+    ffts = jnp.fft.fft(arrays, axis=1)
+
+    def one(i, j):
+        corr = jnp.fft.ifft(jnp.conj(ffts[i]) * ffts[j])
+        window = jnp.concatenate(
+            [corr[:max_delay], corr[-max_delay:]]
+        )
+        power = jnp.abs(window) ** 2
+        return jnp.argmax(power), jnp.max(power)
+
+    return jax.vmap(one)(ii, jj)
+
+
+def distance_to_lag(distance: int, max_delay: int) -> int:
+    """Window index -> signed sample lag: the second half of the window
+    holds the negative lags (`correlator.hpp:77-78`)."""
+    return (
+        int(distance)
+        if distance < max_delay
+        else int(distance) - 2 * max_delay
+    )
+
+
+def find_delays(arrays: np.ndarray, max_delay: int) -> list[dict]:
+    """Delay of every baseline of an (nant, size) array stack.
+
+    Returns one record per pair (i, j>i): the reference's window-index
+    ``distance`` plus the signed ``lag`` in samples and the peak
+    correlation power.
+    """
+    arrays = jnp.asarray(arrays, jnp.complex64)
+    n = arrays.shape[0]
+    size = arrays.shape[1]
+    if not 0 < max_delay <= size // 2:
+        raise ValueError(
+            f"max_delay must be in (0, size//2]; got {max_delay} for "
+            f"size {size}"
+        )
+    pairs = [(i, j) for i in range(n) for j in range(i + 1, n)]
+    if not pairs:
+        return []
+    ii = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    jj = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    distances, powers = _baseline_delays(arrays, ii, jj, int(max_delay))
+    distances = np.asarray(distances)
+    powers = np.asarray(powers)
+    return [
+        {
+            "i": i, "j": j,
+            "distance": int(d),
+            "lag": distance_to_lag(int(d), int(max_delay)),
+            "power": float(p),
+        }
+        for (i, j), d, p in zip(pairs, distances, powers)
+    ]

@@ -34,16 +34,55 @@ def extract_above_threshold(
     the true number of qualifying bins (may exceed ``capacity``).
     """
     size = spectrum.shape[0]
-    i = jnp.arange(size, dtype=jnp.int32)
-    mask = (i >= start_idx) & (i < stop_idx) & (spectrum > thresh)
+    # bins >= stop_idx can never qualify: sort only the searched prefix
+    # (for low harmonic levels stop_idx << size, cutting the top_k cost)
+    stop_idx = min(stop_idx, size)
+    spec = spectrum[:stop_idx]
+    i = jnp.arange(stop_idx, dtype=jnp.int32)
+    mask = (i >= start_idx) & (spec > thresh)
     sentinel = jnp.int32(-(size + 1))
     score = jnp.where(mask, -i, sentinel)
-    top, _ = jax.lax.top_k(score, capacity)  # largest scores = smallest idx
+    k_eff = min(capacity, stop_idx)
+    top, _ = jax.lax.top_k(score, k_eff)  # largest scores = smallest idx
     valid = top != sentinel
     idxs = jnp.where(valid, -top, -1)
-    snrs = jnp.where(valid, spectrum[jnp.clip(-top, 0, size - 1)], 0.0)
+    snrs = jnp.where(valid, spec[jnp.clip(-top, 0, stop_idx - 1)], 0.0)
+    if k_eff < capacity:
+        idxs = jnp.pad(idxs, (0, capacity - k_eff), constant_values=-1)
+        snrs = jnp.pad(snrs, (0, capacity - k_eff))
     count = jnp.sum(mask, dtype=jnp.int32)
     return idxs, snrs.astype(jnp.float32), count
+
+
+def segmented_unique_peaks(
+    idxs: np.ndarray,
+    snrs: np.ndarray,
+    seg_bounds: np.ndarray,
+    min_gap: int = 30,
+):
+    """Run the unique-peak merge over every segment of a concatenated
+    entry list in one native call (segments = per-spectrum slices).
+
+    Returns (merged_idxs, merged_snrs, per_segment_counts).
+    """
+    try:
+        from ..native import lib as _native
+    except Exception:
+        _native = None
+    if _native is not None:
+        return _native.unique_peaks_segmented(idxs, snrs, seg_bounds,
+                                              min_gap)
+    outs_i, outs_s, counts = [], [], []
+    for lo, hi in zip(seg_bounds[:-1], seg_bounds[1:]):
+        pi, ps = identify_unique_peaks(idxs[lo:hi], snrs[lo:hi], min_gap)
+        outs_i.append(pi)
+        outs_s.append(ps)
+        counts.append(len(pi))
+    return (
+        np.concatenate(outs_i) if outs_i else np.zeros(0, np.int64),
+        np.concatenate(outs_s) if outs_s else np.zeros(0, np.float32),
+        np.array(counts, np.int64),
+    )
 
 
 def identify_unique_peaks(

@@ -44,7 +44,7 @@ from ..search.pipeline import (
 from ..search.plan import SearchConfig
 from ..data.candidates import Candidate, CandidateCollection
 from ..io.unpack import pack_bits
-from ..ops.peaks import identify_unique_peaks
+from ..ops.peaks import segmented_unique_peaks
 
 
 def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
@@ -55,7 +55,8 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
 
 
 def _search_dm_row(tim, accs_row, birdies, widths, *, bin_width, tsamp,
-                   nharms, bounds, capacity, min_snr, b5, b25, use_zap):
+                   nharms, bounds, capacity, min_snr, b5, b25, use_zap,
+                   max_shift=None):
     """Whiten one DM trial and search its (NaN-padded) accel batch.
 
     Shared body of both sharded programs: returns (idxs, snrs, counts)
@@ -66,7 +67,7 @@ def _search_dm_row(tim, accs_row, birdies, widths, *, bin_width, tsamp,
     )
     search = lambda a: search_one_accel(
         tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
-        capacity, min_snr,
+        capacity, min_snr, max_shift,
     )
     idxs, snrs, counts = jax.vmap(search)(accs_row)
     valid = ~jnp.isnan(accs_row)
@@ -144,6 +145,7 @@ def build_fused_search(
     use_zap: bool,
     use_killmask: bool,
     compact_k: int,
+    max_shift: int | None = None,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -157,15 +159,18 @@ def build_fused_search(
     dominate wall-clock on a remote-attached TPU: the reference pays
     neither (its host loop talks to a local PCIe GPU per DM trial,
     `src/pipeline_multi.cu:145-244`), so the TPU-native design moves the
-    whole search into one dispatch and ships home only:
+    whole search into one dispatch and ships home ONE packed f32 buffer
+    per shard (ints bitcast), laid out as:
 
-    * ``sel_bin``  (compact_k,) int32 — spectrum bin indices
-    * ``sel_snr``  (compact_k,) f32   — SNR values
-    * ``nvalid``   (1,) int32 — true total peak count (overflow check)
-    * ``counts``   (ndm_local, naccel, nlevels) int32 — per-spectrum
-      above-threshold counts (per-spectrum overflow check)
-    * ``trials``   (ndm_local, out_nsamps) f32 — full-width, stays
-      device-resident for the folding phase; never copied to host.
+    * ``[0:compact_k]``  spectrum bin indices (int32 bitcast)
+    * ``[compact_k:2k]`` SNR values (f32)
+    * ``[2k:2k+nspec]``  per-spectrum above-threshold counts
+      (ndm_local*naccel*nlevels int32 bitcast; overflow check + the
+      key to reconstructing each entry's (dm, accel, level) tag)
+    * ``[-1]``           true total valid count (int32 bitcast)
+
+    plus ``trials`` (ndm_local, out_nsamps) f32 — full-width, staying
+    device-resident for the folding phase; never copied to host.
 
     Returns a jitted callable
     ``fn(raw, delays, killmask, accs, birdies, widths)``.
@@ -193,17 +198,18 @@ def build_fused_search(
             )
             trials_sz = jnp.concatenate([trials, pad], axis=1)
 
-        def per_dm(carry, inp):
-            tim, accs_row = inp
-            outs = _search_dm_row(
+        def per_dm(tim, accs_row):
+            return _search_dm_row(
                 tim, accs_row, birdies, widths, bin_width=bin_width,
                 tsamp=tsamp, nharms=nharms, bounds=bounds,
                 capacity=capacity, min_snr=min_snr, b5=b5, b25=b25,
-                use_zap=use_zap,
+                use_zap=use_zap, max_shift=max_shift,
             )
-            return carry, outs
 
-        _, (idxs, snrs, counts) = lax.scan(per_dm, 0, (trials_sz, accs))
+        # vmap (not scan): all local DM trials are one batch of FFTs /
+        # gathers / top_ks, keeping the VPU/MXU fed instead of running
+        # 59 small sequential program iterations
+        idxs, snrs, counts = jax.vmap(per_dm)(trials_sz, accs)
 
         flat_bin = idxs.reshape(-1)
         flat_snr = snrs.reshape(-1)
@@ -221,7 +227,15 @@ def build_fused_search(
         sel_bin = jnp.where(got, flat_bin[sel], -1)
         sel_snr = jnp.where(got, flat_snr[sel], 0.0).astype(jnp.float32)
         nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
-        return sel_bin, sel_snr, nvalid, counts, trials
+        # pack everything into ONE f32 buffer (ints bitcast) so the
+        # host pays a single device->host round trip
+        packed = jnp.concatenate([
+            lax.bitcast_convert_type(sel_bin, jnp.float32),
+            sel_snr,
+            lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
+            lax.bitcast_convert_type(nvalid, jnp.float32),
+        ])
+        return packed, trials
 
     mapped = jax.shard_map(
         shard_fn,
@@ -229,10 +243,7 @@ def build_fused_search(
         in_specs=(
             P(), P("dm", None), P(), P("dm", None), P(), P(),
         ),
-        out_specs=(
-            P("dm"), P("dm"), P("dm"),
-            P("dm", None, None), P("dm", None),
-        ),
+        out_specs=(P("dm"), P("dm", None)),
     )
     return jax.jit(mapped)
 
@@ -245,31 +256,6 @@ class MeshPulsarSearch(PulsarSearch):
         super().__init__(fil, config)
         self.mesh = mesh if mesh is not None else make_mesh(max_devices)
         self.ndev = self.mesh.devices.size
-
-    def _entries_to_dm_cands(self, dm, dm_idx, acc_list, ebins, esnrs,
-                             eacc, elvl):
-        """Sparse equivalent of ``PulsarSearch.process_dm_peaks``: turn
-        this DM's compacted peak entries into distilled candidates.
-        Entry order within each (accel, level) spectrum is ascending bin
-        index (compaction preserves slot order), as the unique-peak
-        merge requires."""
-        groups: list[list[Candidate]] = []
-        for j, acc in enumerate(acc_list):
-            m_acc = eacc == j
-            cands: list[Candidate] = []
-            for level, (_start, _stop, factor) in enumerate(self.bounds):
-                m = m_acc & (elvl == level)
-                if not m.any():
-                    continue
-                pidx, psnr = identify_unique_peaks(ebins[m], esnrs[m])
-                for p, s in zip(pidx, psnr):
-                    cands.append(
-                        Candidate(dm=dm, dm_idx=dm_idx, acc=float(acc),
-                                  nh=level, snr=float(s),
-                                  freq=float(p * factor))
-                    )
-            groups.append(cands)
-        return self._distill_accel_groups(groups)
 
     def _padded_trial_count(self) -> int:
         ndm = len(self.dm_list)
@@ -298,6 +284,44 @@ class MeshPulsarSearch(PulsarSearch):
         if km is not None:
             return fn(data, delays_d, killmask=jax.device_put(km, rep))
         return fn(data, delays_d)
+
+    def _device_inputs(self, acc_lists, ndm_p: int, namax: int):
+        """Build (once) and cache the device-resident static inputs.
+
+        The filterbank bytes, delay table, killmask and accel grid are
+        constant for a given search object, so they live in HBM across
+        ``run()`` calls — re-uploading them per run costs more than the
+        entire device search on a remote-attached TPU.
+        """
+        if getattr(self, "_dev_inputs", None) is not None:
+            return self._dev_inputs
+        ndm = len(self.dm_list)
+        accs = np.full((ndm_p, namax), np.nan, np.float32)
+        for i, a in enumerate(acc_lists):
+            accs[i, : len(a)] = a
+        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+        delays[:ndm] = self.delays
+        killmask = (
+            self.killmask
+            if self.killmask is not None
+            else np.ones(self.fil.nchans, np.float32)
+        )
+        nbits = self.fil.header.nbits
+        if nbits == 32:  # float data: nothing to pack
+            raw = np.ascontiguousarray(self.fil.data, np.float32).ravel()
+        else:
+            raw = pack_bits(self.fil.data.ravel(), nbits)
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P("dm", None))
+        self._dev_inputs = (
+            jax.device_put(jnp.asarray(raw), rep),
+            jax.device_put(jnp.asarray(delays), shard),
+            jax.device_put(jnp.asarray(killmask, dtype=jnp.float32), rep),
+            jax.device_put(jnp.asarray(accs), shard),
+            jax.device_put(jnp.asarray(self.birdies), rep),
+            jax.device_put(jnp.asarray(self.bwidths), rep),
+        )
+        return self._dev_inputs
 
     def run(self) -> SearchResult:
         import time
@@ -332,21 +356,6 @@ class MeshPulsarSearch(PulsarSearch):
             self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
         ]
         namax = max(len(a) for a in acc_lists)
-        accs = np.full((ndm_p, namax), np.nan, np.float32)
-        for i, a in enumerate(acc_lists):
-            accs[i, : len(a)] = a
-        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
-        delays[:ndm] = self.delays
-        killmask = (
-            self.killmask
-            if self.killmask is not None
-            else np.ones(self.fil.nchans, np.float32)
-        )
-        nbits = self.fil.header.nbits
-        if nbits == 32:  # float data: nothing to pack
-            raw = np.ascontiguousarray(self.fil.data, np.float32).ravel()
-        else:
-            raw = pack_bits(self.fil.data.ravel(), nbits)
         nlevels = cfg.nharmonics + 1
         cap = cfg.peak_capacity
         # clamp to the shard's total slot count (small configs)
@@ -356,7 +365,7 @@ class MeshPulsarSearch(PulsarSearch):
 
         program = build_fused_search(
             self.mesh,
-            nbits=nbits,
+            nbits=self.fil.header.nbits,
             nchans=self.fil.nchans,
             nsamps=self.fil.nsamps,
             out_nsamps=self.out_nsamps,
@@ -372,29 +381,37 @@ class MeshPulsarSearch(PulsarSearch):
             use_zap=bool(len(self.birdies)),
             use_killmask=self.killmask is not None,
             compact_k=compact_k,
+            max_shift=self.max_shift,
         )
 
         from ..utils import trace_range
 
         t0 = time.time()
         with trace_range("Fused-Search"):
-            rep = NamedSharding(self.mesh, P())
-            shard = NamedSharding(self.mesh, P("dm", None))
-            raw_d = jax.device_put(jnp.asarray(raw), rep)
-            delays_d = jax.device_put(jnp.asarray(delays), shard)
-            km_d = jax.device_put(
-                jnp.asarray(killmask, dtype=jnp.float32), rep
+            inputs = self._device_inputs(acc_lists, ndm_p, namax)
+            packed, trials = program(*inputs)
+            # ONE gather over ICI -> host; ``trials`` stays on device
+            packed = np.asarray(packed)
+        nspec_local = ndm_local * namax * nlevels
+        blk_len = 2 * compact_k + nspec_local + 1
+        sel_bin = np.empty(ndev * compact_k, np.int32)
+        sel_snr = np.empty(ndev * compact_k, np.float32)
+        counts = np.empty((ndm_p, namax, nlevels), np.int32)
+        nvalid = np.empty(ndev, np.int32)
+        for sidx in range(ndev):
+            blk = packed[sidx * blk_len : (sidx + 1) * blk_len]
+            sel_bin[sidx * compact_k : (sidx + 1) * compact_k] = (
+                blk[:compact_k].view(np.int32)
             )
-            accs_d = jax.device_put(jnp.asarray(accs), shard)
-            sel_bin, sel_snr, nvalid, counts, trials = program(
-                raw_d, delays_d, km_d, accs_d,
-                jnp.asarray(self.birdies), jnp.asarray(self.bwidths),
+            sel_snr[sidx * compact_k : (sidx + 1) * compact_k] = (
+                blk[compact_k : 2 * compact_k]
             )
-            # tiny gathers over ICI -> host; ``trials`` stays on device
-            sel_bin = np.asarray(sel_bin)
-            sel_snr = np.asarray(sel_snr)
-            nvalid = np.asarray(nvalid)
-            counts = np.asarray(counts)
+            counts[sidx * ndm_local : (sidx + 1) * ndm_local] = (
+                blk[2 * compact_k : 2 * compact_k + nspec_local]
+                .view(np.int32)
+                .reshape(ndm_local, namax, nlevels)
+            )
+            nvalid[sidx] = blk[-1:].view(np.int32)[0]
         timers["dedispersion"] = 0.0  # fused into the search program
         # sub-span of "searching" (which covers device + host decode)
         timers["searching_device"] = time.time() - t0
@@ -406,10 +423,11 @@ class MeshPulsarSearch(PulsarSearch):
             )
 
         # reconstruct each entry's (dm_local, accel, level) tag from
-        # counts: the device compaction keeps valid slots in flat
-        # (dm_local, accel, level, slot) order
-        per_dm_entries: dict[int, tuple] = {}
-        nspec_local = ndm_local * namax * nlevels
+        # counts (the device compaction keeps valid slots in flat
+        # spectrum order), then run the unique-peak merge over ALL
+        # spectra in one native segmented call per shard
+        factors = np.array([b[2] for b in self.bounds])
+        per_dm_groups: dict[int, list] = {}
         for s in range(ndev):
             if nvalid[s] > compact_k:
                 warnings.warn(
@@ -419,33 +437,45 @@ class MeshPulsarSearch(PulsarSearch):
             k = np.minimum(
                 counts[s * ndm_local : (s + 1) * ndm_local], cap
             ).reshape(-1)
+            seg_bounds = np.minimum(
+                np.concatenate([[0], np.cumsum(k)]), compact_k
+            )
+            total = int(seg_bounds[-1])
+            blk = slice(s * compact_k, s * compact_k + total)
+            merged_bin, merged_snr, seg_counts = segmented_unique_peaks(
+                sel_bin[blk], sel_snr[blk], seg_bounds
+            )
             spec = np.repeat(
-                np.arange(nspec_local, dtype=np.int64), k
-            )[:compact_k]
-            nent = spec.shape[0]
-            blk = slice(s * compact_k, s * compact_k + nent)
-            bins = sel_bin[blk]
-            snrs = sel_snr[blk]
+                np.arange(nspec_local, dtype=np.int64), seg_counts
+            )
             lvl = spec % nlevels
             acc_i = (spec // nlevels) % namax
             dml = spec // (nlevels * namax)
+            freqs = merged_bin * factors[lvl]
             for d in np.unique(dml):
                 m = dml == d
-                per_dm_entries[int(s * ndm_local + d)] = (
-                    bins[m], snrs[m], acc_i[m], lvl[m]
+                per_dm_groups[int(s * ndm_local + d)] = (
+                    freqs[m], merged_snr[m], acc_i[m], lvl[m]
                 )
 
         dm_cands = CandidateCollection()
         ckpt_done = {}
         for ii in range(ndm):
-            if ii not in per_dm_entries:
+            if ii not in per_dm_groups:
                 ckpt_done[ii] = []
                 continue
-            ebins, esnrs, eacc, elvl = per_dm_entries[ii]
-            cands_ii = self._entries_to_dm_cands(
-                float(self.dm_list[ii]), ii, acc_lists[ii],
-                ebins, esnrs, eacc, elvl,
-            )
+            efreq, esnr, eacc, elvl = per_dm_groups[ii]
+            dm = float(self.dm_list[ii])
+            groups = []
+            for j in range(len(acc_lists[ii])):
+                m = eacc == j
+                acc = float(acc_lists[ii][j])
+                groups.append([
+                    Candidate(dm=dm, dm_idx=ii, acc=acc, nh=int(nh),
+                              snr=float(sn), freq=float(fq))
+                    for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
+                ])
+            cands_ii = self._distill_accel_groups(groups)
             ckpt_done[ii] = cands_ii
             dm_cands.append(cands_ii)
         if ckpt:

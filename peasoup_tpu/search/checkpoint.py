@@ -81,11 +81,14 @@ class SearchCheckpoint:
         try:
             with open(self.path, "rb") as f:
                 payload = pickle.load(f)
+            if (
+                not isinstance(payload, dict)
+                or payload.get("key") != self.key
+            ):
+                return None
+            return payload["cands_by_dm"]
         except Exception:
             return None
-        if payload.get("key") != self.key:
-            return None
-        return payload["cands_by_dm"]
 
     def save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
         tmp = self.path + ".tmp"

@@ -30,7 +30,18 @@ from ..data.candidates import Candidate
 SPEED_OF_LIGHT = 299792458.0
 
 
+def _native_lib():
+    try:
+        from ..native import lib
+    except Exception:
+        return None
+    return lib
+
+
 class BaseDistiller:
+    #: native predicate id for distill_greedy, or None (numpy path only)
+    native_type: int | None = None
+
     def __init__(self, keep_related: bool):
         self.keep_related = keep_related
 
@@ -42,11 +53,26 @@ class BaseDistiller:
     def setup(self, cands: list[Candidate]) -> None:
         self.freqs = np.array([c.freq for c in cands], np.float64)
 
+    def native_args(self) -> tuple:
+        """(aux_array, max_harm, tobs_over_c) for distill_greedy."""
+        raise NotImplementedError
+
     def distill(self, cands: list[Candidate]) -> list[Candidate]:
         size = len(cands)
         # std::sort with snr-greater comparator; stable for determinism
         cands = sorted(cands, key=lambda c: -c.snr)
         self.setup(cands)
+        native = _native_lib() if self.native_type is not None else None
+        if native is not None:
+            aux, max_harm, tobs_over_c = self.native_args()
+            unique, pf, pa = native.distill_greedy(
+                self.native_type, self.freqs, aux, self.tolerance,
+                max_harm, tobs_over_c, self.keep_related,
+            )
+            if self.keep_related:
+                for fi, ai in zip(pf, pa):
+                    cands[fi].append(cands[ai])
+            return [cands[i] for i in range(size) if unique[i]]
         unique = np.ones(size, dtype=bool)
         for idx in range(size):
             if not unique[idx]:
@@ -60,12 +86,17 @@ class BaseDistiller:
 
 
 class HarmonicDistiller(BaseDistiller):
+    native_type = 0
+
     def __init__(self, tol: float, max_harm: int, keep_related: bool,
                  fractional_harms: bool = True):
         super().__init__(keep_related)
         self.tolerance = tol
         self.max_harm = int(max_harm)
         self.fractional_harms = fractional_harms
+
+    def native_args(self):
+        return self.max_denoms.astype(np.float64), self.max_harm, 0.0
 
     def setup(self, cands):
         super().setup(cands)
@@ -95,11 +126,16 @@ class HarmonicDistiller(BaseDistiller):
 
 
 class AccelerationDistiller(BaseDistiller):
+    native_type = 1
+
     def __init__(self, tobs: float, tolerance: float, keep_related: bool):
         super().__init__(keep_related)
         self.tobs = tobs
         self.tobs_over_c = tobs / SPEED_OF_LIGHT
         self.tolerance = tolerance
+
+    def native_args(self):
+        return self.accs, 0, self.tobs_over_c
 
     def setup(self, cands):
         super().setup(cands)
@@ -117,9 +153,14 @@ class AccelerationDistiller(BaseDistiller):
 
 
 class DMDistiller(BaseDistiller):
+    native_type = 2
+
     def __init__(self, tolerance: float, keep_related: bool):
         super().__init__(keep_related)
         self.tolerance = tolerance
+
+    def native_args(self):
+        return np.zeros_like(self.freqs), 0, 0.0
 
     def matches(self, idx):
         ratio = self.freqs[idx + 1 :] / self.freqs[idx]

@@ -88,8 +88,8 @@ whiten_trial = jax.jit(
 
 
 def search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
-                     min_snr):
-    tim_r = resample2(tim_w, accel, tsamp)
+                     min_snr, max_shift=None):
+    tim_r = resample2(tim_w, accel, tsamp, max_shift)
     fs = jnp.fft.rfft(tim_r).astype(jnp.complex64)
     pspec = form_interpolated(fs)
     pspec = ((pspec - mean) / std).astype(jnp.float32)
@@ -105,13 +105,16 @@ def search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
 
 @partial(
     jax.jit,
-    static_argnames=("tsamp", "nharms", "bounds", "capacity", "min_snr"),
+    static_argnames=(
+        "tsamp", "nharms", "bounds", "capacity", "min_snr", "max_shift",
+    ),
 )
 def search_accel_chunk(tim_w, accels, mean, std, tsamp, nharms, bounds,
-                       capacity, min_snr):
+                       capacity, min_snr, max_shift=None):
     """vmapped acceleration-trial batch: (chunk,) accels -> peak buffers."""
     fn = lambda a: search_one_accel(
-        tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr
+        tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr,
+        max_shift,
     )
     return jax.vmap(fn)(accels)
 
@@ -151,6 +154,12 @@ class PulsarSearch:
         self.acc_plan = AccelerationPlan(
             config.acc_start, config.acc_end, config.acc_tol,
             config.acc_pulse_width, self.size, hdr.tsamp, hdr.cfreq, hdr.foff,
+        )
+        from ..ops.resample import resample2_max_shift
+
+        self.max_shift = resample2_max_shift(
+            max(abs(config.acc_start), abs(config.acc_end)),
+            hdr.tsamp, self.size,
         )
         self.killmask = None
         if config.killfilename:
@@ -217,6 +226,7 @@ class PulsarSearch:
             idxs, snrs, counts = search_accel_chunk(
                 tim_w, batch, mean, std, float(self.fil.tsamp),
                 cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
+                self.max_shift,
             )
             all_idxs.append(np.asarray(idxs))
             all_snrs.append(np.asarray(snrs))
@@ -418,7 +428,16 @@ def _batched_fold_program(
         subints = fold_time_series_core(tim_r, period, tsamp, nbins, nints)
         return optimise_device(subints)
 
-    return jax.vmap(one)(dm_idxs, accs, periods)
+    argmaxes, opt_folds, opt_profs = jax.vmap(one)(dm_idxs, accs, periods)
+    # one packed f32 buffer -> a single device->host round trip
+    ncand = dm_idxs.shape[0]
+    return jnp.concatenate([
+        jax.lax.bitcast_convert_type(
+            argmaxes.astype(jnp.int32), jnp.float32
+        ),
+        opt_folds.reshape(ncand * nints * nbins),
+        opt_profs.reshape(ncand * nbins),
+    ])
 
 
 def fold_candidates(
@@ -466,13 +485,14 @@ def fold_candidates(
     periods = jnp.asarray(
         [1.0 / cands[i].freq for i in fold_ids], jnp.float32
     )
-    argmaxes, opt_folds, opt_profs = _batched_fold_program(
+    packed = np.asarray(_batched_fold_program(
         trials, dm_idxs, accs, periods, bin_width, nsamps, float(tsamp),
         nbins, nints,
-    )
-    argmaxes = np.asarray(argmaxes)
-    opt_folds = np.asarray(opt_folds)
-    opt_profs = np.asarray(opt_profs)
+    ))
+    n = len(fold_ids)
+    argmaxes = packed[:n].view(np.int32)
+    opt_folds = packed[n : n + n * nints * nbins].reshape(n, nints, nbins)
+    opt_profs = packed[n + n * nints * nbins :].reshape(n, nbins)
     for k, ci in enumerate(fold_ids):
         cand = cands[ci]
         period = 1.0 / cand.freq

@@ -1,0 +1,56 @@
+"""DelayFinder tests (`include/transforms/correlator.hpp:33-92`)."""
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.ops.correlate import distance_to_lag, find_delays
+
+
+def _delayed(base, lag):
+    """x_j(t) = x_i(t - lag) -> correlation peaks at +lag."""
+    return np.roll(base, lag)
+
+
+def test_recovers_known_delays():
+    rng = np.random.default_rng(0)
+    size, md = 4096, 64
+    base = rng.normal(size=size)
+    arrays = np.stack([
+        base,
+        _delayed(base, 5),
+        _delayed(base, -17),
+    ]).astype(np.complex64)
+    out = find_delays(arrays, md)
+    assert len(out) == 3  # baselines (0,1), (0,2), (1,2)
+    by_pair = {(r["i"], r["j"]): r for r in out}
+    assert by_pair[(0, 1)]["lag"] == 5
+    assert by_pair[(0, 2)]["lag"] == -17
+    assert by_pair[(1, 2)]["lag"] == -22
+
+    # distance is the raw window index the reference prints
+    assert by_pair[(0, 1)]["distance"] == 5
+    assert by_pair[(0, 2)]["distance"] == 2 * md - 17
+
+
+def test_distance_to_lag_window_mapping():
+    assert distance_to_lag(0, 32) == 0
+    assert distance_to_lag(31, 32) == 31
+    assert distance_to_lag(32, 32) == -32
+    assert distance_to_lag(63, 32) == -1
+
+
+def test_matches_numpy_reference():
+    """Window power must equal a direct numpy correlation."""
+    rng = np.random.default_rng(3)
+    size, md = 1024, 16
+    a = rng.normal(size=size) + 1j * rng.normal(size=size)
+    b = rng.normal(size=size) + 1j * rng.normal(size=size)
+    corr = np.fft.ifft(np.conj(np.fft.fft(a)) * np.fft.fft(b))
+    window = np.concatenate([corr[:md], corr[-md:]])
+    want = int(np.argmax(np.abs(window) ** 2))
+    out = find_delays(np.stack([a, b]).astype(np.complex64), md)
+    assert out[0]["distance"] == want
+
+
+def test_no_baselines_for_single_antenna():
+    assert find_delays(np.zeros((1, 128), np.complex64), 8) == []

@@ -106,6 +106,59 @@ class TestDistillers:
         out = HarmonicDistiller(1e-4, 16, False).distill(cands)
         assert [c.snr for c in out] == [30.0, 20.0, 10.0]
 
+    @pytest.mark.parametrize("seed", range(5))
+    def test_native_distill_matches_numpy(self, seed, monkeypatch):
+        """The native greedy distill must be bit-identical to the numpy
+        matches() path, assoc structure included."""
+        rng = np.random.default_rng(seed)
+        n = 120
+        base = rng.uniform(1.0, 30.0, 8)
+        freqs = np.concatenate([
+            b * rng.integers(1, 5, 15) * (1 + rng.normal(0, 3e-5, 15))
+            for b in base
+        ])
+
+        def mk_set():
+            return [
+                self.mk(float(f), float(s), acc=float(a), nh=int(h))
+                for f, s, a, h in zip(
+                    freqs,
+                    rng.permutation(np.linspace(10, 90, n)),
+                    rng.choice([0.0, -5.0, 5.0], n),
+                    rng.integers(0, 5, n),
+                )
+            ]
+
+        rng2 = np.random.default_rng(seed)  # same draws for both sets
+        rng, saved = rng2, rng
+        a_set = mk_set()
+        rng = saved
+        b_set = [
+            Candidate(dm=c.dm, dm_idx=c.dm_idx, acc=c.acc, nh=c.nh,
+                      snr=c.snr, freq=c.freq)
+            for c in a_set
+        ]
+
+        import peasoup_tpu.search.distill as dst
+
+        for cls, args in [
+            (HarmonicDistiller, (1e-4, 16, True)),
+            (AccelerationDistiller, (41.94, 1e-4, True)),
+            (DMDistiller, (1e-4, True)),
+        ]:
+            a_in = [c for c in a_set]
+            b_in = [c for c in b_set]
+            native_out = cls(*args).distill(a_in)
+            monkeypatch.setattr(dst, "_native_lib", lambda: None)
+            numpy_out = cls(*args).distill(b_in)
+            monkeypatch.undo()
+            assert len(native_out) == len(numpy_out)
+            for x, y in zip(native_out, numpy_out):
+                assert x.freq == y.freq and x.snr == y.snr
+                assert x.count_assoc() == y.count_assoc()
+            for c in a_set + b_set:
+                c.assoc = []
+
 
 class TestScorer:
     def test_scoring(self):
