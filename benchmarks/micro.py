@@ -1,0 +1,101 @@
+"""Micro-benchmarks mirroring the reference's ad-hoc perf harnesses.
+
+* fft:  2^23-point R2C+C2R round trip, mean over N iters
+  (`src/hcfft.cpp:14-42`)
+* hsum: 10^7-bin spectrum, 4 harmonic-sum levels, N reps
+  (`src/harmonic_sum_test.cpp:13,35-36`)
+* resample: 2^23-point accel resample (select path), N reps
+
+Run: python benchmarks/micro.py [fft|hsum|resample|all] [iters]
+Prints one JSON line per benchmark.  Timing is taken at the host fetch
+of a scalar reduction — on remote-attached TPUs dispatch is lazy and
+``block_until_ready`` can return before execution.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _time(fn, iters):
+    fn()  # compile
+    t0 = time.time()
+    for _ in range(iters):
+        fn()
+    return (time.time() - t0) / iters
+
+
+def bench_fft(iters):
+    import jax
+    import jax.numpy as jnp
+
+    n = 1 << 23
+    x = jax.device_put(
+        np.random.default_rng(0).normal(size=n).astype(np.float32)
+    )
+    f = jax.jit(lambda v: jnp.fft.irfft(jnp.fft.rfft(v), n=n).sum())
+    return {"metric": "fft_r2c_c2r_2e23_roundtrip",
+            "value": round(_time(lambda: float(f(x)), iters) * 1e3, 3),
+            "unit": "ms"}
+
+
+def bench_hsum(iters):
+    import jax
+    import jax.numpy as jnp
+
+    from peasoup_tpu.ops import harmonic_sums
+
+    n = 10_000_000
+    spec = jax.device_put(
+        np.random.default_rng(0).normal(size=n).astype(np.float32)
+    )
+    f = jax.jit(lambda s: sum(h.sum() for h in harmonic_sums(s, 4)))
+    return {"metric": "harmonic_sum_1e7_4levels",
+            "value": round(_time(lambda: float(f(spec)), iters) * 1e3, 3),
+            "unit": "ms"}
+
+
+def bench_resample(iters):
+    import jax
+    import jax.numpy as jnp
+
+    from peasoup_tpu.ops.resample import resample2, resample2_max_shift
+
+    n = 1 << 23
+    tsamp = 6.4e-5
+    ms = resample2_max_shift(5.0, tsamp, n)
+    tim = jax.device_put(
+        np.random.default_rng(0).normal(size=n).astype(np.float32)
+    )
+    f = jax.jit(lambda t: resample2(t, 5.0, tsamp, ms).sum())
+    return {"metric": "resample2_2e23",
+            "value": round(_time(lambda: float(f(tim)), iters) * 1e3, 3),
+            "unit": "ms"}
+
+
+BENCHES = {"fft": bench_fft, "hsum": bench_hsum, "resample": bench_resample}
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    which = args[0] if args else "all"
+    iters = int(args[1]) if len(args) > 1 else 20
+    if which != "all" and which not in BENCHES:
+        print(f"unknown benchmark '{which}'; choose from: "
+              f"{', '.join(BENCHES)}, all", file=sys.stderr)
+        return 1
+    names = list(BENCHES) if which == "all" else [which]
+    for name in names:
+        print(json.dumps(BENCHES[name](iters)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

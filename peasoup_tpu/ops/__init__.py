@@ -8,7 +8,7 @@ from .dedisperse import (
 from .spectrum import form_power, form_interpolated
 from .rednoise import median_scrunch5, linear_stretch, running_median, deredden
 from .zap import zap_birdies, load_zaplist
-from .stats import mean_rms_std, normalise
+from .stats import mean_rms_std, normalise, normalise_spectrum, transpose
 from .resample import resample, resample2
 from .harmonics import harmonic_sums
 from .peaks import (

@@ -21,3 +21,24 @@ def mean_rms_std(x: jnp.ndarray, min_bin: int = 0):
 
 def normalise(x: jnp.ndarray, mean, sigma) -> jnp.ndarray:
     return ((x - mean) / sigma).astype(jnp.float32)
+
+
+def normalise_spectrum(
+    x: jnp.ndarray, sigma: float | None = None, min_bin: int = 0
+) -> jnp.ndarray:
+    """Legacy divide-by-sigma normalisation
+    (`src/kernels.cu:499-522`, unused by the shipped reference binary):
+    sigma is computed from the spectrum's own mean/rms above ``min_bin``
+    when not supplied, and every bin is divided by it (no mean
+    subtraction)."""
+    if sigma is None:
+        _, _, sigma = mean_rms_std(x, min_bin)
+    return (x / sigma).astype(jnp.float32)
+
+
+def transpose(block: jnp.ndarray) -> jnp.ndarray:
+    """2-D transpose (`include/transforms/transpose.hpp:30-263`, the
+    tiled Barsdell kernel, unused by the shipped pipelines).  On TPU a
+    plain ``jnp.transpose`` lowers to XLA's native layout swap; the
+    hand-tiled shared-memory scheme has no equivalent to port."""
+    return jnp.transpose(block)

@@ -47,6 +47,24 @@ from ..io.unpack import pack_bits
 from ..ops.peaks import segmented_unique_peaks
 
 
+def fetch_to_host(arr) -> np.ndarray:
+    """Device->host fetch that works on multi-host (global) arrays.
+
+    A plain ``np.asarray`` raises on arrays spanning non-addressable
+    devices; in that case every process all-gathers the global value
+    over ICI/DCN first (`jax.experimental.multihost_utils`)."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    if all(
+        d.process_index == jax.process_index()
+        for d in arr.sharding.device_set
+    ):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
     devs = jax.devices()
     if max_devices:

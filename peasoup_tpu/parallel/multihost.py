@@ -1,0 +1,65 @@
+"""Multi-host execution entry point.
+
+The reference's cross-device story stops at one host: a pthread pool
+over local GPUs with a shared-memory merge (`src/pipeline_multi.cu:
+33-81,356-359`) and no NCCL/MPI.  The TPU build scales past one host
+with the standard JAX SPMD recipe instead:
+
+1. every host calls :func:`initialize` (jax.distributed) at startup;
+2. :func:`global_mesh` builds a ``Mesh`` over ALL devices in the slice
+   (ICI within a host/pod, DCN across pods — XLA routes collectives);
+3. ``MeshPulsarSearch`` runs unchanged on that mesh: the DM axis is
+   sharded globally, and the single packed peak buffer per shard is
+   gathered to every host by the same ``np.asarray`` fetch (an
+   all-gather over ICI/DCN under the hood);
+4. each host runs the identical (deterministic) distillation, so the
+   outputs agree without any explicit broadcast.
+
+Single-chip CI cannot exercise real multi-host runs; the mesh semantics
+are validated on the virtual multi-device CPU mesh (tests/conftest.py)
+and by the driver's ``dryrun_multichip``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bring up jax.distributed (no-op if already initialised).
+
+    On TPU pods the three arguments are auto-detected from the
+    environment; pass them explicitly elsewhere.
+    """
+    import os
+
+    import jax
+
+    auto_detectable = any(
+        v in os.environ
+        for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+                  "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and not auto_detectable:
+        # plain single-process run: nothing to initialise
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError):
+        # already initialised, or the environment cannot support a
+        # coordinator: fall back to single-process execution
+        pass
+
+
+def global_mesh(axis: str = "dm"):
+    """1-D mesh over every device of every participating host."""
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), (axis,))

@@ -163,6 +163,40 @@ def test_resample_kernels_match_numpy(accel):
     np.testing.assert_array_equal(out2, _resample_numpy(tim, accel, tsamp, 2))
 
 
+@pytest.mark.parametrize("accel", [125.5, -125.5, 5.0, 0.0])
+def test_resample2_select_path_matches_gather(accel):
+    from peasoup_tpu.ops.resample import resample2_max_shift
+
+    n = 1 << 16
+    tim = rng.normal(size=n).astype(np.float32)
+    tsamp = 0.000064
+    ms = resample2_max_shift(accel, tsamp, n)
+    gathered = np.asarray(resample2(jnp.asarray(tim), accel, tsamp))
+    if ms <= 64:
+        selected = np.asarray(
+            resample2(jnp.asarray(tim), accel, tsamp, ms)
+        )
+        np.testing.assert_array_equal(selected, gathered)
+
+
+def test_normalise_spectrum_legacy():
+    from peasoup_tpu.ops import normalise_spectrum
+
+    x = rng.normal(loc=5.0, scale=2.0, size=4096).astype(np.float32)
+    out = np.asarray(normalise_spectrum(jnp.asarray(x)))
+    _, _, std = mean_rms_std(jnp.asarray(x))
+    np.testing.assert_allclose(out, x / float(std), rtol=1e-6)
+    out2 = np.asarray(normalise_spectrum(jnp.asarray(x), sigma=2.0))
+    np.testing.assert_allclose(out2, x / 2.0, rtol=1e-6)
+
+
+def test_transpose_op():
+    from peasoup_tpu.ops import transpose
+
+    x = rng.normal(size=(17, 33)).astype(np.float32)
+    np.testing.assert_array_equal(np.asarray(transpose(jnp.asarray(x))), x.T)
+
+
 def test_resample_zero_accel_is_identity():
     n = 4096
     tim = rng.normal(size=n).astype(np.float32)
