@@ -1,21 +1,15 @@
 import os
+import sys
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
-# exercised without TPU hardware. Must be set before jax import.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# exercised without TPU hardware; the forcing recipe (env vars before jax
+# import, live-config fallback after, backend reset) is shared with the
+# driver's dryrun entry point.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _force_virtual_cpu_mesh  # noqa: E402
 
-# jax may already have been imported by the host's sitecustomize (which
-# registers a TPU plugin), making the env vars above too late — force the
-# platform through the live config instead.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+_force_virtual_cpu_mesh(8)
 
 import pytest  # noqa: E402
 
