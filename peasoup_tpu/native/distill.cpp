@@ -35,18 +35,34 @@ size_t distill_greedy(int type, const double* freqs, const double* aux,
             const double f = freqs[ii];
             bool hit = false;
             if (type == 0) {
+                // the reference appends one assoc entry PER matching
+                // (j,k) combination (distiller.hpp:91-100) — assoc
+                // multiplicity feeds ddm ratios, so no short-circuit
                 const int64_t max_denom = static_cast<int64_t>(aux[ii]);
-                for (int64_t j = 1; j <= max_harm && !hit; ++j) {
+                for (int64_t j = 1; j <= max_harm; ++j) {
                     for (int64_t k = 1; k <= max_denom; ++k) {
                         const double ratio =
                             static_cast<double>(k) * f /
                             (static_cast<double>(j) * f0);
                         if (ratio > lower && ratio < upper) {
                             hit = true;
-                            break;
+                            if (record_pairs) {
+                                if (npairs < pair_capacity) {
+                                    pair_fundi[npairs] =
+                                        static_cast<int64_t>(idx);
+                                    pair_absorbed[npairs] =
+                                        static_cast<int64_t>(ii);
+                                }
+                                ++npairs;
+                            }
                         }
                     }
+                    // multiplicity only matters when recording pairs;
+                    // otherwise first hit decides and the grid can stop
+                    if (hit && !record_pairs) break;
                 }
+                if (hit) unique[ii] = 0;
+                continue;
             } else if (type == 1) {
                 const double delta_acc = aux[idx] - aux[ii];
                 const double fa = f0 + delta_acc * f0 * tobs_over_c;

@@ -47,22 +47,7 @@ from ..io.unpack import pack_bits
 from ..ops.peaks import segmented_unique_peaks
 
 
-def fetch_to_host(arr) -> np.ndarray:
-    """Device->host fetch that works on multi-host (global) arrays.
-
-    A plain ``np.asarray`` raises on arrays spanning non-addressable
-    devices; in that case every process all-gathers the global value
-    over ICI/DCN first (`jax.experimental.multihost_utils`)."""
-    if isinstance(arr, np.ndarray):
-        return arr
-    if all(
-        d.process_index == jax.process_index()
-        for d in arr.sharding.device_set
-    ):
-        return np.asarray(arr)
-    from jax.experimental import multihost_utils
-
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+from ..utils.hostfetch import fetch_to_host  # re-exported; also used below
 
 
 def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
@@ -408,8 +393,8 @@ class MeshPulsarSearch(PulsarSearch):
         with trace_range("Fused-Search"):
             inputs = self._device_inputs(acc_lists, ndm_p, namax)
             packed, trials = program(*inputs)
-            # ONE gather over ICI -> host; ``trials`` stays on device
-            packed = np.asarray(packed)
+            # ONE gather over ICI/DCN -> host; ``trials`` stays on device
+            packed = fetch_to_host(packed)
         nspec_local = ndm_local * namax * nlevels
         blk_len = 2 * compact_k + nspec_local + 1
         sel_bin = np.empty(ndev * compact_k, np.int32)

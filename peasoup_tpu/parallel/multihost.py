@@ -10,8 +10,9 @@ with the standard JAX SPMD recipe instead:
    (ICI within a host/pod, DCN across pods — XLA routes collectives);
 3. ``MeshPulsarSearch`` runs unchanged on that mesh: the DM axis is
    sharded globally, and the single packed peak buffer per shard is
-   gathered to every host by the same ``np.asarray`` fetch (an
-   all-gather over ICI/DCN under the hood);
+   gathered to every host by ``fetch_to_host`` (a
+   ``multihost_utils.process_allgather`` over ICI/DCN when the array
+   spans non-addressable devices);
 4. each host runs the identical (deterministic) distillation, so the
    outputs agree without any explicit broadcast.
 

@@ -9,18 +9,23 @@ the same input and configuration resumes instead of recomputing.
 
 The checkpoint key ties the file to the exact search: input path,
 filterbank geometry, and every ``SearchConfig`` field.  A key mismatch
-silently invalidates the checkpoint (the search simply runs afresh).
+invalidates the checkpoint with a warning (the search runs afresh).
 """
 
 from __future__ import annotations
 
+import json
 import os
-import pickle
+import warnings
 from dataclasses import asdict
+
+import numpy as np
 
 from ..data.candidates import Candidate
 
-_FORMAT_VERSION = 1
+# v2: JSON payload (v1 was pickle — dropped because unpickling a
+# user-named file executes arbitrary code on a substituted checkpoint)
+_FORMAT_VERSION = 2
 
 
 # presentation/runtime knobs that do not change the search's results
@@ -65,8 +70,46 @@ def search_key(infile: str, fil, config) -> str:
     ))
 
 
+def _cand_to_obj(c: Candidate) -> dict:
+    """Candidate -> JSON-safe dict (recursive over assoc)."""
+    obj = {
+        "dm": c.dm, "dm_idx": c.dm_idx, "acc": c.acc, "nh": c.nh,
+        "snr": c.snr, "freq": c.freq, "folded_snr": c.folded_snr,
+        "opt_period": c.opt_period, "is_adjacent": c.is_adjacent,
+        "is_physical": c.is_physical,
+        "ddm_count_ratio": c.ddm_count_ratio,
+        "ddm_snr_ratio": c.ddm_snr_ratio,
+        "nbins": c.nbins, "nints": c.nints,
+        "assoc": [_cand_to_obj(a) for a in c.assoc],
+    }
+    if c.fold is not None:
+        obj["fold"] = np.asarray(c.fold, np.float32).tolist()
+    return obj
+
+
+def _cand_from_obj(obj: dict) -> Candidate:
+    assoc = [_cand_from_obj(a) for a in obj.get("assoc", [])]
+    fold = obj.get("fold")
+    return Candidate(
+        dm=float(obj["dm"]), dm_idx=int(obj["dm_idx"]),
+        acc=float(obj["acc"]), nh=int(obj["nh"]), snr=float(obj["snr"]),
+        freq=float(obj["freq"]), folded_snr=float(obj["folded_snr"]),
+        opt_period=float(obj["opt_period"]),
+        is_adjacent=bool(obj["is_adjacent"]),
+        is_physical=bool(obj["is_physical"]),
+        ddm_count_ratio=float(obj["ddm_count_ratio"]),
+        ddm_snr_ratio=float(obj["ddm_snr_ratio"]),
+        nbins=int(obj["nbins"]), nints=int(obj["nints"]),
+        assoc=assoc,
+        fold=None if fold is None else np.asarray(fold, np.float32),
+    )
+
+
 class SearchCheckpoint:
-    """Atomic pickle checkpoint of {dm_idx: [Candidate]} progress."""
+    """Atomic JSON checkpoint of {dm_idx: [Candidate]} progress.
+
+    JSON, not pickle: the path is user-named, and unpickling a
+    corrupted or substituted file would execute arbitrary code."""
 
     def __init__(self, path: str, key: str, interval: int = 8):
         self.path = path
@@ -79,27 +122,56 @@ class SearchCheckpoint:
         if not self.path or not os.path.exists(self.path):
             return None
         try:
-            with open(self.path, "rb") as f:
-                payload = pickle.load(f)
-            if (
-                not isinstance(payload, dict)
-                or payload.get("key") != self.key
-            ):
-                return None
-            return payload["cands_by_dm"]
-        except Exception:
+            with open(self.path) as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError("payload is not a dict")
+        except Exception as exc:
+            warnings.warn(
+                f"ignoring unreadable checkpoint {self.path!r}: {exc}"
+            )
+            return None
+        if payload.get("version") != _FORMAT_VERSION:
+            warnings.warn(
+                f"ignoring checkpoint {self.path!r}: format version "
+                f"{payload.get('version')} != {_FORMAT_VERSION}"
+            )
+            return None
+        if payload.get("key") != self.key:
+            warnings.warn(
+                f"ignoring checkpoint {self.path!r}: it belongs to a "
+                "different search (input/config mismatch)"
+            )
+            return None
+        try:
+            return {
+                int(k): [_cand_from_obj(o) for o in v]
+                for k, v in payload["cands_by_dm"].items()
+            }
+        except Exception as exc:
+            warnings.warn(
+                f"ignoring corrupt checkpoint {self.path!r}: {exc}"
+            )
             return None
 
     def save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
         tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"key": self.key, "cands_by_dm": cands_by_dm}, f)
+        payload = {
+            "version": _FORMAT_VERSION,
+            "key": self.key,
+            "cands_by_dm": {
+                str(k): [_cand_to_obj(c) for c in v]
+                for k, v in cands_by_dm.items()
+            },
+        }
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
         os.replace(tmp, self.path)
 
     def maybe_save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
         """Save every ``interval`` calls (host-loop cadence control).
 
-        Each save re-pickles the whole accumulated dict, so total
+        Each save re-serialises the whole accumulated dict, so total
         checkpoint I/O over a run is O(ndm^2 / interval); keep
         ``interval`` >= the default for searches with many DM trials
         (interval=1 is for tests/tiny runs).

@@ -91,13 +91,22 @@ def run_coincidencer(
 ) -> tuple[np.ndarray, np.ndarray, float]:
     """Full coincidencer; returns (samp_mask, spec_mask, bin_width)."""
     tims = []
+    # tsamp comes from the FIRST beam like the reference
+    # (`src/coincidencer.cpp` uses filobjs[0]); mismatched beams would
+    # silently skew bin_width, so they are an error here
     tsamp = None
     for fn in filenames:
         if cfg.verbose:
             print(f"Reading and dedispersing {fn}")
         fil = read_filterbank(fn)
         tims.append(dedisperse_dm0(fil))
-        tsamp = float(fil.tsamp)
+        if tsamp is None:
+            tsamp = float(fil.tsamp)
+        elif float(fil.tsamp) != tsamp:
+            raise ValueError(
+                f"tsamp mismatch across beams: {fn} has {fil.tsamp}, "
+                f"first beam has {tsamp}"
+            )
     size = len(tims[0])
     for fn, t in zip(filenames, tims):
         if len(t) != size:

@@ -50,6 +50,13 @@ class BaseDistiller:
         absorbs."""
         raise NotImplementedError
 
+    def match_counts(self, idx: int) -> np.ndarray:
+        """Int array over candidates idx+1..: how many times each is
+        absorbed (the reference appends one assoc entry per matching
+        predicate combination — only >1 for the harmonic distiller's
+        (j,k) grid, `distiller.hpp:91-100`)."""
+        return self.matches(idx).astype(np.int64)
+
     def setup(self, cands: list[Candidate]) -> None:
         self.freqs = np.array([c.freq for c in cands], np.float64)
 
@@ -77,10 +84,12 @@ class BaseDistiller:
         for idx in range(size):
             if not unique[idx]:
                 continue
-            hit = np.nonzero(self.matches(idx))[0] + idx + 1
+            counts = self.match_counts(idx)
+            hit = np.nonzero(counts)[0] + idx + 1
             if self.keep_related:
                 for ii in hit:
-                    cands[idx].append(cands[ii])
+                    for _ in range(int(counts[ii - idx - 1])):
+                        cands[idx].append(cands[ii])
             unique[hit] = False
         return [cands[i] for i in range(size) if unique[i]]
 
@@ -111,7 +120,7 @@ class HarmonicDistiller(BaseDistiller):
         self.jj = np.arange(1, self.max_harm + 1, dtype=np.float64)
         self.kk = np.arange(1, kmax + 1, dtype=np.float64)
 
-    def matches(self, idx):
+    def _ok_grid(self, idx):
         fundi_freq = self.freqs[idx]
         freqs = self.freqs[idx + 1 :]
         # ratio[i, k, j] = kk[k] * f_i / (jj[j] * f0)
@@ -122,7 +131,14 @@ class HarmonicDistiller(BaseDistiller):
         )
         ok = (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
         ok &= self.kk[None, :, None] <= self.max_denoms[idx + 1 :, None, None]
-        return ok.any(axis=(1, 2))
+        return ok
+
+    def matches(self, idx):
+        return self._ok_grid(idx).any(axis=(1, 2))
+
+    def match_counts(self, idx):
+        # one absorption per matching (j,k), like distiller.hpp:91-100
+        return self._ok_grid(idx).sum(axis=(1, 2))
 
 
 class AccelerationDistiller(BaseDistiller):

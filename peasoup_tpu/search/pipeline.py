@@ -456,18 +456,12 @@ def fold_candidates(
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
     by max(snr, folded_snr) (`folder.hpp:424-434,25-31`)."""
     # both drivers hand over trials with >= prev_power_of_two(
-    # trials_nsamps) real columns, so this clamp is a guard only; if a
-    # future caller passes narrower trials the fold FFT length would
-    # silently stop being the reference's power of two — hence the check
+    # trials_nsamps) real columns; a narrower caller gets zero-padded
+    # so the fold FFT length stays the reference's power of two
+    # (matching the old DeviceTimeSeries zero-fill semantics)
     nsamps = prev_power_of_two(trials_nsamps)
     if nsamps > trials.shape[1]:
-        import warnings
-
-        warnings.warn(
-            f"trials narrower than the fold length ({trials.shape[1]} < "
-            f"{nsamps}); folding on a non-reference FFT length"
-        )
-        nsamps = trials.shape[1]
+        trials = jnp.pad(trials, ((0, 0), (0, nsamps - trials.shape[1])))
     tobs = nsamps * tsamp
     bin_width = 1.0 / tobs
     fold_ids = [
@@ -485,7 +479,9 @@ def fold_candidates(
     periods = jnp.asarray(
         [1.0 / cands[i].freq for i in fold_ids], jnp.float32
     )
-    packed = np.asarray(_batched_fold_program(
+    from ..utils.hostfetch import fetch_to_host
+
+    packed = fetch_to_host(_batched_fold_program(
         trials, dm_idxs, accs, periods, bin_width, nsamps, float(tsamp),
         nbins, nints,
     ))

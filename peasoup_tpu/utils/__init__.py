@@ -6,5 +6,9 @@ itself lives in each driver's ``timers`` dict)."""
 
 from .progress import ProgressBar
 from .tracing import trace_range, start_trace, stop_trace
+from .hostfetch import fetch_to_host
 
-__all__ = ["ProgressBar", "trace_range", "start_trace", "stop_trace"]
+__all__ = [
+    "ProgressBar", "trace_range", "start_trace", "stop_trace",
+    "fetch_to_host",
+]

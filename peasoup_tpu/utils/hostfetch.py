@@ -1,0 +1,27 @@
+"""Device->host fetch that works on multi-host (global) arrays."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fetch_to_host(arr) -> np.ndarray:
+    """Fetch a jax array to host memory, multi-host safe.
+
+    A plain ``np.asarray`` raises on arrays spanning non-addressable
+    devices; in that case every process all-gathers the global value
+    over ICI/DCN first (`jax.experimental.multihost_utils`).  This is
+    the TPU-native replacement for the reference's pthread-join +
+    append merge (`src/pipeline_multi.cu:356-359`)."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    import jax
+
+    if all(
+        d.process_index == jax.process_index()
+        for d in arr.sharding.device_set
+    ):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

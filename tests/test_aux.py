@@ -90,11 +90,18 @@ def test_checkpoint_key_invalidation(tutorial_fil, tmp_path):
     c = SearchCheckpoint(ck, key_a)
     c.save({0: []})
     assert c.load() == {0: []}
-    # different search params -> different key -> stale checkpoint ignored
+    # different search params -> different key -> stale checkpoint
+    # ignored, LOUDLY (a silent reject would look like a fresh run)
     cfg_b = SearchConfig(checkpoint_file=ck, **{**CFG, "dm_end": 60.0})
     key_b = search_key("", fil, cfg_b)
     assert key_a != key_b
-    assert SearchCheckpoint(ck, key_b).load() is None
+    with pytest.warns(UserWarning, match="different search"):
+        assert SearchCheckpoint(ck, key_b).load() is None
+    # a corrupt (non-JSON) file is rejected with a warning, not an error
+    with open(ck, "w") as f:
+        f.write("\x00garbage")
+    with pytest.warns(UserWarning, match="unreadable"):
+        assert SearchCheckpoint(ck, key_a).load() is None
     # presentation-only knobs do not invalidate
     cfg_c = SearchConfig(checkpoint_file=ck, verbose=True, **CFG)
     assert search_key("", fil, cfg_c) == key_a
