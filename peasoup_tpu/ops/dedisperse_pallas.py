@@ -1,0 +1,204 @@
+"""Tiled Pallas dedispersion kernel for real channel counts.
+
+The XLA formulation in :mod:`peasoup_tpu.ops.dedisperse` scans channels
+sequentially with the (ndm, out_nsamps) accumulator living in HBM, so
+its traffic is ``nchans * ndm * out_nsamps * 8`` bytes — fine for the
+64-channel tutorial file, catastrophic at 1024-4096 channels (the scale
+``libdedisp`` handles inside `include/transforms/dedisperser.hpp:104-112`).
+
+This kernel keeps a (DM_TILE, TIME_TILE) accumulator in VMEM and
+streams the input past it once per DM tile:
+
+* grid = (ndm / DM_TILE, out_nsamps / TIME_TILE);
+* per program, channels are processed in groups of CHAN_GROUP; each
+  group's samples for the whole DM tile live in one rectangular window
+  ``data[g0:g0+G, t0 + min_delay : t0 + min_delay + TIME_TILE + slack]``
+  (delays vary smoothly across both channels and neighbouring DM
+  trials, so the window height ``slack`` is small), DMA'd HBM->VMEM
+  with double buffering;
+* the inner loop adds dynamically-shifted window rows into the
+  accumulator rows — the only data-dependent addressing left, and it
+  is VMEM-resident.
+
+HBM traffic drops to ``(ndm / DM_TILE) * nchans * nsamps`` input reads
+plus one output write — DM_TILE x less than the scan — and the kernel
+becomes VPU-add bound (the algorithm's inherent ndm*nchans*T adds).
+
+Input may be float32 or uint8 (8-bit filterbanks stay packed in HBM;
+the f32 conversion happens on VMEM tiles, reference analogue
+`src/kernels.cu:1144-1171` conversion_kernel).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def dedisperse_window_slack(
+    delays: np.ndarray, dm_tile: int, chan_group: int
+) -> int:
+    """Static bound on (max - min) delay within any (dm_tile, chan_group)
+    block of the delay table, rounded up to a lane multiple.
+
+    This is the extra window width the kernel DMAs per channel group so
+    that every row's shifted slice lands inside VMEM.
+    """
+    delays = np.asarray(delays)
+    ndm, nchans = delays.shape
+    slack = 0
+    for i0 in range(0, ndm, dm_tile):
+        blk = delays[i0 : i0 + dm_tile]
+        for g0 in range(0, nchans, chan_group):
+            sub = blk[:, g0 : g0 + chan_group]
+            slack = max(slack, int(sub.max()) - int(sub.min()))
+    return -(-(slack + 1) // 128) * 128  # pad + round up to 128
+
+
+def _dedisperse_kernel(
+    delays_ref, data_ref, out_ref, win_ref, sem_ref,
+    *, dm_tile, time_tile, chan_group, slack, nchans, nsamps,
+):
+    T, G, S = time_tile, chan_group, slack
+    W = T + S
+    t0 = pl.program_id(1) * T
+    ngroups = nchans // G
+
+    # the wrapper pads the input so every window [t0+dmin, t0+dmin+W)
+    # is in bounds — no clamping, so per-(d,c) offsets stay exact
+    def group_start(g):
+        return t0 + jnp.min(delays_ref[:, pl.ds(g * G, G)])
+
+    def group_dma(slot, g):
+        return pltpu.make_async_copy(
+            data_ref.at[pl.ds(g * G, G), pl.ds(group_start(g), W)],
+            win_ref.at[slot],
+            sem_ref.at[slot],
+        )
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+    group_dma(0, 0).start()
+
+    def group_body(g, _):
+        slot = g % 2
+
+        @pl.when(g + 1 < ngroups)
+        def _():
+            group_dma((g + 1) % 2, g + 1).start()
+
+        group_dma(slot, g).wait()
+        start = group_start(g)
+
+        def d_body(d, _):
+            def c_body(c, acc):
+                off = t0 + delays_ref[d, g * G + c] - start
+                w = win_ref[slot, c, pl.ds(off, T)]
+                if w.dtype == jnp.uint8:
+                    w = w.astype(jnp.int32)  # Mosaic has no u8->f32 cast
+                return acc + w.astype(jnp.float32)
+
+            row = jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(G), c_body,
+                jnp.zeros((T,), jnp.float32),
+            )
+            out_ref[d, :] += row
+            return 0
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(dm_tile), d_body, 0)
+        return 0
+
+    # int32 bounds: under jax_enable_x64 python-int bounds make the
+    # index i64, which Mosaic's memref slicing rejects
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(ngroups), group_body, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "out_nsamps", "window_slack", "dm_tile", "time_tile",
+        "chan_group", "interpret",
+    ),
+)
+def dedisperse_pallas(
+    data: jax.Array,
+    delays: jax.Array,
+    out_nsamps: int,
+    *,
+    window_slack: int,
+    dm_tile: int = 32,
+    time_tile: int = 8192,
+    chan_group: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dedisperse with the tiled VMEM-accumulator kernel.
+
+    Args:
+        data: (nchans, nsamps) float32 or uint8, channel-major, already
+            killmask-multiplied.
+        delays: (ndm, nchans) int32 sample delays.
+        out_nsamps: output samples per trial (nsamps - max_delay).
+        window_slack: static per-(tile, group) delay spread bound from
+            :func:`dedisperse_window_slack` (must be computed from the
+            same dm_tile/chan_group).
+        interpret: run the interpreter (CPU tests).
+
+    Returns:
+        (ndm, out_nsamps) float32.
+    """
+    ndm, nchans = delays.shape
+    nsamps = data.shape[1]
+    if nchans % chan_group:
+        raise ValueError(f"{nchans=} not a multiple of {chan_group=}")
+    T, S = time_tile, window_slack
+    if out_nsamps < T:
+        raise ValueError(
+            f"input too short for the kernel window ({out_nsamps=} < "
+            f"{T}); use the XLA scan path"
+        )
+    ndm_p = -(-ndm // dm_tile) * dm_tile
+    out_p = -(-out_nsamps // T) * T
+    # every (tile, group) window [t0 + dmin, t0 + dmin + T + S) must be
+    # in bounds without clamping (clamping would shift valid offsets).
+    # max delay is statically nsamps - out_nsamps (the dedisp contract,
+    # `dedisperser.hpp:100-101`), so the worst window end is
+    # (out_p - T) + max_delay + T + S; pad the tail to reach it.  The
+    # chunked driver bakes this padding into its device-resident buffer,
+    # so the pad here is a no-op on the hot path.
+    need = out_p + (nsamps - out_nsamps) + S
+    if nsamps < need:
+        data = jnp.pad(data, ((0, 0), (0, need - nsamps)))
+        nsamps = need
+    if ndm_p != ndm:
+        delays = jnp.pad(delays, ((0, ndm_p - ndm), (0, 0)), mode="edge")
+
+    grid = (ndm_p // dm_tile, out_p // T)
+    out = pl.pallas_call(
+        partial(
+            _dedisperse_kernel,
+            dm_tile=dm_tile, time_tile=T, chan_group=chan_group,
+            slack=S, nchans=nchans, nsamps=nsamps,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (dm_tile, nchans), lambda i, j: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (dm_tile, T), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((ndm_p, out_p), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, chan_group, T + S), data.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(delays, data)
+    return out[:ndm, :out_nsamps]

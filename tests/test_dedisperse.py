@@ -89,3 +89,67 @@ def test_tutorial_max_delay(tutorial_fil):
     # ~140 samples at DM 252.98 for the tutorial setup
     assert 100 < md < 200
     assert fil.nsamps - md > 131072  # search still uses a 2**17 FFT
+
+
+# ---------------------------------------------------------------------------
+# Pallas tiled kernel (interpret mode on CPU; compiled on TPU)
+# ---------------------------------------------------------------------------
+
+from peasoup_tpu.ops.dedisperse_pallas import (  # noqa: E402
+    dedisperse_pallas,
+    dedisperse_window_slack,
+)
+
+
+def _random_case(rng, nchans, nsamps, ndm, dtype):
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    dm_list = np.linspace(0.0, 150.0, ndm).astype(np.float32)
+    delays = delays_in_samples(dm_list, tab)
+    if dtype == np.uint8:
+        data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
+    else:
+        data = rng.normal(size=(nchans, nsamps)).astype(np.float32)
+    out_nsamps = nsamps - max_delay(dm_list, tab)
+    return data, delays, out_nsamps
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.uint8])
+def test_dedisperse_pallas_parity(dtype):
+    """Tile/pad/clamp paths: ndm not a tile multiple, out_nsamps not a
+    time-tile multiple, windows clamped at the array end."""
+    rng = np.random.default_rng(3)
+    nchans, nsamps, ndm = 32, 4096, 21
+    data, delays, out_nsamps = _random_case(rng, nchans, nsamps, ndm, dtype)
+    dm_tile, chan_group, time_tile = 8, 8, 256
+    slack = dedisperse_window_slack(delays, dm_tile, chan_group)
+    out = np.asarray(dedisperse_pallas(
+        jnp.asarray(data), jnp.asarray(delays), out_nsamps,
+        window_slack=slack, dm_tile=dm_tile, time_tile=time_tile,
+        chan_group=chan_group, interpret=True,
+    ))
+    golden = dedisperse_numpy(data.astype(np.float32), delays, out_nsamps)
+    assert out.shape == golden.shape
+    np.testing.assert_allclose(out, golden, rtol=1e-6, atol=1e-5)
+
+
+def test_dedisperse_pallas_matches_scan_path():
+    """Pallas kernel == the XLA scan path on the same inputs."""
+    rng = np.random.default_rng(4)
+    data, delays, out_nsamps = _random_case(rng, 16, 2048, 12, np.float32)
+    slack = dedisperse_window_slack(delays, 4, 4)
+    a = np.asarray(dedisperse_pallas(
+        jnp.asarray(data), jnp.asarray(delays), out_nsamps,
+        window_slack=slack, dm_tile=4, time_tile=128, chan_group=4,
+        interpret=True,
+    ))
+    b = np.asarray(dedisperse(jnp.asarray(data), jnp.asarray(delays),
+                              out_nsamps))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-5)
+
+
+def test_dedisperse_pallas_rejects_short_input():
+    data = jnp.zeros((8, 128), jnp.float32)
+    delays = jnp.zeros((4, 8), jnp.int32)
+    with pytest.raises(ValueError, match="too short"):
+        dedisperse_pallas(data, delays, 64, window_slack=128,
+                          time_tile=128, chan_group=8, interpret=True)
