@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Transform size to use (defaults to lower power of two)")
     p.add_argument("--dm_start", type=float, default=0.0)
     p.add_argument("--dm_end", type=float, default=100.0)
+    p.add_argument("--dm_file", default="", dest="dm_file",
+                   help="file with one DM trial per line (overrides "
+                        "dm_start/dm_end/dm_tol)")
     p.add_argument("--dm_tol", type=float, default=1.10)
     p.add_argument("--dm_pulse_width", type=float, default=64.0)
     p.add_argument("--acc_start", type=float, default=0.0)

@@ -82,8 +82,11 @@ def delays_in_samples(dm_list: np.ndarray, table: np.ndarray) -> np.ndarray:
 
 
 def max_delay(dm_list: np.ndarray, table: np.ndarray) -> int:
-    """``dedisp_get_max_delay``: delay of the last channel at the top DM."""
-    return int(np.float32(dm_list[-1]) * np.float32(table[-1]) + 0.5)
+    """``dedisp_get_max_delay``: delay of the last channel at the top DM
+    (``max`` rather than ``[-1]`` so user-supplied unsorted DM lists,
+    `dedisperser.hpp:34-48`, get a correct bound; identical for the
+    generated ascending grid)."""
+    return int(np.float32(np.max(dm_list)) * np.float32(table[-1]) + 0.5)
 
 
 def dedisperse(

@@ -107,6 +107,8 @@ class OutputFileWriter:
         el.append(XMLElement("outdir", cfg.outdir))
         el.append(XMLElement("killfilename", cfg.killfilename))
         el.append(XMLElement("zapfilename", cfg.zapfilename))
+        if getattr(cfg, "dm_file", ""):
+            el.append(XMLElement("dm_file", cfg.dm_file))
         el.append(XMLElement("max_num_threads", cfg.max_num_threads))
         el.append(XMLElement("size", cfg.size))
         for key in ("dm_start", "dm_end", "dm_tol", "dm_pulse_width",

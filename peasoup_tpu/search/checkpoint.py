@@ -58,7 +58,12 @@ def search_key(infile: str, fil, config) -> str:
     """
     hdr = fil.header
     cfg_items = sorted(
-        (k, v) for k, v in asdict(config).items()
+        # a custom dm_list enters as an explicit tuple: repr() of a long
+        # ndarray elides the middle with "...", which would alias the
+        # keys of different grids
+        (k, tuple(float(x) for x in np.asarray(v).ravel())
+         if k == "dm_list" and v is not None else v)
+        for k, v in asdict(config).items()
         if k not in _NON_IDENTITY_FIELDS
     )
     return repr((
@@ -67,6 +72,7 @@ def search_key(infile: str, fil, config) -> str:
         float(hdr.fch1), float(hdr.foff), cfg_items,
         _file_digest(config.killfilename),
         _file_digest(config.zapfilename),
+        _file_digest(getattr(config, "dm_file", "")),
     ))
 
 

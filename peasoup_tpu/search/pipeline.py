@@ -140,10 +140,19 @@ class PulsarSearch:
         self.fil = fil
         self.config = config
         hdr = fil.header
-        self.dm_list = generate_dm_list(
-            config.dm_start, config.dm_end, hdr.tsamp, config.dm_pulse_width,
-            hdr.fch1, hdr.foff, fil.nchans, config.dm_tol,
-        )
+        if config.dm_list is not None:
+            # ``dedisp_set_dm_list`` equivalent (`dedisperser.hpp:34-48`)
+            self.dm_list = np.asarray(config.dm_list, dtype=np.float32)
+        elif config.dm_file:
+            self.dm_list = load_dm_file(config.dm_file)
+        else:
+            self.dm_list = generate_dm_list(
+                config.dm_start, config.dm_end, hdr.tsamp,
+                config.dm_pulse_width, hdr.fch1, hdr.foff, fil.nchans,
+                config.dm_tol,
+            )
+        if len(self.dm_list) == 0:
+            raise ValueError("empty DM trial list")
         self.delay_tab = delay_table(fil.nchans, hdr.tsamp, hdr.fch1, hdr.foff)
         self.delays = delays_in_samples(self.dm_list, self.delay_tab)
         self.max_delay = max_delay(self.dm_list, self.delay_tab)
@@ -501,6 +510,19 @@ def fold_candidates(
         cand.nints = nints
         cand.opt_period = opt.opt_period
     cands.sort(key=lambda c: -max(c.snr, c.folded_snr))
+
+
+def load_dm_file(filename: str) -> np.ndarray:
+    """Parse a one-DM-per-line trial list (user-supplied grid, the
+    file-based face of ``dedisp_set_dm_list``, `dedisperser.hpp:34-48`).
+    Blank lines and ``#`` comments are skipped."""
+    vals: list[float] = []
+    with open(filename) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if line:
+                vals.append(float(line))
+    return np.asarray(vals, dtype=np.float32)
 
 
 def load_killmask(filename: str, nchans: int) -> np.ndarray:

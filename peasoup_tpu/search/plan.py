@@ -47,6 +47,12 @@ class SearchConfig:
     freq_tol: float = 0.0001
     verbose: bool = False
     progress_bar: bool = False
+    # user-supplied DM trials (``dedisp_set_dm_list`` equivalent,
+    # `include/transforms/dedisperser.hpp:34-48`): either an explicit
+    # array/sequence of DMs, or a one-DM-per-line text file.  Either
+    # overrides the generated dm_start/dm_end/dm_tol grid.
+    dm_list: object = None
+    dm_file: str = ""
     # TPU-build extras (no reference equivalent)
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step

@@ -173,3 +173,59 @@ class TestScorer:
         assert cand.is_adjacent  # dm_idx 10 is adjacent to 9
         assert 0 < cand.ddm_count_ratio <= 1
         assert 0 < cand.ddm_snr_ratio <= 1
+
+
+class TestCustomDMList:
+    """User-supplied DM grids (``dedisp_set_dm_list``,
+    `dedisperser.hpp:34-48`) via SearchConfig.dm_list / --dm_file."""
+
+    def _base(self, tutorial_fil):
+        from peasoup_tpu.io import read_filterbank
+        from peasoup_tpu.search.pipeline import PulsarSearch
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil = read_filterbank(tutorial_fil)
+        cfg = SearchConfig(
+            dm_start=0.0, dm_end=60.0, acc_start=-5.0, acc_end=5.0,
+            acc_pulse_width=64000.0, nharmonics=4, npdmp=0, limit=50,
+        )
+        return fil, cfg, PulsarSearch(fil, cfg)
+
+    def test_explicit_list_overrides_grid(self, tutorial_fil):
+        from peasoup_tpu.search.pipeline import PulsarSearch
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil, _, base = self._base(tutorial_fil)
+        cfg = SearchConfig(
+            dm_list=np.asarray(base.dm_list), acc_start=-5.0, acc_end=5.0,
+            acc_pulse_width=64000.0, nharmonics=4, npdmp=0, limit=50,
+        )
+        search = PulsarSearch(fil, cfg)
+        np.testing.assert_array_equal(search.dm_list, base.dm_list)
+        a, b = base.run(), search.run()
+        assert len(a.candidates) == len(b.candidates)
+        for x, y in zip(a.candidates, b.candidates):
+            assert x.freq == y.freq and x.snr == y.snr and x.dm == y.dm
+
+    def test_dm_file(self, tutorial_fil, tmp_path):
+        from peasoup_tpu.search.pipeline import PulsarSearch, load_dm_file
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil, _, base = self._base(tutorial_fil)
+        path = tmp_path / "dms.txt"
+        lines = (["# custom grid"]
+                 + [f"{float(dm)!r}" for dm in base.dm_list] + [""])
+        path.write_text("\n".join(lines))
+        np.testing.assert_array_equal(load_dm_file(str(path)), base.dm_list)
+        cfg = SearchConfig(dm_file=str(path))
+        search = PulsarSearch(fil, cfg)
+        np.testing.assert_array_equal(search.dm_list, base.dm_list)
+
+    def test_empty_list_raises(self, tutorial_fil):
+        from peasoup_tpu.io import read_filterbank
+        from peasoup_tpu.search.pipeline import PulsarSearch
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil = read_filterbank(tutorial_fil)
+        with pytest.raises(ValueError):
+            PulsarSearch(fil, SearchConfig(dm_list=[]))
