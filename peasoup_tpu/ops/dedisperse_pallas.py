@@ -11,22 +11,51 @@ streams the input past it once per DM tile:
 
 * grid = (ndm / DM_TILE, out_nsamps / TIME_TILE);
 * per program, channels are processed in groups of CHAN_GROUP; each
-  group's samples for the whole DM tile live in one rectangular window
-  ``data[g0:g0+G, t0 + min_delay : t0 + min_delay + TIME_TILE + slack]``
-  (delays vary smoothly across both channels and neighbouring DM
-  trials, so the window height ``slack`` is small), DMA'd HBM->VMEM
-  with double buffering;
-* the inner loop adds dynamically-shifted window rows into the
-  accumulator rows — the only data-dependent addressing left, and it
-  is VMEM-resident.
+  group's samples for the whole DM tile live in a VMEM window, DMA'd
+  HBM->VMEM with double buffering;
+* the inner loop reads a 128-aligned coarse slice of the window and
+  applies the 0..127 fine shift with a lane rotate (``pltpu.roll``).
 
 HBM traffic drops to ``(ndm / DM_TILE) * nchans * nsamps`` input reads
 plus one output write — DM_TILE x less than the scan — and the kernel
-becomes VPU-add bound (the algorithm's inherent ndm*nchans*T adds).
+becomes VPU-bound (the algorithm's inherent ndm*nchans*T adds, plus
+~2 extra vector ops per add for the coarse-read + rotate).
+
+Sublane-packed time layout
+--------------------------
+
+A time series is 1-D, but TPU vector registers are (8 sublanes, 128
+lanes): operating on ``(1, T)`` rows uses 1/8 of every vreg. The
+kernel therefore splits each DM row's time tile T into 8 sublane
+chunks of ``TQ = T/8`` samples, and each channel window into 8
+*separately DMA'd* sublane windows whose starts are
+``align128(t0 + group_min) + s*TQ``. Because TQ is a multiple of 128,
+the residual offset ``off = t0 + delay - align128(t0 + group_min)``
+is identical for all 8 sublane rows, so one (8, RW) coarse read + one
+lane rotate shifts all 8 chunks at once — full vreg utilisation.
+
+The accumulator and HBM output use the matching packed layout
+``(ndm, nj, 8, TQ)``; a host-side reshape to (ndm, nj*T) is exactly
+the logical time order.
 
 Input may be float32 or uint8 (8-bit filterbanks stay packed in HBM;
-the f32 conversion happens on VMEM tiles, reference analogue
+the f32 conversion happens once per VMEM window, reference analogue
 `src/kernels.cu:1144-1171` conversion_kernel).
+
+TPU-backend notes (all verified on a real v5e chip):
+
+* the whole pallas_call is traced under ``enable_x64(False)``:
+  jax_enable_x64 (which this package switches on for f64 index math
+  elsewhere) makes pallas' internal index bookkeeping produce i64
+  values that Mosaic either rejects or recurses on;
+* ``tpu.dynamic_rotate`` requires a power-of-two lane width and is
+  *silently wrong* otherwise (8192/16384 exact; 8320/4224/3840
+  corrupt) — hence ``TQ + 128`` must be a power of two;
+* vector loads/DMAs need *provably* 128-aligned minor-dim starts:
+  every data-dependent offset is decomposed as
+  ``(off // 128) * 128 + fine`` with ``pl.multiple_of`` hints;
+* scalar reads (the per-(dm, chan) delays) must live in SMEM — from
+  VMEM they lower to (1, 1) vector loads with unprovable alignment.
 """
 
 from __future__ import annotations
@@ -38,6 +67,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax._src.config import enable_x64
 
 
 def dedisperse_window_slack(
@@ -61,59 +91,90 @@ def dedisperse_window_slack(
 
 
 def _dedisperse_kernel(
-    delays_ref, data_ref, out_ref, win_ref, sem_ref,
-    *, dm_tile, time_tile, chan_group, slack, nchans, nsamps,
+    gmins_ref, delays_ref, data_ref, out_ref, win_ref, winf_ref, sem_ref,
+    *, dm_tile, time_tile, chan_group, slack, nchans,
 ):
     T, G, S = time_tile, chan_group, slack
-    W = T + S
-    t0 = pl.program_id(1) * T
+    TQ = T // 8        # per-sublane chunk
+    RW = TQ + 128      # rotate width (power of two, checked by wrapper)
+    WQ = TQ + S + 128  # per-sublane window width
+    i_tile = pl.program_id(0)  # hoisted: program_id inside nested
+    t0 = pl.program_id(1) * T  # control flow breaks interpret mode
     ngroups = nchans // G
 
-    # the wrapper pads the input so every window [t0+dmin, t0+dmin+W)
-    # is in bounds — no clamping, so per-(d,c) offsets stay exact
-    def group_start(g):
-        return t0 + jnp.min(delays_ref[:, pl.ds(g * G, G)])
+    # the wrapper pads the input so every window stays in bounds — no
+    # clamping, so per-(d,c) offsets stay exact.  Group minima come
+    # precomputed via SMEM: a vector-min over a dynamic column slice of
+    # the delay table is not provably 128-aligned in-kernel.
+    def group_astart(g):
+        start = t0 + gmins_ref[i_tile, g]
+        return pl.multiple_of((start // 128) * 128, 128)
 
-    def group_dma(slot, g):
-        return pltpu.make_async_copy(
-            data_ref.at[pl.ds(g * G, G), pl.ds(group_start(g), W)],
-            win_ref.at[slot],
-            sem_ref.at[slot],
-        )
+    def group_dmas(slot, g):
+        astart = group_astart(g)
+        # dst is (s, channel)-ordered: a tiled ref cannot be sliced to
+        # a single sublane row, so the s-windows land in the leading
+        # dim here and one in-VMEM transpose per group re-packs them
+        # into sublanes for the hot loop
+        return [
+            pltpu.make_async_copy(
+                data_ref.at[pl.ds(g * G, G), pl.ds(astart + s * TQ, WQ)],
+                win_ref.at[slot, s, :, :],
+                sem_ref.at[slot, s],
+            )
+            for s in range(8)
+        ]
 
     out_ref[:] = jnp.zeros_like(out_ref)
-    group_dma(0, 0).start()
+    for cp in group_dmas(0, 0):
+        cp.start()
 
     def group_body(g, _):
         slot = g % 2
 
         @pl.when(g + 1 < ngroups)
         def _():
-            group_dma((g + 1) % 2, g + 1).start()
+            for cp in group_dmas((g + 1) % 2, g + 1):
+                cp.start()
 
-        group_dma(slot, g).wait()
-        start = group_start(g)
+        for cp in group_dmas(slot, g):
+            cp.wait()
+        astart = group_astart(g)
 
+        # one conversion + transpose per window (~3% of the inner-loop
+        # work): keeps the hot loop a uniform f32 read+rotate+add for
+        # u8 and f32 inputs alike (Mosaic has no u8->f32 cast; go via
+        # i32), and moves the 8 sublane windows from the DMA-friendly
+        # leading dim into actual sublanes
+        w = win_ref[slot]
+        if w.dtype == jnp.uint8:
+            w = w.astype(jnp.int32)
+        winf_ref[:] = jnp.swapaxes(w.astype(jnp.float32), 0, 1)
+
+        # d outer (dynamic fori), c inner (static python unroll): the
+        # static c makes the window read's leading index free, and the
+        # per-channel contributions accumulate in vector registers so
+        # the out_ref read-modify-write happens once per (d, group)
+        # instead of once per (d, c)
         def d_body(d, _):
-            def c_body(c, acc):
-                off = t0 + delays_ref[d, g * G + c] - start
-                w = win_ref[slot, c, pl.ds(off, T)]
-                if w.dtype == jnp.uint8:
-                    w = w.astype(jnp.int32)  # Mosaic has no u8->f32 cast
-                return acc + w.astype(jnp.float32)
+            def chan(c, acc):
+                off = t0 + delays_ref[d, g * G + c] - astart  # [0, S+128)
+                coarse = pl.multiple_of((off // 128) * 128, 128)
+                fine = off - coarse
+                v = winf_ref[c, :, pl.ds(coarse, RW)]  # (8, RW)
+                return acc + pltpu.roll(v, -fine, 1)[:, :TQ]
 
-            row = jax.lax.fori_loop(
-                jnp.int32(0), jnp.int32(G), c_body,
-                jnp.zeros((T,), jnp.float32),
-            )
-            out_ref[d, :] += row
+            acc = chan(0, jnp.zeros((8, TQ), jnp.float32))
+            for c in range(1, G):
+                acc = chan(c, acc)
+            out_ref[pl.ds(d, 1), 0] += acc[None]
             return 0
 
+        # int32 bounds: under jax_enable_x64 python-int bounds make the
+        # index i64, which Mosaic rejects
         jax.lax.fori_loop(jnp.int32(0), jnp.int32(dm_tile), d_body, 0)
         return 0
 
-    # int32 bounds: under jax_enable_x64 python-int bounds make the
-    # index i64, which Mosaic's memref slicing rejects
     jax.lax.fori_loop(jnp.int32(0), jnp.int32(ngroups), group_body, 0)
 
 
@@ -131,7 +192,7 @@ def dedisperse_pallas(
     *,
     window_slack: int,
     dm_tile: int = 32,
-    time_tile: int = 8192,
+    time_tile: int = 15360,
     chan_group: int = 16,
     interpret: bool = False,
 ) -> jax.Array:
@@ -145,60 +206,107 @@ def dedisperse_pallas(
         window_slack: static per-(tile, group) delay spread bound from
             :func:`dedisperse_window_slack` (must be computed from the
             same dm_tile/chan_group).
+        time_tile: samples per grid step; time_tile/8 + 128 must be a
+            power of two (7168, 15360, 31744, ...).
         interpret: run the interpreter (CPU tests).
 
     Returns:
         (ndm, out_nsamps) float32.
     """
+    with enable_x64(False):
+        return _dedisperse_pallas_impl(
+            data, delays, out_nsamps, window_slack, dm_tile, time_tile,
+            chan_group, interpret,
+        )
+
+
+def _dedisperse_pallas_impl(
+    data, delays, out_nsamps, window_slack, dm_tile, time_tile,
+    chan_group, interpret,
+):
     ndm, nchans = delays.shape
     nsamps = data.shape[1]
     if nchans % chan_group:
         raise ValueError(f"{nchans=} not a multiple of {chan_group=}")
     T, S = time_tile, window_slack
+    TQ, rem = divmod(T, 8)
+    # tpu.dynamic_rotate silently produces WRONG results for vector
+    # widths that are not a power of two (verified empirically on v5e:
+    # 8192/16384 exact, 8320/4224/3840 corrupt) — the kernel's fine
+    # shift rolls (8, TQ + 128) chunks, so TQ + 128 must be a power of
+    # two (and TQ a lane multiple, for the aligned sublane DMA starts)
+    if rem or TQ % 128 or (TQ + 128) & (TQ + 127):
+        raise ValueError(
+            f"time_tile must be 8*TQ with TQ+128 a power of two (got "
+            f"{T}); e.g. 7168, 15360 or 31744"
+        )
+    # the coarse/fine decomposition bounds coarse by S only when S is a
+    # lane multiple; a hand-computed slack like 64 would let the coarse
+    # read run past the DMA'd window and sum stale VMEM into the output
+    if S % 128:
+        raise ValueError(
+            f"window_slack must be a multiple of 128 (got {S}); use "
+            f"dedisperse_window_slack()"
+        )
     if out_nsamps < T:
         raise ValueError(
             f"input too short for the kernel window ({out_nsamps=} < "
             f"{T}); use the XLA scan path"
         )
+    delays = delays.astype(jnp.int32)
     ndm_p = -(-ndm // dm_tile) * dm_tile
     out_p = -(-out_nsamps // T) * T
-    # every (tile, group) window [t0 + dmin, t0 + dmin + T + S) must be
+    nj = out_p // T
+    # every sublane window [astart + s*TQ, astart + s*TQ + WQ) must be
     # in bounds without clamping (clamping would shift valid offsets).
     # max delay is statically nsamps - out_nsamps (the dedisp contract,
-    # `dedisperser.hpp:100-101`), so the worst window end is
-    # (out_p - T) + max_delay + T + S; pad the tail to reach it.  The
-    # chunked driver bakes this padding into its device-resident buffer,
-    # so the pad here is a no-op on the hot path.
-    need = out_p + (nsamps - out_nsamps) + S
+    # `dedisperser.hpp:100-101`); the worst window end is
+    # (out_p - T) + max_delay + T + S + 128.  The chunked driver bakes
+    # this padding into its device-resident buffer, so the pad here is
+    # a no-op on the hot path.
+    need = out_p + (nsamps - out_nsamps) + S + 128
     if nsamps < need:
         data = jnp.pad(data, ((0, 0), (0, need - nsamps)))
         nsamps = need
     if ndm_p != ndm:
         delays = jnp.pad(delays, ((0, ndm_p - ndm), (0, 0)), mode="edge")
 
-    grid = (ndm_p // dm_tile, out_p // T)
+    ntiles, ngroups = ndm_p // dm_tile, nchans // chan_group
+    gmins = (
+        delays.reshape(ntiles, dm_tile, ngroups, chan_group)
+        .min(axis=(1, 3))
+        .astype(jnp.int32)
+    )
+    WQ = TQ + S + 128
+    grid = (ntiles, nj)
     out = pl.pallas_call(
         partial(
             _dedisperse_kernel,
             dm_tile=dm_tile, time_tile=T, chan_group=chan_group,
-            slack=S, nchans=nchans, nsamps=nsamps,
+            slack=S, nchans=nchans,
         ),
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # gmins: whole array
+            # delays live in SMEM: the kernel only ever reads them as
+            # scalars, and scalar reads from VMEM lower to (1,1) vector
+            # loads whose dynamic lane index Mosaic cannot prove aligned
             pl.BlockSpec(
                 (dm_tile, nchans), lambda i, j: (i, 0),
-                memory_space=pltpu.VMEM,
+                memory_space=pltpu.SMEM,
             ),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (dm_tile, T), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            (dm_tile, 1, 8, TQ), lambda i, j: (i, j, 0, 0),
+            memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((ndm_p, out_p), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((ndm_p, nj, 8, TQ), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((2, chan_group, T + S), data.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((2, 8, chan_group, WQ), data.dtype),
+            pltpu.VMEM((chan_group, 8, WQ), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 8)),
         ],
         interpret=interpret,
-    )(delays, data)
-    return out[:ndm, :out_nsamps]
+    )(gmins, delays, data)
+    return out.reshape(ndm_p, out_p)[:ndm, :out_nsamps]

@@ -120,7 +120,7 @@ def test_dedisperse_pallas_parity(dtype):
     rng = np.random.default_rng(3)
     nchans, nsamps, ndm = 32, 4096, 21
     data, delays, out_nsamps = _random_case(rng, nchans, nsamps, ndm, dtype)
-    dm_tile, chan_group, time_tile = 8, 8, 256
+    dm_tile, chan_group, time_tile = 8, 8, 1024
     slack = dedisperse_window_slack(delays, dm_tile, chan_group)
     out = np.asarray(dedisperse_pallas(
         jnp.asarray(data), jnp.asarray(delays), out_nsamps,
@@ -139,7 +139,7 @@ def test_dedisperse_pallas_matches_scan_path():
     slack = dedisperse_window_slack(delays, 4, 4)
     a = np.asarray(dedisperse_pallas(
         jnp.asarray(data), jnp.asarray(delays), out_nsamps,
-        window_slack=slack, dm_tile=4, time_tile=128, chan_group=4,
+        window_slack=slack, dm_tile=4, time_tile=1024, chan_group=4,
         interpret=True,
     ))
     b = np.asarray(dedisperse(jnp.asarray(data), jnp.asarray(delays),
@@ -152,4 +152,4 @@ def test_dedisperse_pallas_rejects_short_input():
     delays = jnp.zeros((4, 8), jnp.int32)
     with pytest.raises(ValueError, match="too short"):
         dedisperse_pallas(data, delays, 64, window_slack=128,
-                          time_tile=128, chan_group=8, interpret=True)
+                          time_tile=1024, chan_group=8, interpret=True)
