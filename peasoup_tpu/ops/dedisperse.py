@@ -121,7 +121,9 @@ def dedisperse(
         sliced = jax.vmap(
             lambda di: lax.dynamic_slice(col, (di,), (out_nsamps,))
         )(d)
-        return acc + sliced, None
+        # u8 input stays packed in HBM (34 GB as f32 at 4k chans x 2^23
+        # samples); the cast rides the fused slice+add
+        return acc + sliced.astype(jnp.float32), None
 
     # derive the zero init from ``delays`` so that under shard_map it
     # carries the same varying-manual-axes annotation as the scanned

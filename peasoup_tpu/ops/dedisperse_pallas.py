@@ -92,7 +92,7 @@ def dedisperse_window_slack(
 
 def _dedisperse_kernel(
     gmins_ref, delays_ref, data_ref, out_ref, win_ref, winf_ref, sem_ref,
-    *, dm_tile, time_tile, chan_group, slack, nchans,
+    *, dm_tile, time_tile, chan_group, slack, nchans, delays_blocked,
 ):
     T, G, S = time_tile, chan_group, slack
     TQ = T // 8        # per-sublane chunk
@@ -157,8 +157,12 @@ def _dedisperse_kernel(
         # the out_ref read-modify-write happens once per (d, group)
         # instead of once per (d, c)
         def d_body(d, _):
+            # unblocked delays (dm_tile not sublane-divisible, e.g. the
+            # fold path's scattered-row dm_tile=1) index globally
+            dd = d if delays_blocked else i_tile * dm_tile + d
+
             def chan(c, acc):
-                off = t0 + delays_ref[d, g * G + c] - astart  # [0, S+128)
+                off = t0 + delays_ref[dd, g * G + c] - astart  # [0, S+128)
                 coarse = pl.multiple_of((off // 128) * 128, 128)
                 fine = off - coarse
                 v = winf_ref[c, :, pl.ds(coarse, RW)]  # (8, RW)
@@ -182,7 +186,7 @@ def _dedisperse_kernel(
     jax.jit,
     static_argnames=(
         "out_nsamps", "window_slack", "dm_tile", "time_tile",
-        "chan_group", "interpret",
+        "chan_group", "interpret", "max_delay",
     ),
 )
 def dedisperse_pallas(
@@ -195,20 +199,27 @@ def dedisperse_pallas(
     time_tile: int = 15360,
     chan_group: int = 16,
     interpret: bool = False,
+    max_delay: int | None = None,
 ) -> jax.Array:
     """Dedisperse with the tiled VMEM-accumulator kernel.
 
     Args:
         data: (nchans, nsamps) float32 or uint8, channel-major, already
-            killmask-multiplied.
+            killmask-multiplied (and possibly tail-padded by the
+            caller).
         delays: (ndm, nchans) int32 sample delays.
-        out_nsamps: output samples per trial (nsamps - max_delay).
+        out_nsamps: output samples per trial.
         window_slack: static per-(tile, group) delay spread bound from
             :func:`dedisperse_window_slack` (must be computed from the
             same dm_tile/chan_group).
         time_tile: samples per grid step; time_tile/8 + 128 must be a
             power of two (7168, 15360, 31744, ...).
         interpret: run the interpreter (CPU tests).
+        max_delay: true maximum delay (the dedisp contract bound,
+            `dedisperser.hpp:100-101`).  Pass it whenever ``data`` is
+            already tail-padded — inferring it as nsamps - out_nsamps
+            from a padded array over-pads AGAIN inside the jitted
+            program, i.e. a full HBM copy of the input on every call.
 
     Returns:
         (ndm, out_nsamps) float32.
@@ -216,13 +227,13 @@ def dedisperse_pallas(
     with enable_x64(False):
         return _dedisperse_pallas_impl(
             data, delays, out_nsamps, window_slack, dm_tile, time_tile,
-            chan_group, interpret,
+            chan_group, interpret, max_delay,
         )
 
 
 def _dedisperse_pallas_impl(
     data, delays, out_nsamps, window_slack, dm_tile, time_tile,
-    chan_group, interpret,
+    chan_group, interpret, max_delay=None,
 ):
     ndm, nchans = delays.shape
     nsamps = data.shape[1]
@@ -259,12 +270,13 @@ def _dedisperse_pallas_impl(
     nj = out_p // T
     # every sublane window [astart + s*TQ, astart + s*TQ + WQ) must be
     # in bounds without clamping (clamping would shift valid offsets).
-    # max delay is statically nsamps - out_nsamps (the dedisp contract,
-    # `dedisperser.hpp:100-101`); the worst window end is
-    # (out_p - T) + max_delay + T + S + 128.  The chunked driver bakes
-    # this padding into its device-resident buffer, so the pad here is
-    # a no-op on the hot path.
-    need = out_p + (nsamps - out_nsamps) + S + 128
+    # The worst window end is (out_p - T) + max_delay + T + S + 128.
+    # The chunked driver bakes this padding into its device-resident
+    # buffer (and passes the true max_delay), so the pad here is a
+    # no-op on its hot path.
+    if max_delay is None:
+        max_delay = nsamps - out_nsamps  # the dedisp contract bound
+    need = out_p + max_delay + S + 128
     if nsamps < need:
         data = jnp.pad(data, ((0, 0), (0, need - nsamps)))
         nsamps = need
@@ -279,22 +291,30 @@ def _dedisperse_pallas_impl(
     )
     WQ = TQ + S + 128
     grid = (ntiles, nj)
+    # delays live in SMEM: the kernel only ever reads them as scalars,
+    # and scalar reads from VMEM lower to (1,1) vector loads whose
+    # dynamic lane index Mosaic cannot prove aligned.  SMEM blocks must
+    # still satisfy the (8, 128)-divisible-or-full rule, so small
+    # dm_tiles ship the whole table instead (it is tiny in that case).
+    delays_blocked = dm_tile % 8 == 0 or ntiles == 1
+    delays_spec = (
+        pl.BlockSpec(
+            (dm_tile, nchans), lambda i, j: (i, 0),
+            memory_space=pltpu.SMEM,
+        )
+        if delays_blocked
+        else pl.BlockSpec(memory_space=pltpu.SMEM)
+    )
     out = pl.pallas_call(
         partial(
             _dedisperse_kernel,
             dm_tile=dm_tile, time_tile=T, chan_group=chan_group,
-            slack=S, nchans=nchans,
+            slack=S, nchans=nchans, delays_blocked=delays_blocked,
         ),
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # gmins: whole array
-            # delays live in SMEM: the kernel only ever reads them as
-            # scalars, and scalar reads from VMEM lower to (1,1) vector
-            # loads whose dynamic lane index Mosaic cannot prove aligned
-            pl.BlockSpec(
-                (dm_tile, nchans), lambda i, j: (i, 0),
-                memory_space=pltpu.SMEM,
-            ),
+            delays_spec,
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(

@@ -128,6 +128,36 @@ def sharded_search_program(
 from functools import lru_cache
 
 
+def _compact_peaks(idxs, snrs, counts, compact_k):
+    """Shared device-side tail of both fused programs: compact all
+    (dm, accel, level) peak buffers of a shard into one packed f32
+    buffer (layout documented in :func:`build_fused_search`)."""
+    flat_bin = idxs.reshape(-1)
+    flat_snr = snrs.reshape(-1)
+    n = flat_bin.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    valid = flat_bin >= 0
+    sentinel = jnp.int32(-n - 1)
+    score = jnp.where(valid, -pos, sentinel)
+    top, _ = lax.top_k(score, compact_k)  # first compact_k valid slots
+    got = top != sentinel
+    sel = jnp.where(got, -top, 0)
+    # the host reconstructs each entry's (dm, accel, level, slot) tag
+    # from ``counts`` alone: valid slots appear in flat spectrum
+    # order, so only bins+snrs are shipped
+    sel_bin = jnp.where(got, flat_bin[sel], -1)
+    sel_snr = jnp.where(got, flat_snr[sel], 0.0).astype(jnp.float32)
+    nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
+    # pack everything into ONE f32 buffer (ints bitcast) so the
+    # host pays a single device->host round trip
+    return jnp.concatenate([
+        lax.bitcast_convert_type(sel_bin, jnp.float32),
+        sel_snr,
+        lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
+        lax.bitcast_convert_type(nvalid, jnp.float32),
+    ])
+
+
 @lru_cache(maxsize=32)
 def build_fused_search(
     mesh: Mesh,
@@ -213,31 +243,7 @@ def build_fused_search(
         # gathers / top_ks, keeping the VPU/MXU fed instead of running
         # 59 small sequential program iterations
         idxs, snrs, counts = jax.vmap(per_dm)(trials_sz, accs)
-
-        flat_bin = idxs.reshape(-1)
-        flat_snr = snrs.reshape(-1)
-        n = flat_bin.shape[0]
-        pos = jnp.arange(n, dtype=jnp.int32)
-        valid = flat_bin >= 0
-        sentinel = jnp.int32(-n - 1)
-        score = jnp.where(valid, -pos, sentinel)
-        top, _ = lax.top_k(score, compact_k)  # first compact_k valid slots
-        got = top != sentinel
-        sel = jnp.where(got, -top, 0)
-        # the host reconstructs each entry's (dm, accel, level, slot) tag
-        # from ``counts`` alone: valid slots appear in flat spectrum
-        # order, so only bins+snrs are shipped
-        sel_bin = jnp.where(got, flat_bin[sel], -1)
-        sel_snr = jnp.where(got, flat_snr[sel], 0.0).astype(jnp.float32)
-        nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
-        # pack everything into ONE f32 buffer (ints bitcast) so the
-        # host pays a single device->host round trip
-        packed = jnp.concatenate([
-            lax.bitcast_convert_type(sel_bin, jnp.float32),
-            sel_snr,
-            lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
-            lax.bitcast_convert_type(nvalid, jnp.float32),
-        ])
+        packed = _compact_peaks(idxs, snrs, counts, compact_k)
         return packed, trials
 
     mapped = jax.shard_map(
@@ -247,6 +253,159 @@ def build_fused_search(
             P(), P("dm", None), P(), P("dm", None), P(), P(),
         ),
         out_specs=(P("dm"), P("dm", None)),
+    )
+    return jax.jit(mapped)
+
+
+@lru_cache(maxsize=16)
+def build_chunked_search(
+    mesh: Mesh,
+    *,
+    nchans: int,
+    out_nsamps: int,
+    size: int,
+    ndm_local: int,
+    dm_chunk: int,
+    namax: int,
+    accel_block: int,
+    bin_width: float,
+    tsamp: float,
+    nharms: int,
+    bounds: tuple,
+    capacity: int,
+    min_snr: float,
+    b5: float,
+    b25: float,
+    use_zap: bool,
+    compact_k: int,
+    max_shift: int | None,
+    dedisp_method: str,
+    window_slack: int = 0,
+    dm_tile: int = 32,
+    time_tile: int = 15360,
+    chan_group: int = 16,
+    max_delay_samples: int = 0,
+):
+    """Bounded-HBM variant of :func:`build_fused_search`.
+
+    The full-materialisation program holds ``(ndm_local, out_nsamps)``
+    trials plus ``ndm_local*namax`` batched search intermediates — at
+    SURVEY-scale inputs (2^23 samples x 10^3 DM trials) that is
+    terabytes. This program is the same single dispatch, but streams
+    the work in the shape the reference streams it
+    (`src/pipeline_multi.cu:145-157` processes one trial at a time):
+
+    * an outer ``lax.scan`` over DM chunks of ``dm_chunk`` trials:
+      dedisperse (Pallas kernel or XLA scan) -> per-row whiten;
+    * an inner ``lax.scan`` over accel blocks of ``accel_block``
+      trials, so at most ``dm_chunk * accel_block`` spectra worth of
+      FFT/harmonic intermediates are ever live;
+    * only the fixed-size peak buffers survive each step (stacked by
+      the scans), and the usual global compaction ships ONE packed
+      buffer per shard home.
+
+    ``trials`` are NOT returned: at this scale they cannot stay
+    HBM-resident, so folding re-dedisperses just the candidate DM rows
+    (see ``MeshPulsarSearch._fold_trials_provider``).
+
+    ``data`` is channel-major and stays uint8 in HBM for 8-bit inputs
+    (f32 at 4096 chans x 2^23 samples would be 34 GB); the caller
+    pre-applies the killmask and pre-pads the tail so the Pallas
+    kernel's window padding is a no-op on the hot path.
+
+    Returns a jitted ``fn(data, delays, accs, birdies, widths) ->
+    packed`` with delays/accs sharded over ``dm`` and
+    ``ndm_local = n_chunks * dm_chunk`` rows per shard.
+    """
+    from ..ops.dedisperse_pallas import dedisperse_pallas
+
+    nlevels = nharms + 1
+    n_chunks = ndm_local // dm_chunk
+    n_ablocks = namax // accel_block
+    assert ndm_local == n_chunks * dm_chunk
+    assert namax == n_ablocks * accel_block
+
+    def shard_fn(data, delays, accs, birdies, widths):
+        def chunk_body(_, ci):
+            z = jnp.int32(0)  # literal 0 is weak-i64 under x64
+            delays_c = lax.dynamic_slice(
+                delays, (ci * dm_chunk, z), (dm_chunk, nchans)
+            )
+            accs_c = lax.dynamic_slice(
+                accs, (ci * dm_chunk, z), (dm_chunk, namax)
+            )
+            if dedisp_method == "pallas":
+                trials = dedisperse_pallas(
+                    data, delays_c, out_nsamps,
+                    window_slack=window_slack, dm_tile=dm_tile,
+                    time_tile=time_tile, chan_group=chan_group,
+                    max_delay=max_delay_samples,
+                )
+            else:
+                trials = dedisperse(data, delays_c, out_nsamps)
+            if out_nsamps >= size:
+                trials_sz = trials[:, :size]
+            else:
+                pad_mean = jnp.mean(trials, axis=1, keepdims=True)
+                pad = jnp.broadcast_to(
+                    pad_mean, (dm_chunk, size - out_nsamps)
+                )
+                trials_sz = jnp.concatenate([trials, pad], axis=1)
+
+            whiten = lambda tim: whiten_core(
+                tim, birdies, widths, bin_width, b5, b25, use_zap
+            )
+            tim_w, mean, std = jax.vmap(whiten)(trials_sz)
+
+            def ab_body(_, ai):
+                accs_blk = lax.dynamic_slice(
+                    accs_c, (jnp.int32(0), ai * accel_block),
+                    (dm_chunk, accel_block),
+                )
+
+                def row(tw, m, s, arow):
+                    search = lambda a: search_one_accel(
+                        tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
+                        bounds, capacity, min_snr, max_shift,
+                    )
+                    i2, s2, c2 = jax.vmap(search)(arow)
+                    valid = ~jnp.isnan(arow)
+                    i2 = jnp.where(valid[:, None, None], i2, -1)
+                    s2 = jnp.where(valid[:, None, None], s2, 0.0)
+                    c2 = jnp.where(valid[:, None], c2, 0)
+                    return i2, s2, c2
+
+                return 0, jax.vmap(row)(tim_w, mean, std, accs_blk)
+
+            _, (bi, bs, bc) = lax.scan(
+                ab_body, 0, jnp.arange(n_ablocks, dtype=jnp.int32)
+            )
+            # (n_ablocks, dm_chunk, accel_block, ...) -> (dm_chunk, namax, ...)
+            bi = jnp.moveaxis(bi, 0, 1).reshape(
+                dm_chunk, namax, nlevels, capacity
+            )
+            bs = jnp.moveaxis(bs, 0, 1).reshape(
+                dm_chunk, namax, nlevels, capacity
+            )
+            bc = jnp.moveaxis(bc, 0, 1).reshape(dm_chunk, namax, nlevels)
+            return 0, (bi, bs, bc)
+
+        _, (idxs, snrs, counts) = lax.scan(
+            chunk_body, 0, jnp.arange(n_chunks, dtype=jnp.int32)
+        )
+        idxs = idxs.reshape(ndm_local, namax, nlevels, capacity)
+        snrs = snrs.reshape(ndm_local, namax, nlevels, capacity)
+        counts = counts.reshape(ndm_local, namax, nlevels)
+        return _compact_peaks(idxs, snrs, counts, compact_k)
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(), P("dm", None), P("dm", None), P(), P()),
+        out_specs=P("dm"),
+        # pallas_call out_shapes carry no varying-mesh-axes annotation;
+        # every output here is trivially dm-varying, so skip the check
+        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -326,80 +485,259 @@ class MeshPulsarSearch(PulsarSearch):
         )
         return self._dev_inputs
 
-    def run(self) -> SearchResult:
+    # -- bounded-HBM chunked path (production scale) --------------------
+
+    # rough per-element coefficients for the planner: the batched
+    # search chain's biggest concurrent buffers (f64 resample indices,
+    # complex spectra, harmonic sums) cost ~32 B per sample per live
+    # spectrum; whiten ~24 B/sample/row.  Deliberately conservative —
+    # the scan reuses buffers across steps, so only one chunk's worth
+    # is ever live.
+    _SPECTRUM_BYTES = 32
+    _WHITEN_BYTES = 24
+
+    def _data_bytes(self) -> int:
+        itemsize = 1 if self.fil.header.nbits <= 8 else 4
+        return self.fil.nchans * self.fil.nsamps * itemsize
+
+    def _plan_chunking(self, namax: int) -> dict | None:
+        """Decide full-materialisation vs chunked execution and pick
+        chunk sizes within ``config.hbm_budget_gb``.
+
+        Returns None for the (small-input) full path, else a plan dict.
+        """
+        cfg = self.config
+        budget = int(cfg.hbm_budget_gb * 1e9)
+        ndm = len(self.dm_list)
+        ndm_local = int(np.ceil(ndm / self.ndev))
+        est_full = (
+            self._SPECTRUM_BYTES * ndm_local * namax * self.size
+            + 8 * ndm_local * self.out_nsamps
+            + self._data_bytes()
+        )
+        if est_full <= budget and not cfg.dm_chunk and not cfg.accel_block:
+            return None
+
+        avail = budget - self._data_bytes()
+        if avail <= 0:
+            raise ValueError(
+                f"filterbank alone ({self._data_bytes()/1e9:.1f} GB) "
+                f"exceeds hbm_budget_gb={cfg.hbm_budget_gb}"
+            )
+        # half the remaining budget to whiten+trials, half to spectra
+        if cfg.dm_chunk:
+            dm_chunk = cfg.dm_chunk
+        else:
+            per_row = (self._WHITEN_BYTES * self.size
+                       + 8 * self.out_nsamps)
+            dm_chunk = int(max(1, min(32, (avail // 2) // per_row)))
+        if cfg.accel_block:
+            accel_block = cfg.accel_block
+        else:
+            live = (avail // 2) // (self._SPECTRUM_BYTES * self.size)
+            accel_block = int(max(1, min(namax, live // dm_chunk)))
+        ndm_local_p = int(np.ceil(ndm_local / dm_chunk)) * dm_chunk
+        namax_p = int(np.ceil(namax / accel_block)) * accel_block
+
+        # dedispersion method: the tiled Pallas kernel needs a TPU, a
+        # chan_group-divisible channel count and a full time tile
+        chan_group = 16
+        time_tile = next(
+            (t for t in (31744, 15360, 7168, 3072, 1024)
+             if t <= self.out_nsamps), 0,
+        )
+        dm_tile = min(32, dm_chunk)
+        on_tpu = jax.devices()[0].platform == "tpu"
+        use_pallas = (
+            on_tpu
+            and time_tile > 0
+            and self.fil.nchans % chan_group == 0
+            and dm_chunk % dm_tile == 0
+        )
+        plan = dict(
+            dm_chunk=dm_chunk, accel_block=accel_block,
+            ndm_local_p=ndm_local_p, namax_p=namax_p,
+            dedisp_method="pallas" if use_pallas else "scan",
+            dm_tile=dm_tile, time_tile=time_tile, chan_group=chan_group,
+            window_slack=0, pad_to=self.fil.nsamps,
+        )
+        if use_pallas:
+            from ..ops.dedisperse_pallas import dedisperse_window_slack
+
+            ndm_pp = ndm_local_p * self.ndev
+            # edge-pad (like the kernel wrapper): zero-padding would put
+            # max-delay rows next to zero rows in the last DM tile and
+            # explode the slack bound to ~max_delay
+            delays_p = np.empty((ndm_pp, self.fil.nchans), np.int32)
+            delays_p[:ndm] = self.delays
+            delays_p[ndm:] = self.delays[-1]
+            slack = dedisperse_window_slack(delays_p, dm_tile, chan_group)
+            out_p = int(np.ceil(self.out_nsamps / time_tile)) * time_tile
+            plan["window_slack"] = slack
+            plan["pad_to"] = out_p + self.max_delay + slack + 128
+        return plan
+
+    def _device_inputs_chunked(self, plan, acc_lists):
+        """Channel-major (killmask-applied, tail-padded) data plus the
+        padded trial grid, uploaded once and cached in HBM."""
+        if getattr(self, "_dev_inputs_chunked", None) is not None:
+            return self._dev_inputs_chunked
+        ndm = len(self.dm_list)
+        ndm_pp = plan["ndm_local_p"] * self.ndev
+        namax_p = plan["namax_p"]
+        accs = np.full((ndm_pp, namax_p), np.nan, np.float32)
+        for i, a in enumerate(acc_lists):
+            accs[i, : len(a)] = a
+        # edge-pad to match the planner's slack bound (padded rows emit
+        # nothing: their accel slots are all NaN)
+        delays = np.empty((ndm_pp, self.fil.nchans), np.int32)
+        delays[:ndm] = self.delays
+        delays[ndm:] = self.delays[-1]
+        nbits = self.fil.header.nbits
+        nchans, nsamps = self.fil.nchans, self.fil.nsamps
+        # single allocation: transpose-copy + killmask + tail pad in
+        # place (three sequential full copies would transiently need
+        # ~3x the multi-GB input on the host)
+        data = np.zeros(
+            (nchans, max(plan["pad_to"], nsamps)),
+            np.uint8 if nbits <= 8 else np.float32,
+        )
+        data[:, :nsamps] = self.fil.data.T
+        if self.killmask is not None:
+            data[:, :nsamps] *= self.killmask[:, None].astype(data.dtype)
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P("dm", None))
+        self._dev_inputs_chunked = (
+            jax.device_put(jnp.asarray(data), rep),
+            jax.device_put(jnp.asarray(delays), shard),
+            jax.device_put(jnp.asarray(accs), shard),
+            jax.device_put(jnp.asarray(self.birdies), rep),
+            jax.device_put(jnp.asarray(self.bwidths), rep),
+        )
+        return self._dev_inputs_chunked
+
+    def _fold_trials_provider(self, dm_idxs):
+        """Re-dedisperse just the candidate DM rows for folding (the
+        chunked program cannot keep (ndm, out_nsamps) trials resident;
+        the reference holds them host-side, `pipeline_multi.cu:258`)."""
+        plan = self._chunk_plan
+        uniq = sorted(set(int(i) for i in dm_idxs))
+        row_map = {dm: r for r, dm in enumerate(uniq)}
+        data = self._dev_inputs_chunked[0]
+        delays_sel = jnp.asarray(self.delays[uniq])
+        if plan["dedisp_method"] == "pallas":
+            from ..ops.dedisperse_pallas import dedisperse_pallas
+
+            # dm_tile=1: the selected rows are scattered DMs, so any
+            # multi-row tile would have an unbounded delay spread; a
+            # (1, chan_group) block's spread is <= the plan's
+            # (dm_tile, chan_group) bound, so the plan slack is valid
+            # and the pre-padded data needs no re-pad
+            trials = dedisperse_pallas(
+                data, delays_sel, self.out_nsamps,
+                window_slack=plan["window_slack"],
+                dm_tile=1, time_tile=plan["time_tile"],
+                chan_group=plan["chan_group"],
+                max_delay=self.max_delay,
+            )
+        else:
+            trials = dedisperse(data, delays_sel, self.out_nsamps)
+        return trials, row_map
+
+    def _run_chunked(self, plan, acc_lists, namax, timers, t_total, ckpt,
+                     ckpt_done):
         import time
-        import warnings
 
         cfg = self.config
-        timers: dict[str, float] = {}
-        t_total = time.time()
-
         ndm = len(self.dm_list)
-
-        # checkpoint resume: the mesh search is a single dispatch, so a
-        # complete checkpoint skips the device program entirely (trials
-        # are re-dedispersed only if folding needs them)
-        ckpt, ckpt_done = self._make_checkpoint()
-        if ckpt and len(ckpt_done) == ndm:
-            timers["dedispersion"] = 0.0
-            timers["searching"] = 0.0
-            dm_cands = CandidateCollection()
-            for ii in range(ndm):
-                dm_cands.append(ckpt_done[ii])
-            trials = (
-                self.dedisperse_sharded() if cfg.npdmp > 0 else None
-            )
-            result = self._finalise(dm_cands, trials, timers, t_total)
-            ckpt.remove()
-            return result
-        ndm_p = self._padded_trial_count()
-        ndev = self.ndev
-        ndm_local = ndm_p // ndev
-        acc_lists = [
-            self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
-        ]
-        namax = max(len(a) for a in acc_lists)
+        ndm_local_p = plan["ndm_local_p"]
+        namax_p = plan["namax_p"]
         nlevels = cfg.nharmonics + 1
         cap = cfg.peak_capacity
-        # clamp to the shard's total slot count (small configs)
-        compact_k = min(
-            cfg.compact_capacity, ndm_local * namax * nlevels * cap
-        )
-
-        program = build_fused_search(
-            self.mesh,
-            nbits=self.fil.header.nbits,
-            nchans=self.fil.nchans,
-            nsamps=self.fil.nsamps,
-            out_nsamps=self.out_nsamps,
-            size=self.size,
-            bin_width=self.bin_width,
-            tsamp=float(self.fil.tsamp),
-            nharms=cfg.nharmonics,
-            bounds=self.bounds,
-            capacity=cap,
-            min_snr=cfg.min_snr,
-            b5=cfg.boundary_5_freq,
-            b25=cfg.boundary_25_freq,
-            use_zap=bool(len(self.birdies)),
-            use_killmask=self.killmask is not None,
-            compact_k=compact_k,
-            max_shift=self.max_shift,
-        )
-
+        total_slots = ndm_local_p * namax_p * nlevels * cap
+        compact_k = min(cfg.compact_capacity, total_slots)
+        self._chunk_plan = plan
         from ..utils import trace_range
 
         t0 = time.time()
-        with trace_range("Fused-Search"):
-            inputs = self._device_inputs(acc_lists, ndm_p, namax)
-            packed, trials = program(*inputs)
-            # ONE gather over ICI/DCN -> host; ``trials`` stays on device
-            packed = fetch_to_host(packed)
+        inputs = self._device_inputs_chunked(plan, acc_lists)
+        while True:
+            program = build_chunked_search(
+                self.mesh,
+                nchans=self.fil.nchans,
+                out_nsamps=self.out_nsamps,
+                size=self.size,
+                ndm_local=ndm_local_p,
+                dm_chunk=plan["dm_chunk"],
+                namax=namax_p,
+                accel_block=plan["accel_block"],
+                bin_width=self.bin_width,
+                tsamp=float(self.fil.tsamp),
+                nharms=cfg.nharmonics,
+                bounds=self.bounds,
+                capacity=cap,
+                min_snr=cfg.min_snr,
+                b5=cfg.boundary_5_freq,
+                b25=cfg.boundary_25_freq,
+                use_zap=bool(len(self.birdies)),
+                compact_k=compact_k,
+                max_shift=self.max_shift,
+                dedisp_method=plan["dedisp_method"],
+                window_slack=plan["window_slack"],
+                dm_tile=plan["dm_tile"],
+                time_tile=plan["time_tile"],
+                chan_group=plan["chan_group"],
+                max_delay_samples=self.max_delay,
+            )
+            with trace_range("Chunked-Search"):
+                packed = fetch_to_host(program(*inputs))
+            per_dm_groups, mx_count, mx_valid = self._decode_packed(
+                packed, ndm_local_p, namax_p, nlevels, cap, compact_k
+            )
+            nxt = self._escalated(
+                cap, compact_k, mx_count, mx_valid,
+                ndm_local_p * namax_p * nlevels * cap,
+            )
+            if nxt is None:
+                break
+            cap, compact_k = nxt
+        timers["dedispersion"] = 0.0  # fused into the search program
+        timers["searching_device"] = time.time() - t0
+        dm_cands = CandidateCollection()
+        for ii in range(ndm):
+            cands_ii = self._distill_dm_row(
+                ii, per_dm_groups.get(ii), acc_lists[ii]
+            )
+            ckpt_done[ii] = cands_ii
+            dm_cands.append(cands_ii)
+        if ckpt:
+            ckpt.save(ckpt_done)
+        timers["searching"] = time.time() - t0
+        result = self._finalise(
+            dm_cands, None, timers, t_total,
+            trials_provider=self._fold_trials_provider,
+        )
+        if ckpt:
+            ckpt.remove()
+        return result
+
+    def _decode_packed(self, packed, ndm_local, namax, nlevels, cap,
+                       compact_k):
+        """Host decode of the per-shard packed peak buffers into
+        (per_dm_groups, max_count, max_nvalid).
+
+        ``max_count`` / ``max_nvalid`` are the TRUE high-water marks
+        (the device reports true above-threshold counts even when the
+        fixed buffers clipped) — the callers re-run with escalated
+        buffer sizes when they exceed capacity, so no candidate is
+        ever silently dropped (the reference simply sizes its buffer
+        at 100000, `peakfinder.hpp:17,61`)."""
+        ndev = self.ndev
         nspec_local = ndm_local * namax * nlevels
         blk_len = 2 * compact_k + nspec_local + 1
         sel_bin = np.empty(ndev * compact_k, np.int32)
         sel_snr = np.empty(ndev * compact_k, np.float32)
-        counts = np.empty((ndm_p, namax, nlevels), np.int32)
+        counts = np.empty((ndev * ndm_local, namax, nlevels), np.int32)
         nvalid = np.empty(ndev, np.int32)
         for sidx in range(ndev):
             blk = packed[sidx * blk_len : (sidx + 1) * blk_len]
@@ -415,28 +753,14 @@ class MeshPulsarSearch(PulsarSearch):
                 .reshape(ndm_local, namax, nlevels)
             )
             nvalid[sidx] = blk[-1:].view(np.int32)[0]
-        timers["dedispersion"] = 0.0  # fused into the search program
-        # sub-span of "searching" (which covers device + host decode)
-        timers["searching_device"] = time.time() - t0
-
-        if counts.max(initial=0) > cap:
-            warnings.warn(
-                f"peak buffer overflow: max count {counts.max()} > "
-                f"capacity {cap}; raise peak_capacity"
-            )
 
         # reconstruct each entry's (dm_local, accel, level) tag from
         # counts (the device compaction keeps valid slots in flat
         # spectrum order), then run the unique-peak merge over ALL
         # spectra in one native segmented call per shard
         factors = np.array([b[2] for b in self.bounds])
-        per_dm_groups: dict[int, list] = {}
+        per_dm_groups: dict[int, tuple] = {}
         for s in range(ndev):
-            if nvalid[s] > compact_k:
-                warnings.warn(
-                    f"compacted peak buffer overflow on shard {s}: "
-                    f"{nvalid[s]} > {compact_k}; raise compact_capacity"
-                )
             k = np.minimum(
                 counts[s * ndm_local : (s + 1) * ndm_local], cap
             ).reshape(-1)
@@ -460,25 +784,163 @@ class MeshPulsarSearch(PulsarSearch):
                 per_dm_groups[int(s * ndm_local + d)] = (
                     freqs[m], merged_snr[m], acc_i[m], lvl[m]
                 )
+        return per_dm_groups, int(counts.max(initial=0)), int(nvalid.max())
 
+    @staticmethod
+    def _escalated(cap, compact_k, max_count, max_nvalid, total_slots):
+        """Next (capacity, compact_k) after an overflow, or None."""
+        import warnings
+
+        new_cap, new_ck = cap, compact_k
+        if max_count > cap:
+            new_cap = 1 << int(np.ceil(np.log2(max_count)))
+        if max_nvalid > compact_k and compact_k < total_slots:
+            new_ck = int(min(
+                total_slots, 1 << int(np.ceil(np.log2(max_nvalid)))
+            ))
+        if (new_cap, new_ck) == (cap, compact_k):
+            return None
+        warnings.warn(
+            f"peak buffers overflowed (count {max_count}/{cap}, "
+            f"compacted {max_nvalid}/{compact_k}); re-running with "
+            f"capacity={new_cap}, compact_capacity={new_ck}"
+        )
+        return new_cap, new_ck
+
+    def _distill_dm_row(self, ii, group, acc_list):
+        """Build + distill one DM trial's candidates from its decoded
+        peak group (None -> no peaks)."""
+        if group is None:
+            return []
+        efreq, esnr, eacc, elvl = group
+        dm = float(self.dm_list[ii])
+        groups = []
+        for j in range(len(acc_list)):
+            m = eacc == j
+            acc = float(acc_list[j])
+            groups.append([
+                Candidate(dm=dm, dm_idx=ii, acc=acc, nh=int(nh),
+                          snr=float(sn), freq=float(fq))
+                for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
+            ])
+        return self._distill_accel_groups(groups)
+
+    def run(self) -> SearchResult:
+        import time
+
+        cfg = self.config
+        timers: dict[str, float] = {}
+        t_total = time.time()
+
+        ndm = len(self.dm_list)
+
+        # checkpoint resume: the mesh search is a single dispatch, so a
+        # complete checkpoint skips the device program entirely (trials
+        # are re-dedispersed only if folding needs them)
+        ckpt, ckpt_done = self._make_checkpoint()
+        if ckpt and len(ckpt_done) == ndm:
+            timers["dedispersion"] = 0.0
+            timers["searching"] = 0.0
+            dm_cands = CandidateCollection()
+            for ii in range(ndm):
+                dm_cands.append(ckpt_done[ii])
+            # a production-scale resume must not fall back to full
+            # trial materialisation: honour the bounded-HBM plan
+            acc_lists = [
+                self.acc_plan.generate_accel_list(dm)
+                for dm in self.dm_list
+            ]
+            namax = max(len(a) for a in acc_lists)
+            plan = self._plan_chunking(namax) if cfg.npdmp > 0 else None
+            if plan is not None:
+                self._chunk_plan = plan
+                self._device_inputs_chunked(plan, acc_lists)
+                result = self._finalise(
+                    dm_cands, None, timers, t_total,
+                    trials_provider=self._fold_trials_provider,
+                )
+            else:
+                trials = (
+                    self.dedisperse_sharded() if cfg.npdmp > 0 else None
+                )
+                result = self._finalise(dm_cands, trials, timers, t_total)
+            ckpt.remove()
+            return result
+        ndm_p = self._padded_trial_count()
+        ndev = self.ndev
+        ndm_local = ndm_p // ndev
+        acc_lists = [
+            self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
+        ]
+        namax = max(len(a) for a in acc_lists)
+
+        plan = self._plan_chunking(namax)
+        if plan is not None:
+            if cfg.verbose:
+                print(
+                    f"chunked search: dm_chunk={plan['dm_chunk']} "
+                    f"accel_block={plan['accel_block']} "
+                    f"dedisp={plan['dedisp_method']}"
+                )
+            return self._run_chunked(
+                plan, acc_lists, namax, timers, t_total, ckpt, ckpt_done
+            )
+        nlevels = cfg.nharmonics + 1
+        cap = cfg.peak_capacity
+        # clamp to the shard's total slot count (small configs)
+        compact_k = min(
+            cfg.compact_capacity, ndm_local * namax * nlevels * cap
+        )
+
+        from ..utils import trace_range
+
+        t0 = time.time()
+        inputs = self._device_inputs(acc_lists, ndm_p, namax)
+        while True:
+            program = build_fused_search(
+                self.mesh,
+                nbits=self.fil.header.nbits,
+                nchans=self.fil.nchans,
+                nsamps=self.fil.nsamps,
+                out_nsamps=self.out_nsamps,
+                size=self.size,
+                bin_width=self.bin_width,
+                tsamp=float(self.fil.tsamp),
+                nharms=cfg.nharmonics,
+                bounds=self.bounds,
+                capacity=cap,
+                min_snr=cfg.min_snr,
+                b5=cfg.boundary_5_freq,
+                b25=cfg.boundary_25_freq,
+                use_zap=bool(len(self.birdies)),
+                use_killmask=self.killmask is not None,
+                compact_k=compact_k,
+                max_shift=self.max_shift,
+            )
+            with trace_range("Fused-Search"):
+                packed, trials = program(*inputs)
+                # ONE gather over ICI/DCN -> host; ``trials`` stays on
+                # device for the folding phase
+                packed = fetch_to_host(packed)
+            per_dm_groups, mx_count, mx_valid = self._decode_packed(
+                packed, ndm_local, namax, nlevels, cap, compact_k
+            )
+            nxt = self._escalated(
+                cap, compact_k, mx_count, mx_valid,
+                ndm_local * namax * nlevels * cap,
+            )
+            if nxt is None:
+                break
+            cap, compact_k = nxt
+        timers["dedispersion"] = 0.0  # fused into the search program
+        # sub-span of "searching" (which covers device + host decode)
+        timers["searching_device"] = time.time() - t0
         dm_cands = CandidateCollection()
         ckpt_done = {}
         for ii in range(ndm):
-            if ii not in per_dm_groups:
-                ckpt_done[ii] = []
-                continue
-            efreq, esnr, eacc, elvl = per_dm_groups[ii]
-            dm = float(self.dm_list[ii])
-            groups = []
-            for j in range(len(acc_lists[ii])):
-                m = eacc == j
-                acc = float(acc_lists[ii][j])
-                groups.append([
-                    Candidate(dm=dm, dm_idx=ii, acc=acc, nh=int(nh),
-                              snr=float(sn), freq=float(fq))
-                    for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
-                ])
-            cands_ii = self._distill_accel_groups(groups)
+            cands_ii = self._distill_dm_row(
+                ii, per_dm_groups.get(ii), acc_lists[ii]
+            )
             ckpt_done[ii] = cands_ii
             dm_cands.append(cands_ii)
         if ckpt:

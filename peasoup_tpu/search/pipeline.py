@@ -229,29 +229,44 @@ class PulsarSearch:
         padded = int(np.ceil(n / chunk)) * chunk
         accs = np.zeros(padded, np.float32)
         accs[:n] = acc_list
-        all_idxs, all_snrs, all_counts = [], [], []
-        for c0 in range(0, padded, chunk):
-            batch = jnp.asarray(accs[c0 : c0 + chunk])
-            idxs, snrs, counts = search_accel_chunk(
-                tim_w, batch, mean, std, float(self.fil.tsamp),
-                cfg.nharmonics, self.bounds, cfg.peak_capacity, cfg.min_snr,
-                self.max_shift,
+        cap = cfg.peak_capacity
+        while True:  # auto-escalate on peak-buffer overflow: no silent
+            all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
+            for c0 in range(0, padded, chunk):
+                batch = jnp.asarray(accs[c0 : c0 + chunk])
+                idxs, snrs, counts = search_accel_chunk(
+                    tim_w, batch, mean, std, float(self.fil.tsamp),
+                    cfg.nharmonics, self.bounds, cap, cfg.min_snr,
+                    self.max_shift,
+                )
+                all_idxs.append(np.asarray(idxs))
+                all_snrs.append(np.asarray(snrs))
+                all_counts.append(np.asarray(counts))
+            mx = int(max(c.max(initial=0) for c in all_counts))
+            if mx <= cap:
+                break
+            import warnings
+
+            cap = 1 << int(np.ceil(np.log2(mx)))
+            warnings.warn(
+                f"peak buffer overflow on DM trial {idx} (count {mx}); "
+                f"re-running with capacity={cap}"
             )
-            all_idxs.append(np.asarray(idxs))
-            all_snrs.append(np.asarray(snrs))
-            all_counts.append(np.asarray(counts))
         return self.process_dm_peaks(
             dm, idx, acc_list,
             np.concatenate(all_idxs), np.concatenate(all_snrs),
             np.concatenate(all_counts),
+            capacity=cap,
         )
 
-    def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts):
+    def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts,
+                         capacity=None):
         """Turn per-(accel, spectrum) peak buffers into distilled per-DM
         candidates."""
         groups = [
             self._peaks_to_candidates(
-                idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc)
+                idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc),
+                capacity,
             )
             for j, acc in enumerate(acc_list)
         ]
@@ -272,11 +287,12 @@ class PulsarSearch:
         acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
         return acc_still.distill(accel_trial_cands)
 
-    def _peaks_to_candidates(self, idxs, snrs, counts, dm, dm_idx, acc):
+    def _peaks_to_candidates(self, idxs, snrs, counts, dm, dm_idx, acc,
+                             capacity=None):
         cands: list[Candidate] = []
         for level, (start, stop, factor) in enumerate(self.bounds):
             cnt = int(counts[level])
-            cap = self.config.peak_capacity
+            cap = capacity or self.config.peak_capacity
             take = min(cnt, cap)
             if cnt > cap:
                 import warnings
@@ -352,9 +368,15 @@ class PulsarSearch:
             ckpt.remove()  # run completed; resume no longer needed
         return result
 
-    def _finalise(self, dm_cands, trials, timers, t_total) -> SearchResult:
+    def _finalise(self, dm_cands, trials, timers, t_total,
+                  trials_provider=None) -> SearchResult:
         """Shared tail of every driver (`pipeline_multi.cu:362-391`):
-        cross-DM distillation, scoring, folding, limit, result."""
+        cross-DM distillation, scoring, folding, limit, result.
+
+        ``trials_provider``: bounded-HBM drivers pass a callable
+        (dm_idxs) -> (trials, row_map) instead of resident trials; the
+        candidate DM rows are re-dedispersed only if folding runs.
+        """
         cfg = self.config
         dm_still = DMDistiller(cfg.freq_tol, True)
         harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
@@ -373,12 +395,25 @@ class PulsarSearch:
 
         t0 = time.time()
         if cfg.npdmp > 0:
-            with trace_range("Folding"):
-                fold_candidates(
-                    cands, trials, self.out_nsamps, hdr.tsamp, cfg.npdmp,
-                    boundary_5_freq=cfg.boundary_5_freq,
-                    boundary_25_freq=cfg.boundary_25_freq,
-                )
+            dm_row_lookup = None
+            if trials is None and trials_provider is not None:
+                # same filter fold_candidates applies — don't
+                # re-dedisperse rows that will never be folded
+                fold_dms = {
+                    c.dm_idx for c in cands[: cfg.npdmp]
+                    if FOLD_MIN_PERIOD < 1.0 / c.freq < FOLD_MAX_PERIOD
+                }
+                if fold_dms:
+                    trials, dm_row_lookup = trials_provider(fold_dms)
+            if trials is not None:
+                with trace_range("Folding"):
+                    fold_candidates(
+                        cands, trials, self.out_nsamps, hdr.tsamp,
+                        cfg.npdmp,
+                        boundary_5_freq=cfg.boundary_5_freq,
+                        boundary_25_freq=cfg.boundary_25_freq,
+                        dm_row_lookup=dm_row_lookup,
+                    )
         timers["folding"] = time.time() - t0
 
         cands = cands[: cfg.limit]
@@ -396,6 +431,11 @@ class PulsarSearch:
 # --------------------------------------------------------------------------
 # folding (MultiFolder equivalent, folder.hpp:337-442)
 # --------------------------------------------------------------------------
+
+# foldable-period window (`folder.hpp:424-427`); shared between
+# fold_candidates and the _finalise pre-filter
+FOLD_MIN_PERIOD = 0.001
+FOLD_MAX_PERIOD = 10.0
 
 def _rewhiten_core(tim, bin_width):
     """The fold path re-whitens without zapping or interbinning
@@ -457,13 +497,18 @@ def fold_candidates(
     npdmp: int,
     nbins: int = 64,
     nints: int = 16,
-    min_period: float = 0.001,
-    max_period: float = 10.0,
+    min_period: float = FOLD_MIN_PERIOD,
+    max_period: float = FOLD_MAX_PERIOD,
     boundary_5_freq: float = 0.05,
     boundary_25_freq: float = 0.5,
+    dm_row_lookup: dict | None = None,
 ) -> None:
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
-    by max(snr, folded_snr) (`folder.hpp:424-434,25-31`)."""
+    by max(snr, folded_snr) (`folder.hpp:424-434,25-31`).
+
+    ``dm_row_lookup`` maps candidate ``dm_idx`` to a row of ``trials``
+    when the caller passes a compacted trials array (the bounded-HBM
+    path re-dedisperses only the candidate DM rows)."""
     # both drivers hand over trials with >= prev_power_of_two(
     # trials_nsamps) real columns; a narrower caller gets zero-padded
     # so the fold FFT length stays the reference's power of two
@@ -481,7 +526,11 @@ def fold_candidates(
     if not fold_ids:
         cands.sort(key=lambda c: -max(c.snr, c.folded_snr))
         return
-    dm_idxs = jnp.asarray([cands[i].dm_idx for i in fold_ids], jnp.int32)
+    lookup = dm_row_lookup if dm_row_lookup is not None else {}
+    dm_idxs = jnp.asarray(
+        [lookup.get(cands[i].dm_idx, cands[i].dm_idx) for i in fold_ids],
+        jnp.int32,
+    )
     accs = jnp.asarray([cands[i].acc for i in fold_ids], jnp.float32)
     # f32: x64 is disabled on TPU and the relative phase error over a
     # 2^17-sample fold (~1e-7) is far below one phase bin

@@ -57,6 +57,12 @@ class SearchConfig:
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step
     compact_capacity: int = 131072  # per-shard compacted peak buffer (fused)
+    # bounded-HBM chunked execution (production scale: the reference
+    # streams one DM trial at a time, `src/pipeline_multi.cu:145-157`;
+    # we stream DM chunks x accel blocks through one scanned program)
+    hbm_budget_gb: float = 13.0  # per-device working-set budget
+    dm_chunk: int = 0            # DM trials per chunk step (0 = auto)
+    accel_block: int = 0         # accel trials per inner step (0 = auto)
     checkpoint_file: str = ""      # per-DM candidate checkpoint (resume)
     checkpoint_interval: int = 8   # host-loop trials between checkpoint saves
     infilename: str = ""
