@@ -1,0 +1,44 @@
+"""Device-true timing for the axon-tunnelled TPU.
+
+`jax.block_until_ready` does not synchronise through the axon loopback
+relay (a 8192^3 matmul appears to run at 30 PFLOP/s), so wall-clock
+around dispatches measures nothing.  The only reliable fence is a
+device->host transfer.  This harness chains ``iters`` applications of
+the op inside one jitted `lax.scan`, fetches a single scalar, and
+subtracts the 1-iteration run to cancel the tunnel round-trip and
+dispatch overhead:
+
+    per_iter = (t(iters) - t(1)) / (iters - 1)
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def time_op(step_fn, x0, iters: int = 64, repeats: int = 3) -> float:
+    """Median per-iteration seconds of ``step_fn`` (x -> x-like)."""
+
+    def chained(n):
+        def body(c, _):
+            return step_fn(c), None
+
+        # sum the FULL carry: slicing it lets XLA narrow the whole
+        # loop's dependency cone to the sliced elements for
+        # elementwise bodies, timing nothing
+        f = jax.jit(lambda x: jnp.sum(
+            jnp.abs(jax.lax.scan(body, x, None, length=n)[0])))
+        float(f(x0))  # compile + warm
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(f(x0))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t1 = chained(1)
+    tn = chained(iters)
+    return max(tn - t1, 1e-12) / (iters - 1)

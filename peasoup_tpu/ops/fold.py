@@ -33,6 +33,17 @@ import jax
 import jax.numpy as jnp
 
 
+def phase_bins(nsamps: int, period, tsamp, nbins: int) -> jnp.ndarray:
+    """Per-sample phase-bin assignment, matching the reference's
+    ``__double2int_rd(modf(jj * (tsamp/period)) * nbins)``
+    (`src/kernels.cu:621-627`, f64 with the precomputed tsamp/period)."""
+    j = jnp.arange(nsamps, dtype=jnp.float64)
+    tbp = jnp.asarray(tsamp, jnp.float64) / jnp.asarray(period, jnp.float64)
+    phase = j * tbp
+    frac = phase - jnp.floor(phase)
+    return jnp.floor(frac * nbins).astype(jnp.int32)
+
+
 def fold_time_series_core(
     tim: jnp.ndarray, period, tsamp, nbins: int = 64, nints: int = 16
 ) -> jnp.ndarray:
@@ -40,11 +51,7 @@ def fold_time_series_core(
     nsamps = tim.shape[0]
     nper = nsamps // nints
     used = nper * nints
-    j = jnp.arange(used, dtype=jnp.float64)
-    tbp = jnp.asarray(tsamp, jnp.float64) / jnp.asarray(period, jnp.float64)
-    phase = j * tbp
-    frac = phase - jnp.floor(phase)
-    binidx = jnp.floor(frac * nbins).astype(jnp.int32)
+    binidx = phase_bins(used, period, tsamp, nbins)
     subint = (jnp.arange(used, dtype=jnp.int32) // nper).astype(jnp.int32)
     flat = subint * nbins + binidx
     sums = jax.ops.segment_sum(tim[:used], flat, num_segments=nints * nbins)

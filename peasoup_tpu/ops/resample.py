@@ -20,6 +20,9 @@ dynamic-slice-like access pattern rather than a random gather.
 
 from __future__ import annotations
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 SPEED_OF_LIGHT = 299792458.0
@@ -83,3 +86,260 @@ def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None
         out = jnp.where(d == k, padded[max_shift + k : max_shift + k + n],
                         out)
     return out
+
+
+def _exact_offset(i: jnp.ndarray, af, n: int) -> jnp.ndarray:
+    """d(i) = rint(i + i*af*(i-n)) - i, evaluated exactly in f64 —
+    the reference's read-index offset (`src/kernels.cu:335-362`)."""
+    i = i.astype(jnp.float64)
+    return jnp.rint(i + i * af * (i - jnp.float64(n))) - i
+
+
+def _offset_boundaries(af, n: int, max_shift: int):
+    """Positions where the kernel-II offset staircase steps.
+
+    ``d(i)`` follows the parabola ``i*af*(i-n)``: |d| rises 0 -> K1 on
+    [0, n/2] then falls back on [n/2, n), always in unit steps (the
+    parabola's per-sample slope is < 1 for any max_shift < n/4).  The
+    step positions are found by bisection on the EXACT f64 formula —
+    O(max_shift * log n) evaluations on tiny arrays instead of O(n)
+    software-emulated f64 ops per call.
+
+    Returns (bounds, steps): int32[2*max_shift] sorted positions
+    (inactive entries = n) and the signed step of ``d`` at each.
+    """
+    vh = n // 2
+    sign = jnp.where(jnp.asarray(af, jnp.float64) >= 0, 1.0, -1.0)
+    u = lambda i: (-sign * _exact_offset(i, af, n)).astype(jnp.int32)
+    k = jnp.arange(1, max_shift + 1, dtype=jnp.int32)
+    n_iters = int(np.ceil(np.log2(max(n, 2)))) + 1
+
+    def bisect(lo, hi, pred):
+        # first integer in (lo, hi] where pred holds; pred monotone
+        def body(_, lh):
+            lo, hi = lh
+            mid = (lo + hi) // 2
+            p = pred(mid)
+            return jnp.where(p, lo, mid), jnp.where(p, mid, hi)
+
+        lo, hi = jax.lax.fori_loop(
+            0, n_iters, body,
+            (jnp.full_like(k, lo), jnp.full_like(k, hi)))
+        return hi
+
+    k1 = u(jnp.asarray(vh))
+    kend = u(jnp.asarray(n - 1))
+    # rising half: first i with u(i) >= k, for k = 1..K1
+    b = bisect(0, vh, lambda m: u(m) >= k)
+    b = jnp.where(k <= k1, b, n)
+    # falling half: first i with u(i) <= K1 - k, for k = 1..K1-u(n-1)
+    c = bisect(vh, n - 1, lambda m: u(m) <= k1 - k)
+    c = jnp.where(k <= k1 - kend, c, n)
+    bounds = jnp.concatenate([b, c]).astype(jnp.int32)
+    steps = jnp.concatenate(
+        [jnp.full_like(b, -1), jnp.full_like(c, 1)]
+    ) * sign.astype(jnp.int32)
+    order = jnp.argsort(bounds)
+    return bounds[order], steps[order]
+
+
+def residual_width(max_shift: int, block: int, n: int) -> int:
+    """Static per-block residual-table width: the staircase's maximum
+    step count inside one block (derivative bound) + 2 for the two
+    independent roundings at the block base and the element.  Single
+    source of truth for the table builders and the block chooser."""
+    return int(np.ceil(4.0 * max_shift * block / n)) + 2
+
+
+def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
+                         block: int, kernel: int = 2):
+    """Host-side (exact IEEE f64) per-block index tables for the
+    resampling offset staircases, vectorised over accel trials.
+
+    On real TPU hardware float64 is software-emulated and its
+    ``round[NEAREST_EVEN]`` lowering is WRONG for a few percent of
+    values (verified on v5e: e.g. rint(42136.49999354) -> 42135), so
+    any device-side f64 index math is silently inexact there.  The
+    acceleration trial list is always known on the host, so the exact
+    staircase is computed here in hardware f64 and shipped as tiny
+    int32 tables; the device then does only integer compares/selects.
+
+    ``kernel`` selects the reference formula: 2 = shipped search
+    binary's ``rn(i + i*af*(i-n))`` (`src/kernels.cu:335-362`), 1 =
+    folding path's ``rn(i + af*((i-n/2)^2 - (n/2)^2))``
+    (`src/kernels.cu:364-379`).  Both follow the same parabola, but
+    the fp evaluation order differs, so boundaries are bisected on the
+    exact per-kernel expression.
+
+    Returns (d0[A, nb], pos[A, nb, m], step[A, nb, m]) numpy int32:
+    block-start offsets, and the position/sign of each staircase step
+    strictly inside each block (inactive slots: pos = n, step = 0).
+    """
+    afs = np.atleast_1d(np.asarray(afs, np.float64))
+    A = afs.shape[0]
+    nb = n // block
+    m = residual_width(max_shift, block, n)
+    col = afs[:, None]
+    if kernel == 2:
+        d_of = lambda i: np.rint(i + i * col * (i - np.float64(n))) - i
+    else:
+        half = np.float64(n) / 2.0
+        d_of = lambda i: (
+            np.rint(i + col * ((i - half) ** 2 - half * half)) - i)
+    sign = np.where(afs >= 0, 1.0, -1.0)[:, None]
+    u_of = lambda i: (-sign * d_of(np.asarray(i, np.float64))).astype(
+        np.int64)
+    vh = n // 2
+    k = np.broadcast_to(
+        np.arange(1, max_shift + 1, dtype=np.int64), (A, max_shift))
+
+    def bisect(lo0, hi0, pred):
+        lo = np.full((A, max_shift), lo0, np.int64)
+        hi = np.full((A, max_shift), hi0, np.int64)
+        for _ in range(int(np.ceil(np.log2(max(n, 2)))) + 1):
+            mid = (lo + hi) // 2
+            p = pred(mid)
+            lo, hi = np.where(p, lo, mid), np.where(p, mid, hi)
+        return hi
+
+    k1 = u_of(np.full((A, 1), vh))
+    kend = u_of(np.full((A, 1), n - 1))
+    b = np.where(k <= k1, bisect(0, vh, lambda mid: u_of(mid) >= k), n)
+    c = np.where(k <= k1 - kend,
+                 bisect(vh, n - 1, lambda mid: u_of(mid) <= k1 - k), n)
+    i0 = np.arange(nb, dtype=np.float64) * block
+    d0 = d_of(i0).astype(np.int32)
+    pos_t = np.full((A, nb, m), n, np.int32)
+    step_t = np.zeros((A, nb, m), np.int32)
+    s_int = sign.astype(np.int32).ravel()
+    for a in range(A):
+        bounds = np.concatenate([b[a], c[a]])
+        steps = np.concatenate(
+            [np.full(max_shift, -s_int[a], np.int32),
+             np.full(max_shift, s_int[a], np.int32)])
+        active = (bounds < n) & (bounds % block != 0)
+        bounds, steps = bounds[active], steps[active]
+        order = np.argsort(bounds, kind="stable")
+        bounds, steps = bounds[order], steps[order]
+        blk = bounds // block
+        rank = np.arange(len(bounds)) - np.searchsorted(
+            blk, blk, side="left")
+        if len(rank) and rank.max() >= m:
+            raise AssertionError(
+                "staircase step density exceeded static bound")
+        pos_t[a, blk, rank] = bounds
+        step_t[a, blk, rank] = steps
+    return d0, pos_t, step_t
+
+
+def _afs(accels, tsamp) -> np.ndarray:
+    return (np.atleast_1d(np.asarray(accels, np.float64))
+            * np.float64(tsamp) / (2.0 * SPEED_OF_LIGHT))
+
+
+def resample2_tables(accels, tsamp, n: int, max_shift: int,
+                     block: int = 4096):
+    """Exact host-side kernel-II index tables for a batch of accel
+    trials: (d0[A, nb], pos[A, nb, m], step[A, nb, m]), ready to vmap
+    :func:`resample2_from_tables` over."""
+    return _staircase_tables_np(_afs(accels, tsamp), n, max_shift, block,
+                                kernel=2)
+
+
+def resample1_tables(accels, tsamp, n: int, max_shift: int,
+                     block: int = 4096):
+    """Exact host-side kernel-I (folding-path) index tables."""
+    return _staircase_tables_np(_afs(accels, tsamp), n, max_shift, block,
+                                kernel=1)
+
+
+def resample2_unique_tables(accs_grid, tsamp, n: int, max_shift: int,
+                            block: int = 4096):
+    """Tables for a NaN-padded (ndm, namax) accel grid, deduplicated.
+
+    Accel values repeat heavily across DM trials (0 is in every list,
+    grids overlap), so tables are built once per UNIQUE accel and the
+    grid maps to rows via ``uidx``.  NaN padding slots map to the 0.0
+    row (their outputs are masked anyway).
+
+    Returns (d0_u[U, nb], pos_u[U, nb, m], step_u[U, nb, m],
+    uidx[ndm, namax] int32).
+    """
+    grid = np.nan_to_num(np.asarray(accs_grid, np.float64))
+    uniq, inv = np.unique(grid, return_inverse=True)
+    d0, pos, step = resample2_tables(uniq, tsamp, n, max_shift, block=block)
+    return d0, pos, step, inv.reshape(grid.shape).astype(np.int32)
+
+
+def resample2_from_tables(tim: jnp.ndarray, d0: jnp.ndarray,
+                          pos_t: jnp.ndarray, step_t: jnp.ndarray,
+                          max_shift: int, block: int = 4096) -> jnp.ndarray:
+    """Kernel-II resampling from host-precomputed index tables: pure
+    int32 compares + static selects + one contiguous slice per block —
+    no device f64, exact on TPU (see `_staircase_tables_np`)."""
+    n = tim.shape[0]
+    nb, m = pos_t.shape
+    pad = max_shift + m
+    padded = jnp.pad(tim, (pad, pad), mode="edge")
+    starts = (pad - m) + (jnp.arange(nb, dtype=jnp.int32) * block + d0)
+    blocks = jax.vmap(
+        lambda s: jax.lax.dynamic_slice(padded, (s,), (block + 2 * m,))
+    )(starts)
+    i_global = (jnp.arange(nb, dtype=jnp.int32)[:, None] * block
+                + jnp.arange(block, dtype=jnp.int32)[None, :])
+    sel = jnp.full((nb, block), m, jnp.int32)
+    for slot in range(m):
+        sel = sel + step_t[:, slot:slot + 1] * (
+            i_global >= pos_t[:, slot:slot + 1])
+    out = jnp.zeros((nb, block), tim.dtype)
+    for k in range(2 * m + 1):
+        out = jnp.where(
+            sel == k, jax.lax.slice_in_dim(blocks, k, k + block, axis=1),
+            out)
+    return out.reshape(n)
+
+
+def resample2_blockwise(tim: jnp.ndarray, accel, tsamp, max_shift: int,
+                        block: int = 4096) -> jnp.ndarray:
+    """Kernel-II resampling for the high-acceleration regime
+    (``max_shift`` too large for the select path).
+
+    The read-index offset ``d(i) = idx(i) - i`` is slowly varying:
+    ``|d'| <= |af|*n = 4*max_shift/n`` per sample, so across a block of
+    ``block`` samples it changes by at most ``ceil(4*max_shift*block/n)``.
+    That turns the 2^23-element random gather (TPU's weakest access
+    pattern) into (a) one *contiguous* dynamic-slice per block at the
+    block's base offset — a coalesced block gather XLA handles at near
+    copy bandwidth — plus (b) a select over the few within-block
+    residual shifts.  Bit-exact with the plain-gather path (same f64
+    rounded index formula; edge padding == the reference's index clip,
+    `src/kernels.cu:335-362`).
+    """
+    n = tim.shape[0]
+    if n % block:
+        return resample2(tim, accel, tsamp, max_shift=max_shift)
+    af = _accel_fact(accel, tsamp)
+    m = residual_width(max_shift, block, n)
+    nb = n // block
+    d0 = _exact_offset(
+        jnp.arange(nb, dtype=jnp.float64) * block, af, n).astype(jnp.int32)
+    # per-element residual d(i) - d0 via the staircase boundaries that
+    # fall strictly inside each block (a boundary AT the block start is
+    # already counted in d0): scatter (position, step) pairs into an
+    # (nb, m) table; the device body then does m broadcast compares —
+    # no per-element f64
+    bounds, steps = _offset_boundaries(af, n, max_shift)
+    interior = (bounds % block) != 0
+    blk = jnp.where(interior, bounds // block, nb)
+    # inactive entries (blk = nb) break blk's sortedness — stable
+    # re-sort so same-block entries are contiguous for the rank compute
+    order = jnp.argsort(blk, stable=True)
+    blk, bounds, steps = blk[order], bounds[order], steps[order]
+    rank = jnp.arange(bounds.shape[0]) - jnp.searchsorted(
+        blk, blk, side="left")
+    pos_t = jnp.full((nb, m), n, jnp.int32).at[blk, rank].set(
+        bounds, mode="drop")
+    step_t = jnp.zeros((nb, m), jnp.int32).at[blk, rank].set(
+        steps, mode="drop")
+    return resample2_from_tables(tim, d0, pos_t, step_t, max_shift,
+                                 block=block)

@@ -39,6 +39,7 @@ from ..search.pipeline import (
     PulsarSearch,
     SearchResult,
     search_one_accel,
+    search_one_accel_legacy,
     whiten_core,
 )
 from ..search.plan import SearchConfig
@@ -59,20 +60,32 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
 
 def _search_dm_row(tim, accs_row, birdies, widths, *, bin_width, tsamp,
                    nharms, bounds, capacity, min_snr, b5, b25, use_zap,
-                   max_shift=None):
+                   max_shift=None, rtab=None, block=None):
     """Whiten one DM trial and search its (NaN-padded) accel batch.
 
     Shared body of both sharded programs: returns (idxs, snrs, counts)
     with padded accel slots fully masked out.
+
+    ``rtab = (uidx_row, d0_u, pos_u, step_u)`` selects the host-exact
+    table resampler (uidx_row maps each accel slot to its unique-accel
+    table row); None falls back to on-device index math.
     """
     tim_w, mean, std = whiten_core(
         tim, birdies, widths, bin_width, b5, b25, use_zap
     )
-    search = lambda a: search_one_accel(
-        tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
-        capacity, min_snr, max_shift,
-    )
-    idxs, snrs, counts = jax.vmap(search)(accs_row)
+    if rtab is not None:
+        uidx_row, d0_u, pos_u, step_u = rtab
+        search = lambda ui: search_one_accel(
+            tim_w, (d0_u[ui], pos_u[ui], step_u[ui]), mean, std, tsamp,
+            nharms, bounds, capacity, min_snr, max_shift, block,
+        )
+        idxs, snrs, counts = jax.vmap(search)(uidx_row)
+    else:
+        search = lambda a: search_one_accel_legacy(
+            tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
+            capacity, min_snr, max_shift,
+        )
+        idxs, snrs, counts = jax.vmap(search)(accs_row)
     valid = ~jnp.isnan(accs_row)
     idxs = jnp.where(valid[:, None, None], idxs, -1)
     snrs = jnp.where(valid[:, None, None], snrs, 0.0)
@@ -179,6 +192,7 @@ def build_fused_search(
     use_killmask: bool,
     compact_k: int,
     max_shift: int | None = None,
+    block: int | None = None,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -206,13 +220,18 @@ def build_fused_search(
     device-resident for the folding phase; never copied to host.
 
     Returns a jitted callable
-    ``fn(raw, delays, killmask, accs, birdies, widths)``.
+    ``fn(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
+    birdies, widths)``.  The table args are always required; when
+    ``block`` is None (legacy on-device resampler path) they are
+    unused dummies (see ``MeshPulsarSearch._resample_tables``).
     """
     from ..ops.unpack import unpack_bits_device
 
     nlevels = nharms + 1
+    use_tables = block is not None
 
-    def shard_fn(raw, delays, killmask, accs, birdies, widths):
+    def shard_fn(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
+                 birdies, widths):
         vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
         data = vals.reshape(nsamps, nchans).T.astype(jnp.float32)
         if use_killmask:
@@ -231,18 +250,22 @@ def build_fused_search(
             )
             trials_sz = jnp.concatenate([trials, pad], axis=1)
 
-        def per_dm(tim, accs_row):
+        def per_dm(tim, accs_row, uidx_row):
+            rtab = (
+                (uidx_row, d0_u, pos_u, step_u) if use_tables else None
+            )
             return _search_dm_row(
                 tim, accs_row, birdies, widths, bin_width=bin_width,
                 tsamp=tsamp, nharms=nharms, bounds=bounds,
                 capacity=capacity, min_snr=min_snr, b5=b5, b25=b25,
-                use_zap=use_zap, max_shift=max_shift,
+                use_zap=use_zap, max_shift=max_shift, rtab=rtab,
+                block=block,
             )
 
         # vmap (not scan): all local DM trials are one batch of FFTs /
         # gathers / top_ks, keeping the VPU/MXU fed instead of running
         # 59 small sequential program iterations
-        idxs, snrs, counts = jax.vmap(per_dm)(trials_sz, accs)
+        idxs, snrs, counts = jax.vmap(per_dm)(trials_sz, accs, uidx)
         packed = _compact_peaks(idxs, snrs, counts, compact_k)
         return packed, trials
 
@@ -250,7 +273,8 @@ def build_fused_search(
         shard_fn,
         mesh=mesh,
         in_specs=(
-            P(), P("dm", None), P(), P("dm", None), P(), P(),
+            P(), P("dm", None), P(), P("dm", None), P("dm", None),
+            P(), P(), P(), P(), P(),
         ),
         out_specs=(P("dm"), P("dm", None)),
     )
@@ -285,6 +309,7 @@ def build_chunked_search(
     time_tile: int = 15360,
     chan_group: int = 16,
     max_delay_samples: int = 0,
+    block: int | None = None,
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -313,9 +338,11 @@ def build_chunked_search(
     pre-applies the killmask and pre-pads the tail so the Pallas
     kernel's window padding is a no-op on the hot path.
 
-    Returns a jitted ``fn(data, delays, accs, birdies, widths) ->
-    packed`` with delays/accs sharded over ``dm`` and
-    ``ndm_local = n_chunks * dm_chunk`` rows per shard.
+    Returns a jitted ``fn(data, delays, accs, uidx, d0_u, pos_u,
+    step_u, birdies, widths) -> packed`` with delays/accs/uidx sharded
+    over ``dm`` and ``ndm_local = n_chunks * dm_chunk`` rows per shard.
+    The table args are always required; with ``block=None`` they are
+    unused dummies (see ``MeshPulsarSearch._resample_tables``).
     """
     from ..ops.dedisperse_pallas import dedisperse_pallas
 
@@ -324,8 +351,10 @@ def build_chunked_search(
     n_ablocks = namax // accel_block
     assert ndm_local == n_chunks * dm_chunk
     assert namax == n_ablocks * accel_block
+    use_tables = block is not None
 
-    def shard_fn(data, delays, accs, birdies, widths):
+    def shard_fn(data, delays, accs, uidx, d0_u, pos_u, step_u, birdies,
+                 widths):
         def chunk_body(_, ci):
             z = jnp.int32(0)  # literal 0 is weak-i64 under x64
             delays_c = lax.dynamic_slice(
@@ -333,6 +362,9 @@ def build_chunked_search(
             )
             accs_c = lax.dynamic_slice(
                 accs, (ci * dm_chunk, z), (dm_chunk, namax)
+            )
+            uidx_c = lax.dynamic_slice(
+                uidx, (ci * dm_chunk, z), (dm_chunk, namax)
             )
             if dedisp_method == "pallas":
                 trials = dedisperse_pallas(
@@ -362,20 +394,33 @@ def build_chunked_search(
                     accs_c, (jnp.int32(0), ai * accel_block),
                     (dm_chunk, accel_block),
                 )
+                uidx_blk = lax.dynamic_slice(
+                    uidx_c, (jnp.int32(0), ai * accel_block),
+                    (dm_chunk, accel_block),
+                )
 
-                def row(tw, m, s, arow):
-                    search = lambda a: search_one_accel(
-                        tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
-                        bounds, capacity, min_snr, max_shift,
-                    )
-                    i2, s2, c2 = jax.vmap(search)(arow)
+                def row(tw, m, s, arow, urow):
+                    if use_tables:
+                        search = lambda ui: search_one_accel(
+                            tw, (d0_u[ui], pos_u[ui], step_u[ui]), m, s,
+                            tsamp, nharms, bounds, capacity, min_snr,
+                            max_shift, block,
+                        )
+                        i2, s2, c2 = jax.vmap(search)(urow)
+                    else:
+                        search = lambda a: search_one_accel_legacy(
+                            tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
+                            bounds, capacity, min_snr, max_shift,
+                        )
+                        i2, s2, c2 = jax.vmap(search)(arow)
                     valid = ~jnp.isnan(arow)
                     i2 = jnp.where(valid[:, None, None], i2, -1)
                     s2 = jnp.where(valid[:, None, None], s2, 0.0)
                     c2 = jnp.where(valid[:, None], c2, 0)
                     return i2, s2, c2
 
-                return 0, jax.vmap(row)(tim_w, mean, std, accs_blk)
+                return 0, jax.vmap(row)(tim_w, mean, std, accs_blk,
+                                        uidx_blk)
 
             _, (bi, bs, bc) = lax.scan(
                 ab_body, 0, jnp.arange(n_ablocks, dtype=jnp.int32)
@@ -401,7 +446,8 @@ def build_chunked_search(
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P("dm", None), P("dm", None), P(), P()),
+        in_specs=(P(), P("dm", None), P("dm", None), P("dm", None),
+                  P(), P(), P(), P(), P()),
         out_specs=P("dm"),
         # pallas_call out_shapes carry no varying-mesh-axes annotation;
         # every output here is trivially dm-varying, so skip the check
@@ -475,15 +521,38 @@ class MeshPulsarSearch(PulsarSearch):
             raw = pack_bits(self.fil.data.ravel(), nbits)
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
+        uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
         self._dev_inputs = (
             jax.device_put(jnp.asarray(raw), rep),
             jax.device_put(jnp.asarray(delays), shard),
             jax.device_put(jnp.asarray(killmask, dtype=jnp.float32), rep),
             jax.device_put(jnp.asarray(accs), shard),
+            jax.device_put(jnp.asarray(uidx), shard),
+            jax.device_put(jnp.asarray(d0_u), rep),
+            jax.device_put(jnp.asarray(pos_u), rep),
+            jax.device_put(jnp.asarray(step_u), rep),
             jax.device_put(jnp.asarray(self.birdies), rep),
             jax.device_put(jnp.asarray(self.bwidths), rep),
         )
         return self._dev_inputs
+
+    def _resample_tables(self, accs: np.ndarray):
+        """Host-exact unique-accel resample tables for a NaN-padded
+        accel grid (dummies when the legacy path is active)."""
+        if self.resample_block is None:
+            return (
+                np.zeros(accs.shape, np.int32),
+                np.zeros((1, 1), np.int32),
+                np.zeros((1, 1, 1), np.int32),
+                np.zeros((1, 1, 1), np.int32),
+            )
+        from ..ops.resample import resample2_unique_tables
+
+        d0_u, pos_u, step_u, uidx = resample2_unique_tables(
+            accs, float(self.fil.tsamp), self.size, self.max_shift,
+            block=self.resample_block,
+        )
+        return uidx, d0_u, pos_u, step_u
 
     # -- bounded-HBM chunked path (production scale) --------------------
 
@@ -607,10 +676,15 @@ class MeshPulsarSearch(PulsarSearch):
             data[:, :nsamps] *= self.killmask[:, None].astype(data.dtype)
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
+        uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
         self._dev_inputs_chunked = (
             jax.device_put(jnp.asarray(data), rep),
             jax.device_put(jnp.asarray(delays), shard),
             jax.device_put(jnp.asarray(accs), shard),
+            jax.device_put(jnp.asarray(uidx), shard),
+            jax.device_put(jnp.asarray(d0_u), rep),
+            jax.device_put(jnp.asarray(pos_u), rep),
+            jax.device_put(jnp.asarray(step_u), rep),
             jax.device_put(jnp.asarray(self.birdies), rep),
             jax.device_put(jnp.asarray(self.bwidths), rep),
         )
@@ -688,6 +762,7 @@ class MeshPulsarSearch(PulsarSearch):
                 time_tile=plan["time_tile"],
                 chan_group=plan["chan_group"],
                 max_delay_samples=self.max_delay,
+                block=self.resample_block,
             )
             with trace_range("Chunked-Search"):
                 packed = fetch_to_host(program(*inputs))
@@ -916,6 +991,7 @@ class MeshPulsarSearch(PulsarSearch):
                 use_killmask=self.killmask is not None,
                 compact_k=compact_k,
                 max_shift=self.max_shift,
+                block=self.resample_block,
             )
             with trace_range("Fused-Search"):
                 packed, trials = program(*inputs)

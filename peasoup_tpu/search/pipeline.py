@@ -87,9 +87,24 @@ whiten_trial = jax.jit(
 )
 
 
-def search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
-                     min_snr, max_shift=None):
-    tim_r = resample2(tim_w, accel, tsamp, max_shift)
+def resample_block_for(n: int, max_shift: int) -> int | None:
+    """Block size for the table-driven resampler: the largest power of
+    two dividing ``n``, capped at 16384 (the measured sweet spot on
+    v5e).  None if ``n`` has no useful power-of-two factor (the legacy
+    on-device path handles that)."""
+    from ..ops.resample import residual_width
+
+    b = n & -n  # largest power-of-two divisor
+    b = min(b, 16384)
+    if b < 128:
+        return None
+    # keep the per-block residual table narrow even for huge shifts
+    while residual_width(max_shift, b, n) > 18 and b > 128:
+        b //= 2
+    return b
+
+
+def _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr):
     fs = jnp.fft.rfft(tim_r).astype(jnp.complex64)
     pspec = form_interpolated(fs)
     pspec = ((pspec - mean) / std).astype(jnp.float32)
@@ -103,16 +118,53 @@ def search_one_accel(tim_w, accel, mean, std, tsamp, nharms, bounds, capacity,
     return jnp.stack(idxs), jnp.stack(snrs), jnp.stack(counts)
 
 
+def search_one_accel(tim_w, rtab, mean, std, tsamp, nharms, bounds, capacity,
+                     min_snr, max_shift, block):
+    from ..ops.resample import resample2_from_tables
+
+    d0, pos_t, step_t = rtab
+    tim_r = resample2_from_tables(tim_w, d0, pos_t, step_t, max_shift,
+                                  block=block)
+    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "tsamp", "nharms", "bounds", "capacity", "min_snr", "max_shift",
+        "block",
+    ),
+)
+def search_accel_chunk(tim_w, rtabs, mean, std, tsamp, nharms, bounds,
+                       capacity, min_snr, max_shift, block):
+    """vmapped acceleration-trial batch: per-accel host-exact resample
+    tables (d0[A,nb], pos[A,nb,m], step[A,nb,m]) -> peak buffers."""
+    fn = lambda t: search_one_accel(
+        tim_w, t, mean, std, tsamp, nharms, bounds, capacity, min_snr,
+        max_shift, block,
+    )
+    return jax.vmap(fn)(rtabs)
+
+
+def search_one_accel_legacy(tim_w, accel, mean, std, tsamp, nharms, bounds,
+                            capacity, min_snr, max_shift=None):
+    """On-device index math fallback for fft sizes with no power-of-two
+    factor (no host tables).  NB: on real TPU hardware the emulated-f64
+    rint is inexact for a small fraction of indices; the table path is
+    exact and preferred."""
+    tim_r = resample2(tim_w, accel, tsamp, max_shift)
+    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr)
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "tsamp", "nharms", "bounds", "capacity", "min_snr", "max_shift",
     ),
 )
-def search_accel_chunk(tim_w, accels, mean, std, tsamp, nharms, bounds,
-                       capacity, min_snr, max_shift=None):
-    """vmapped acceleration-trial batch: (chunk,) accels -> peak buffers."""
-    fn = lambda a: search_one_accel(
+def search_accel_chunk_legacy(tim_w, accels, mean, std, tsamp, nharms,
+                              bounds, capacity, min_snr, max_shift=None):
+    fn = lambda a: search_one_accel_legacy(
         tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr,
         max_shift,
     )
@@ -170,6 +222,7 @@ class PulsarSearch:
             max(abs(config.acc_start), abs(config.acc_end)),
             hdr.tsamp, self.size,
         )
+        self.resample_block = resample_block_for(self.size, self.max_shift)
         self.killmask = None
         if config.killfilename:
             self.killmask = load_killmask(config.killfilename, fil.nchans)
@@ -230,15 +283,37 @@ class PulsarSearch:
         accs = np.zeros(padded, np.float32)
         accs[:n] = acc_list
         cap = cfg.peak_capacity
+        chunk_tables = {}
+        if self.resample_block is not None:
+            from ..ops.resample import resample2_tables
+
+            for c0 in range(0, padded, chunk):
+                # capacity-independent: built once, reused across the
+                # escalation retries below
+                chunk_tables[c0] = tuple(
+                    map(jnp.asarray, resample2_tables(
+                        accs[c0 : c0 + chunk], float(self.fil.tsamp),
+                        self.size, self.max_shift,
+                        block=self.resample_block,
+                    ))
+                )
         while True:  # auto-escalate on peak-buffer overflow: no silent
             all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
             for c0 in range(0, padded, chunk):
-                batch = jnp.asarray(accs[c0 : c0 + chunk])
-                idxs, snrs, counts = search_accel_chunk(
-                    tim_w, batch, mean, std, float(self.fil.tsamp),
-                    cfg.nharmonics, self.bounds, cap, cfg.min_snr,
-                    self.max_shift,
-                )
+                if self.resample_block is not None:
+                    idxs, snrs, counts = search_accel_chunk(
+                        tim_w, chunk_tables[c0], mean, std,
+                        float(self.fil.tsamp), cfg.nharmonics, self.bounds,
+                        cap, cfg.min_snr, self.max_shift,
+                        self.resample_block,
+                    )
+                else:
+                    batch = jnp.asarray(accs[c0 : c0 + chunk])
+                    idxs, snrs, counts = search_accel_chunk_legacy(
+                        tim_w, batch, mean, std, float(self.fil.tsamp),
+                        cfg.nharmonics, self.bounds, cap, cfg.min_snr,
+                        self.max_shift,
+                    )
                 all_idxs.append(np.asarray(idxs))
                 all_snrs.append(np.asarray(snrs))
                 all_counts.append(np.asarray(counts))

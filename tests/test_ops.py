@@ -179,6 +179,139 @@ def test_resample2_select_path_matches_gather(accel):
         np.testing.assert_array_equal(selected, gathered)
 
 
+@pytest.mark.parametrize("accel", [500.0, -500.0, 137.3, -0.31, 12345.0])
+@pytest.mark.parametrize("block", [1024, 4096])
+def test_resample2_table_paths_exact(accel, block):
+    """Blockwise (device bisection) and table (host-exact) paths must be
+    bit-identical with the plain-gather reference formula."""
+    from peasoup_tpu.ops.resample import (
+        resample2_blockwise,
+        resample2_from_tables,
+        resample2_max_shift,
+        resample2_tables,
+    )
+
+    n = 1 << 16
+    tsamp = 0.00016
+    tim = rng.normal(size=n).astype(np.float32)
+    ms = max(resample2_max_shift(accel, tsamp, n), 1)
+    ref = _resample_numpy(tim, accel, tsamp, 2)
+    got_bw = np.asarray(
+        resample2_blockwise(jnp.asarray(tim), accel, tsamp, ms, block=block)
+    )
+    np.testing.assert_array_equal(got_bw, ref)
+    d0, pos, step = resample2_tables([accel], tsamp, n, ms, block=block)
+    got_tab = np.asarray(resample2_from_tables(
+        jnp.asarray(tim), jnp.asarray(d0[0]), jnp.asarray(pos[0]),
+        jnp.asarray(step[0]), ms, block=block,
+    ))
+    np.testing.assert_array_equal(got_tab, ref)
+
+
+def test_resample2_unique_tables_grid():
+    """NaN-padded accel grids dedupe correctly and round-trip."""
+    from peasoup_tpu.ops.resample import (
+        resample2_from_tables,
+        resample2_max_shift,
+        resample2_unique_tables,
+    )
+
+    n, tsamp = 1 << 14, 0.000064
+    grid = np.array([[0.0, 50.0, np.nan], [0.0, -50.0, 50.0]], np.float32)
+    ms = max(resample2_max_shift(50.0, tsamp, n), 1)
+    d0, pos, step, uidx = resample2_unique_tables(grid, tsamp, n, ms,
+                                                  block=1024)
+    assert d0.shape[0] == 3  # unique: -50, 0, 50
+    tim = rng.normal(size=n).astype(np.float32)
+    for (r, c), acc in np.ndenumerate(grid):
+        if np.isnan(acc):
+            continue
+        u = int(uidx[r, c])
+        got = np.asarray(resample2_from_tables(
+            jnp.asarray(tim), jnp.asarray(d0[u]), jnp.asarray(pos[u]),
+            jnp.asarray(step[u]), ms, block=1024,
+        ))
+        np.testing.assert_array_equal(
+            got, _resample_numpy(tim, float(acc), tsamp, 2))
+
+
+@pytest.mark.parametrize("accel", [500.0, -217.0])
+def test_resample2_index_exactness_2e23(accel):
+    """SURVEY hard-part: read-index exactness at 2^23 samples (f64
+    index ramp reaches ~2^45, `src/kernels.cu:335-362`).  The x64 CPU
+    backend computes true IEEE f64, so equality with the NumPy golden
+    is exact; the table path must agree bit-for-bit too."""
+    from peasoup_tpu.ops.resample import (
+        resample2_from_tables,
+        resample2_max_shift,
+        resample2_tables,
+    )
+
+    n = 1 << 23
+    tsamp = 0.000064
+    # values = bin index mod p: any index error changes the output value
+    tim = (np.arange(n) % 8191).astype(np.float32)
+    ms = resample2_max_shift(accel, tsamp, n)
+    assert ms > 64  # genuinely in the high-accel regime
+    ref = _resample_numpy(tim, accel, tsamp, 2)
+    got = np.asarray(resample2(jnp.asarray(tim), accel, tsamp))
+    np.testing.assert_array_equal(got, ref)
+    block = 16384
+    d0, pos, step = resample2_tables([accel], tsamp, n, ms, block=block)
+    got_tab = np.asarray(resample2_from_tables(
+        jnp.asarray(tim), jnp.asarray(d0[0]), jnp.asarray(pos[0]),
+        jnp.asarray(step[0]), ms, block=block,
+    ))
+    np.testing.assert_array_equal(got_tab, ref)
+
+
+def test_resample1_kernel_exactness_2e23():
+    """Kernel-I (folding path) exactness at 2^23 samples."""
+    n = 1 << 23
+    tsamp, accel = 0.000064, 350.0
+    tim = (np.arange(n) % 8191).astype(np.float32)
+    ref = _resample_numpy(tim, accel, tsamp, 1)
+    got = np.asarray(resample(jnp.asarray(tim), accel, tsamp))
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("accel", [350.0, -125.5, 17.2])
+def test_resample1_tables_exact(accel):
+    """Kernel-I host tables match the kernel-I golden bit-for-bit
+    (distinct fp evaluation order from kernel II, so its boundaries
+    must be bisected on its own expression)."""
+    from peasoup_tpu.ops.resample import (
+        resample1_tables,
+        resample2_from_tables,
+        resample2_max_shift,
+    )
+
+    n, tsamp, block = 1 << 16, 0.00016, 1024
+    tim = rng.normal(size=n).astype(np.float32)
+    ms = max(resample2_max_shift(accel, tsamp, n), 1)
+    d0, pos, step = resample1_tables([accel], tsamp, n, ms, block=block)
+    got = np.asarray(resample2_from_tables(
+        jnp.asarray(tim), jnp.asarray(d0[0]), jnp.asarray(pos[0]),
+        jnp.asarray(step[0]), ms, block=block,
+    ))
+    np.testing.assert_array_equal(got, _resample_numpy(tim, accel, tsamp, 1))
+
+
+def test_fold_phase_bins_exactness_2e23():
+    """Fold phase-bin assignment at 2^23 samples matches the NumPy f64
+    golden (`src/kernels.cu:597-651` computes phase in f64)."""
+    from peasoup_tpu.ops.fold import phase_bins
+
+    n = 1 << 23
+    tsamp, period, nbins = 0.000064, 0.0042573, 64
+    got = np.asarray(phase_bins(n, period, tsamp, nbins))
+    j = np.arange(n, dtype=np.float64)
+    tbp = np.float64(tsamp) / np.float64(period)  # reference precomputes
+    frac, _ = np.modf(j * tbp)
+    want = np.floor(frac * nbins).astype(np.int64)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_normalise_spectrum_legacy():
     from peasoup_tpu.ops import normalise_spectrum
 
