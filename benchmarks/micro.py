@@ -1,15 +1,23 @@
 """Micro-benchmarks mirroring the reference's ad-hoc perf harnesses.
 
-* fft:  2^23-point R2C+C2R round trip, mean over N iters
-  (`src/hcfft.cpp:14-42`)
-* hsum: 10^7-bin spectrum, 4 harmonic-sum levels, N reps
-  (`src/harmonic_sum_test.cpp:13,35-36`)
-* resample: 2^23-point accel resample (select path), N reps
+* fft:      2^23-point R2C+C2R round trip (`src/hcfft.cpp:14-42`),
+            plus the R2C half alone (the search loop's per-trial FFT)
+* hsum:     10^7-bin spectrum, 4 harmonic-sum levels
+            (`src/harmonic_sum_test.cpp:13,35-36`)
+* resample: 2^23-point kernel-II resample at accel=500 m/s^2
+            (`src/kernels.cu:335-362`) — host-table path vs raw gather
+* copy:     HBM/VMEM copy bound (roll; the roofline all of the above
+            are judged against)
 
-Run: python benchmarks/micro.py [fft|hsum|resample|all] [iters]
-Prints one JSON line per benchmark.  Timing is taken at the host fetch
-of a scalar reduction — on remote-attached TPUs dispatch is lazy and
-``block_until_ready`` can return before execution.
+Run: python benchmarks/micro.py [fft|hsum|resample|copy|all] [iters]
+Prints one JSON line per benchmark and (for `all`) writes
+benchmarks/micro_results.json.
+
+Timing uses benchmarks/timing.py's scan-chained harness: on the
+remote-attached TPU both lazy dispatch AND ``block_until_ready`` lie
+(a 8192^3 matmul appears to run at 30 PFLOP/s), so each op is chained
+``iters`` times inside one jitted ``lax.scan``, fenced by a scalar
+fetch, with the 1-iteration run subtracted to cancel tunnel latency.
 """
 
 from __future__ import annotations
@@ -17,38 +25,48 @@ from __future__ import annotations
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+# v5e headline numbers for the utilisation column
+V5E_HBM_GBPS = 819.0
 
-def _time(fn, iters):
-    fn()  # compile
-    t0 = time.time()
-    for _ in range(iters):
-        fn()
-    return (time.time() - t0) / iters
+
+def _gbps(nbytes, secs):
+    return nbytes / secs / 1e9
 
 
 def bench_fft(iters):
     import jax
     import jax.numpy as jnp
+    from benchmarks.timing import time_op
 
     n = 1 << 23
     x = jax.device_put(
         np.random.default_rng(0).normal(size=n).astype(np.float32)
     )
-    f = jax.jit(lambda v: jnp.fft.irfft(jnp.fft.rfft(v), n=n).sum())
-    return {"metric": "fft_r2c_c2r_2e23_roundtrip",
-            "value": round(_time(lambda: float(f(x)), iters) * 1e3, 3),
-            "unit": "ms"}
+    rt = time_op(
+        lambda v: jnp.fft.irfft(jnp.fft.rfft(v), n=n).astype(jnp.float32),
+        x, iters=iters)
+    fwd = time_op(
+        lambda v: jnp.pad(
+            jnp.abs(jnp.fft.rfft(v)).astype(jnp.float32),
+            (0, n - (n // 2 + 1))),
+        x, iters=iters)
+    return [
+        {"metric": "fft_r2c_c2r_2e23_roundtrip", "value": round(rt * 1e3, 3),
+         "unit": "ms"},
+        {"metric": "fft_r2c_2e23", "value": round(fwd * 1e3, 3),
+         "unit": "ms"},
+    ]
 
 
 def bench_hsum(iters):
     import jax
     import jax.numpy as jnp
+    from benchmarks.timing import time_op
 
     from peasoup_tpu.ops import harmonic_sums
 
@@ -56,44 +74,102 @@ def bench_hsum(iters):
     spec = jax.device_put(
         np.random.default_rng(0).normal(size=n).astype(np.float32)
     )
-    f = jax.jit(lambda s: sum(h.sum() for h in harmonic_sums(s, 4)))
-    return {"metric": "harmonic_sum_1e7_4levels",
-            "value": round(_time(lambda: float(f(spec)), iters) * 1e3, 3),
-            "unit": "ms"}
+    def step(s):
+        h = harmonic_sums(s, 4)
+        return s + 1e-12 * (h[0] + h[1] + h[2] + h[3])
+    t = time_op(step, spec, iters=iters)
+    # 4 levels read the spectrum at stretched indices + write each sum
+    traffic = 9 * n * 4
+    return [{"metric": "harmonic_sum_1e7_4levels",
+             "value": round(t * 1e3, 3), "unit": "ms",
+             "GBps": round(_gbps(traffic, t), 1),
+             "hbm_util_pct": round(100 * _gbps(traffic, t) / V5E_HBM_GBPS,
+                                   1)}]
 
 
 def bench_resample(iters):
     import jax
     import jax.numpy as jnp
+    from benchmarks.timing import time_op
 
-    from peasoup_tpu.ops.resample import resample2, resample2_max_shift
+    from peasoup_tpu.ops.resample import (
+        resample2,
+        resample2_from_tables,
+        resample2_max_shift,
+        resample2_tables,
+    )
 
     n = 1 << 23
     tsamp = 6.4e-5
-    ms = resample2_max_shift(5.0, tsamp, n)
+    accel = 500.0
+    block = 16384
+    ms = resample2_max_shift(accel, tsamp, n)
     tim = jax.device_put(
         np.random.default_rng(0).normal(size=n).astype(np.float32)
     )
-    f = jax.jit(lambda t: resample2(t, 5.0, tsamp, ms).sum())
-    return {"metric": "resample2_2e23",
-            "value": round(_time(lambda: float(f(tim)), iters) * 1e3, 3),
-            "unit": "ms"}
+    d0, pos, step = (jnp.asarray(t[0]) for t in
+                     resample2_tables([accel], tsamp, n, ms, block=block))
+    t_tab = time_op(
+        lambda v: resample2_from_tables(v, d0, pos, step, ms, block=block),
+        tim, iters=iters)
+    t_gather = time_op(
+        lambda v: resample2(v, accel, tsamp, max_shift=None), tim,
+        iters=max(4, iters // 4))
+    traffic = 2 * n * 4
+    return [
+        {"metric": "resample2_tables_2e23_accel500",
+         "value": round(t_tab * 1e3, 3), "unit": "ms",
+         "GBps": round(_gbps(traffic, t_tab), 1),
+         "hbm_util_pct": round(100 * _gbps(traffic, t_tab) / V5E_HBM_GBPS,
+                               1)},
+        {"metric": "resample2_gather_2e23_accel500",
+         "value": round(t_gather * 1e3, 3), "unit": "ms"},
+    ]
 
 
-BENCHES = {"fft": bench_fft, "hsum": bench_hsum, "resample": bench_resample}
+def bench_copy(iters):
+    import jax
+    import jax.numpy as jnp
+    from benchmarks.timing import time_op
+
+    n = 1 << 23
+    x = jax.device_put(
+        np.random.default_rng(0).normal(size=n).astype(np.float32)
+    )
+    # the nonlinear |v| term defeats XLA's composition of rolled/scaled
+    # linear chains across scan iterations
+    t = time_op(lambda v: jnp.roll(v, 12345) + jnp.abs(v) * 1e-20, x,
+                iters=max(iters, 64))
+    return [{"metric": "copy_roll_2e23", "value": round(t * 1e3, 4),
+             "unit": "ms", "GBps": round(_gbps(2 * n * 4, t), 1)}]
+
+
+BENCHES = {"fft": bench_fft, "hsum": bench_hsum,
+           "resample": bench_resample, "copy": bench_copy}
 
 
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     which = args[0] if args else "all"
-    iters = int(args[1]) if len(args) > 1 else 20
+    iters = int(args[1]) if len(args) > 1 else 32
     if which != "all" and which not in BENCHES:
         print(f"unknown benchmark '{which}'; choose from: "
               f"{', '.join(BENCHES)}, all", file=sys.stderr)
         return 1
     names = list(BENCHES) if which == "all" else [which]
+    results = []
     for name in names:
-        print(json.dumps(BENCHES[name](iters)))
+        for row in BENCHES[name](iters):
+            results.append(row)
+            print(json.dumps(row))
+    if which == "all":
+        import jax
+
+        out = {"device": str(jax.devices()[0]), "results": results}
+        path = os.path.join(os.path.dirname(__file__),
+                            "micro_results.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
     return 0
 
 

@@ -1,39 +1,30 @@
 """Micro-benchmark: kernel-II acceleration resampling at production scale.
 
-VERDICT r1 item 4: measure the 2^23-point gather path at realistic high
-accelerations (max_shift >> 64, i.e. the regime where `resample2`'s
-select path is unavailable) and compare candidate implementations
-against plain-copy HBM bandwidth.  Reference kernel:
-`src/kernels.cu:335-362` (getAcceleratedIndexII).
+VERDICT r1 item 4: measure the 2^23-point paths at realistic high
+accelerations (max_shift >> 64, where the select path is unavailable)
+against the copy roofline.  Reference kernel: `src/kernels.cu:335-362`
+(getAcceleratedIndexII).
+
+Uses benchmarks/timing.time_op — wall-clock around dispatches measures
+nothing through the async relay (see timing.py docstring).
 
 Run on the real chip:  python benchmarks/resample_bench.py
 """
 
 from __future__ import annotations
 
+import importlib
 import json
-import time
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-
-import importlib
 
 rs = importlib.import_module("peasoup_tpu.ops.resample")
 
 
-def timeit(fn, *args, n_iter=20, warmup=3):
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(n_iter):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n_iter
-
-
 def main():
+    from benchmarks.timing import time_op
+
     n = 1 << 23
     tsamp = 6.4e-5  # 64 us: typical survey sampling => tobs ~ 537 s
     accel = 500.0  # m/s^2, top of the realistic search range
@@ -47,33 +38,34 @@ def main():
                "max_shift": max_shift, "device": str(jax.devices()[0]),
                "cases": {}}
 
-    # plain copy: the bandwidth roofline for any resampler (read n + write n)
-    copy = jax.jit(lambda x: x * 1.0)
-    t = timeit(copy, tim)
-    bw = 2 * n * 4 / t / 1e9
-    results["cases"]["copy"] = {"ms": t * 1e3, "GBps": bw}
-    print(f"copy               {t*1e3:8.3f} ms   {bw:7.1f} GB/s")
+    def record(name, t, extra=None):
+        row = {"ms": round(t * 1e3, 3),
+               "GBps": round(2 * n * 4 / t / 1e9, 1)}
+        row.update(extra or {})
+        results["cases"][name] = row
+        print(f"{name:20s} {t*1e3:8.3f} ms   {row['GBps']:7.1f} GB/s")
 
-    # gather path (what resample2 falls back to at high accel)
-    gather = jax.jit(lambda x: rs.resample2(x, accel, tsamp, max_shift=None))
-    t = timeit(gather, tim)
-    bw = 2 * n * 4 / t / 1e9
-    results["cases"]["gather"] = {"ms": t * 1e3, "GBps": bw}
-    print(f"gather             {t*1e3:8.3f} ms   {bw:7.1f} GB/s")
+    # copy roofline (nonlinear term defeats scan-chain folding)
+    record("copy", time_op(
+        lambda x: jnp.roll(x, 12345) + jnp.abs(x) * 1e-20, tim))
 
-    # blockwise path (candidate fix), several block sizes
-    for bs in (1024, 4096, 16384):
-        fn = jax.jit(lambda x, b=bs: rs.resample2_blockwise(
-            x, accel, tsamp, max_shift, block=b))
-        out = fn(tim)
-        ref = gather(tim)
-        ok = bool(jnp.array_equal(out, ref))
-        t = timeit(fn, tim)
-        bw = 2 * n * 4 / t / 1e9
-        results["cases"][f"blockwise_{bs}"] = {
-            "ms": t * 1e3, "GBps": bw, "matches_gather": ok}
-        print(f"blockwise b={bs:<6} {t*1e3:8.3f} ms   {bw:7.1f} GB/s   "
-              f"exact={ok}")
+    # the gather fallback (what high accel used to hit)
+    record("gather", time_op(
+        lambda x: rs.resample2(x, accel, tsamp, max_shift=None), tim,
+        iters=8))
+
+    # host-exact table path at several block sizes
+    gather_ref = jax.jit(
+        lambda x: rs.resample2(x, accel, tsamp, max_shift=None))(tim)
+    for bs in (4096, 8192, 16384, 32768):
+        d0, pos, step = rs.resample2_tables(
+            [accel], tsamp, n, max_shift, block=bs)
+        d0j, posj, stepj = (jnp.asarray(a[0]) for a in (d0, pos, step))
+        fn = lambda x, a=d0j, b=posj, c=stepj, blk=bs: (
+            rs.resample2_from_tables(x, a, b, c, max_shift, block=blk))
+        exact = bool(jnp.array_equal(jax.jit(fn)(tim), gather_ref))
+        record(f"tables_b{bs}", time_op(fn, tim, iters=16),
+               {"matches_gather": exact})
 
     with open("benchmarks/resample_bench.json", "w") as f:
         json.dump(results, f, indent=1)

@@ -20,7 +20,13 @@ import jax.numpy as jnp
 
 
 def time_op(step_fn, x0, iters: int = 64, repeats: int = 3) -> float:
-    """Median per-iteration seconds of ``step_fn`` (x -> x-like)."""
+    """Median per-iteration seconds of ``step_fn`` (x -> x-like).
+
+    Adaptive: if the chained run is not clearly above the 1-iteration
+    baseline (per-iter cost below the tunnel's ms-scale jitter), the
+    chain length is grown until it is, so sub-0.1 ms ops still resolve.
+    """
+    iters = max(iters, 2)  # the t(1) subtraction needs iters - 1 >= 1
 
     def chained(n):
         def body(c, _):
@@ -40,5 +46,9 @@ def time_op(step_fn, x0, iters: int = 64, repeats: int = 3) -> float:
         return best
 
     t1 = chained(1)
-    tn = chained(iters)
+    for _ in range(6):
+        tn = chained(iters)
+        if tn - t1 > max(0.5 * t1, 5e-3):  # clearly above jitter
+            break
+        iters *= 4
     return max(tn - t1, 1e-12) / (iters - 1)

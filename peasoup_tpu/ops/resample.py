@@ -88,61 +88,6 @@ def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None
     return out
 
 
-def _exact_offset(i: jnp.ndarray, af, n: int) -> jnp.ndarray:
-    """d(i) = rint(i + i*af*(i-n)) - i, evaluated exactly in f64 —
-    the reference's read-index offset (`src/kernels.cu:335-362`)."""
-    i = i.astype(jnp.float64)
-    return jnp.rint(i + i * af * (i - jnp.float64(n))) - i
-
-
-def _offset_boundaries(af, n: int, max_shift: int):
-    """Positions where the kernel-II offset staircase steps.
-
-    ``d(i)`` follows the parabola ``i*af*(i-n)``: |d| rises 0 -> K1 on
-    [0, n/2] then falls back on [n/2, n), always in unit steps (the
-    parabola's per-sample slope is < 1 for any max_shift < n/4).  The
-    step positions are found by bisection on the EXACT f64 formula —
-    O(max_shift * log n) evaluations on tiny arrays instead of O(n)
-    software-emulated f64 ops per call.
-
-    Returns (bounds, steps): int32[2*max_shift] sorted positions
-    (inactive entries = n) and the signed step of ``d`` at each.
-    """
-    vh = n // 2
-    sign = jnp.where(jnp.asarray(af, jnp.float64) >= 0, 1.0, -1.0)
-    u = lambda i: (-sign * _exact_offset(i, af, n)).astype(jnp.int32)
-    k = jnp.arange(1, max_shift + 1, dtype=jnp.int32)
-    n_iters = int(np.ceil(np.log2(max(n, 2)))) + 1
-
-    def bisect(lo, hi, pred):
-        # first integer in (lo, hi] where pred holds; pred monotone
-        def body(_, lh):
-            lo, hi = lh
-            mid = (lo + hi) // 2
-            p = pred(mid)
-            return jnp.where(p, lo, mid), jnp.where(p, mid, hi)
-
-        lo, hi = jax.lax.fori_loop(
-            0, n_iters, body,
-            (jnp.full_like(k, lo), jnp.full_like(k, hi)))
-        return hi
-
-    k1 = u(jnp.asarray(vh))
-    kend = u(jnp.asarray(n - 1))
-    # rising half: first i with u(i) >= k, for k = 1..K1
-    b = bisect(0, vh, lambda m: u(m) >= k)
-    b = jnp.where(k <= k1, b, n)
-    # falling half: first i with u(i) <= K1 - k, for k = 1..K1-u(n-1)
-    c = bisect(vh, n - 1, lambda m: u(m) <= k1 - k)
-    c = jnp.where(k <= k1 - kend, c, n)
-    bounds = jnp.concatenate([b, c]).astype(jnp.int32)
-    steps = jnp.concatenate(
-        [jnp.full_like(b, -1), jnp.full_like(c, 1)]
-    ) * sign.astype(jnp.int32)
-    order = jnp.argsort(bounds)
-    return bounds[order], steps[order]
-
-
 def residual_width(max_shift: int, block: int, n: int) -> int:
     """Static per-block residual-table width: the staircase's maximum
     step count inside one block (derivative bound) + 2 for the two
@@ -204,6 +149,14 @@ def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
 
     k1 = u_of(np.full((A, 1), vh))
     kend = u_of(np.full((A, 1), n - 1))
+    if int(k1.max(initial=0)) > max_shift:
+        # enumerating only k = 1..max_shift would silently drop the
+        # deeper steps AND under-pad the device slice starts
+        raise ValueError(
+            f"true peak shift {int(k1.max())} exceeds max_shift="
+            f"{max_shift}; pass a bound from resample2_max_shift() for "
+            f"the largest |accel| in the batch"
+        )
     b = np.where(k <= k1, bisect(0, vh, lambda mid: u_of(mid) >= k), n)
     c = np.where(k <= k1 - kend,
                  bisect(vh, n - 1, lambda mid: u_of(mid) <= k1 - k), n)
@@ -301,45 +254,18 @@ def resample2_from_tables(tim: jnp.ndarray, d0: jnp.ndarray,
 
 def resample2_blockwise(tim: jnp.ndarray, accel, tsamp, max_shift: int,
                         block: int = 4096) -> jnp.ndarray:
-    """Kernel-II resampling for the high-acceleration regime
-    (``max_shift`` too large for the select path).
+    """Kernel-II resampling via host-exact tables for a CONCRETE accel.
 
-    The read-index offset ``d(i) = idx(i) - i`` is slowly varying:
-    ``|d'| <= |af|*n = 4*max_shift/n`` per sample, so across a block of
-    ``block`` samples it changes by at most ``ceil(4*max_shift*block/n)``.
-    That turns the 2^23-element random gather (TPU's weakest access
-    pattern) into (a) one *contiguous* dynamic-slice per block at the
-    block's base offset — a coalesced block gather XLA handles at near
-    copy bandwidth — plus (b) a select over the few within-block
-    residual shifts.  Bit-exact with the plain-gather path (same f64
-    rounded index formula; edge padding == the reference's index clip,
-    `src/kernels.cu:335-362`).
+    Convenience wrapper (tests/benchmarks): builds the staircase tables
+    on the host — ``accel`` must not be a tracer — and applies
+    :func:`resample2_from_tables`.  Production paths build tables for
+    whole accel batches up front instead.
     """
     n = tim.shape[0]
     if n % block:
         return resample2(tim, accel, tsamp, max_shift=max_shift)
-    af = _accel_fact(accel, tsamp)
-    m = residual_width(max_shift, block, n)
-    nb = n // block
-    d0 = _exact_offset(
-        jnp.arange(nb, dtype=jnp.float64) * block, af, n).astype(jnp.int32)
-    # per-element residual d(i) - d0 via the staircase boundaries that
-    # fall strictly inside each block (a boundary AT the block start is
-    # already counted in d0): scatter (position, step) pairs into an
-    # (nb, m) table; the device body then does m broadcast compares —
-    # no per-element f64
-    bounds, steps = _offset_boundaries(af, n, max_shift)
-    interior = (bounds % block) != 0
-    blk = jnp.where(interior, bounds // block, nb)
-    # inactive entries (blk = nb) break blk's sortedness — stable
-    # re-sort so same-block entries are contiguous for the rank compute
-    order = jnp.argsort(blk, stable=True)
-    blk, bounds, steps = blk[order], bounds[order], steps[order]
-    rank = jnp.arange(bounds.shape[0]) - jnp.searchsorted(
-        blk, blk, side="left")
-    pos_t = jnp.full((nb, m), n, jnp.int32).at[blk, rank].set(
-        bounds, mode="drop")
-    step_t = jnp.zeros((nb, m), jnp.int32).at[blk, rank].set(
-        steps, mode="drop")
-    return resample2_from_tables(tim, d0, pos_t, step_t, max_shift,
-                                 block=block)
+    d0, pos_t, step_t = resample2_tables(
+        [float(accel)], float(tsamp), n, max_shift, block=block)
+    return resample2_from_tables(
+        tim, jnp.asarray(d0[0]), jnp.asarray(pos_t[0]),
+        jnp.asarray(step_t[0]), max_shift, block=block)

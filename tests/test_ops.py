@@ -384,6 +384,25 @@ def test_harmonic_sums_impulse_train():
     assert val == pytest.approx((1 + 16) / 4.0, abs=1e-5) or val > 1.0
 
 
+def test_harmonic_sums_lane_aligned_path_exact():
+    """The large-spectrum (stride-slice + one-hot einsum) path must be
+    bit-identical with the gather formulation across the dispatch
+    threshold."""
+    from peasoup_tpu.ops.harmonics import (
+        _GATHER_MAX_SIZE,
+        _harmonic_sums_gather,
+    )
+
+    n = _GATHER_MAX_SIZE + 1017  # odd, just past the dispatch threshold
+    spec = rng.normal(size=n).astype(np.float32)
+    big = harmonic_sums(jnp.asarray(spec), 4)
+    small = _harmonic_sums_gather(jnp.asarray(spec), 4)
+    for k, (a, b) in enumerate(zip(big, small), 1):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"level {k} mismatch between einsum and gather paths")
+
+
 def test_harmonic_index_integer_equals_float():
     # (i*m + 2^(k-1)) >> k  ==  int(i * m/2^k + 0.5) for the float64 math
     # the reference uses.
