@@ -46,9 +46,9 @@ def time_op(step_fn, x0, iters: int = 64, repeats: int = 3) -> float:
         return best
 
     t1 = chained(1)
-    for _ in range(6):
+    for attempt in range(7):
         tn = chained(iters)
-        if tn - t1 > max(0.5 * t1, 5e-3):  # clearly above jitter
-            break
+        if tn - t1 > max(0.5 * t1, 5e-3) or attempt == 6:
+            break  # clearly above jitter (or give up at this length)
         iters *= 4
     return max(tn - t1, 1e-12) / (iters - 1)

@@ -26,4 +26,20 @@ import jax as _jax
 # explicitly float32/bfloat16.
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache: the fused/chunked search programs take
+# minutes of XLA/Mosaic compile at production shapes; caching makes
+# every rerun (and the escalation rebuilds) pay it once per shape.
+import os as _os
+
+_cache_dir = _os.environ.get(
+    "PEASOUP_TPU_COMPILE_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "peasoup_tpu_xla"),
+)
+if _cache_dir and _cache_dir != "0":
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:  # older jax without the knobs: harmless
+        pass
+
 __version__ = "0.1.0"

@@ -113,6 +113,8 @@ def main(argv=None) -> int:
     # subcommand dispatch: `peasoup-tpu coincidencer <filterbanks...>`
     if argv and argv[0] == "coincidencer":
         return coincidencer_main(argv[1:])
+    if argv and argv[0] == "accmap":
+        return accmap_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
 
@@ -161,6 +163,49 @@ def main(argv=None) -> int:
     if args.verbose:
         print(f"Wrote {len(result.candidates)} candidates to {cfg.outdir}",
               file=sys.stderr)
+    return 0
+
+
+def accmap_main(argv=None) -> int:
+    """Inter-antenna delay finder CLI over ``ops.correlate.find_delays``.
+
+    Equivalent of the reference's ``bin/accmap`` (`src/accmap.cpp`),
+    which is broken in-tree (hardcoded DADA path, missing dada.hpp);
+    this version reads the same payload layout — per antenna, ``size``
+    interleaved complex8 (int8 re, int8 im) samples of one channel —
+    from a raw binary file and prints one line per baseline.
+    """
+    import argparse
+
+    import numpy as np
+
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-accmap",
+        description="Peasoup-TPU - FFT cross-correlation delay finder",
+    )
+    p.add_argument("datafile", help="raw int8 file: nant x size x 2 "
+                                    "(interleaved re/im)")
+    p.add_argument("--nant", type=int, default=2)
+    p.add_argument("--size", type=int, default=65536,
+                   help="samples per antenna (accmap.cpp:13)")
+    p.add_argument("--max_delay", type=int, default=2048,
+                   help="correlation search window (accmap.cpp:27)")
+    args = p.parse_args(argv)
+
+    raw = np.fromfile(args.datafile, dtype=np.int8)
+    need = args.nant * args.size * 2
+    if raw.size < need:
+        print(f"error: {args.datafile} holds {raw.size} bytes; need "
+              f"{need} for nant={args.nant} size={args.size}",
+              file=sys.stderr)
+        return 1
+    z = raw[:need].reshape(args.nant, args.size, 2).astype(np.float32)
+    arrays = z[..., 0] + 1j * z[..., 1]
+    from .ops.correlate import find_delays
+
+    for rec in find_delays(arrays, args.max_delay):
+        print(f"baseline {rec['i']}-{rec['j']}: lag {rec['lag']} "
+              f"samples  power {rec['power']:.3f}")
     return 0
 
 

@@ -134,6 +134,79 @@ def dedisperse(
     return out
 
 
+# whole-channel pieces of the flat filterbank stay below this many
+# elements so every dynamic_slice offset fits int32 (the TPU backend
+# rejects 64-bit slice indices outright)
+_FLAT_PART_LIMIT = (1 << 31) - 1
+
+
+def flat_channel_parts(nchans: int, nsamps: int) -> int:
+    """Channels per flat part: as many whole channels as fit in int32
+    offsets."""
+    return max(1, min(nchans, _FLAT_PART_LIMIT // max(nsamps, 1)))
+
+
+def split_flat_channels(data: np.ndarray):
+    """Split a (nchans, nsamps) array into flat whole-channel parts for
+    :func:`dedisperse_flat` (views, no copies)."""
+    nchans, nsamps = data.shape
+    cpp = flat_channel_parts(nchans, nsamps)
+    return [
+        data[p : p + cpp].reshape(-1) for p in range(0, nchans, cpp)
+    ]
+
+
+def dedisperse_flat(
+    parts,
+    delays: jax.Array,
+    nsamps: int,
+    out_nsamps: int,
+) -> jax.Array:
+    """`dedisperse` over FLAT channel-major array parts.
+
+    The production path keeps the filterbank 1-D on device: a 2-D u8
+    entry parameter is assigned a column-major layout by XLA while
+    in-program consumers want row-major tiled, and under shard_map even
+    a reshape of the flat array materialises a full-size relayout copy
+    (8 GB at 2^23 x 1024 chans).  Slicing each channel straight out of
+    a flat array never forms a 2-D view, so no relayout exists.
+
+    ``parts`` is a sequence of flat arrays each holding
+    :func:`flat_channel_parts` whole channels: a single flat array
+    would need 64-bit slice offsets past 2^31 elements (8.6e9 at
+    1024 chans x 2^23 samples), which the TPU backend rejects — and
+    int32 arithmetic would wrap, silently dedispersing garbage.
+    Killmask handling is the caller's (the chunked driver pre-applies
+    it host-side, matching `dedisperser.hpp:64-95`).
+    """
+    if not isinstance(parts, (list, tuple)):
+        parts = [parts]
+    ndm, nchans = delays.shape
+    cpp = flat_channel_parts(nchans, nsamps)
+
+    def chan_step(flat_part, c0):
+        def body(acc, c_local):
+            col = lax.dynamic_slice(
+                flat_part, (c_local * nsamps,), (nsamps,))
+            d = lax.dynamic_slice(
+                delays, (jnp.int32(0), c0 + c_local), (ndm, 1))[:, 0]
+            sliced = jax.vmap(
+                lambda di: lax.dynamic_slice(col, (di,), (out_nsamps,))
+            )(d)
+            return acc + sliced.astype(jnp.float32), None
+
+        return body
+
+    acc = jnp.zeros((ndm, out_nsamps), dtype=jnp.float32) \
+        + delays[:, :1].astype(jnp.float32) * 0.0
+    for pi, flat_part in enumerate(parts):
+        nloc = min(cpp, nchans - pi * cpp)
+        acc, _ = lax.scan(
+            chan_step(flat_part, jnp.int32(pi * cpp)), acc,
+            jnp.arange(nloc, dtype=jnp.int32))
+    return acc
+
+
 def dedisperse_numpy(
     data: np.ndarray,
     delays: np.ndarray,

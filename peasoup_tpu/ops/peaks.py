@@ -19,6 +19,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# two-stage extraction kicks in above this searched-prefix length; the
+# row width balances the row-reduction pass against the second top_k
+_TWO_STAGE_MIN_SIZE = 1 << 17
+_TWO_STAGE_ROW_WIDTH = 512
+
 
 def extract_above_threshold(
     spectrum: jnp.ndarray,
@@ -38,19 +43,38 @@ def extract_above_threshold(
     # (for low harmonic levels stop_idx << size, cutting the top_k cost)
     stop_idx = min(stop_idx, size)
     spec = spectrum[:stop_idx]
-    i = jnp.arange(stop_idx, dtype=jnp.int32)
-    mask = (i >= start_idx) & (spec > thresh)
-    sentinel = jnp.int32(-(size + 1))
-    score = jnp.where(mask, -i, sentinel)
     k_eff = min(capacity, stop_idx)
-    top, _ = jax.lax.top_k(score, k_eff)  # largest scores = smallest idx
+    sentinel = jnp.int32(-(size + 1))
+    if stop_idx > _TWO_STAGE_MIN_SIZE:
+        # two-stage extraction: a single lax.top_k over millions of
+        # bins costs ~8 ms on v5e; selecting the top-`capacity` ROWS
+        # first (by earliest qualifying index) cuts it to ~0.5 ms.
+        # Exact because global index order is (row, col) lex order and
+        # every selected row holds >= 1 hit: the first k_eff hits
+        # always lie within the first k_eff hit-rows.
+        C = _TWO_STAGE_ROW_WIDTH
+        R = -(-stop_idx // C)
+        i = jnp.arange(R * C, dtype=jnp.int32)
+        sp = jnp.pad(spec, (0, R * C - stop_idx))
+        mask2 = (i >= start_idx) & (i < stop_idx) & (sp > thresh)
+        score2 = jnp.where(mask2, -i, sentinel).reshape(R, C)
+        _, rows = jax.lax.top_k(jnp.max(score2, axis=1), min(k_eff, R))
+        # min(k_eff, R)*C >= k_eff always (k_eff <= stop_idx <= R*C),
+        # so the flattened selection can honour k_eff directly
+        top, _ = jax.lax.top_k(score2[rows].reshape(-1), k_eff)
+        count = jnp.sum(mask2, dtype=jnp.int32)
+    else:
+        i = jnp.arange(stop_idx, dtype=jnp.int32)
+        mask = (i >= start_idx) & (spec > thresh)
+        score = jnp.where(mask, -i, sentinel)
+        top, _ = jax.lax.top_k(score, k_eff)  # largest = smallest idx
+        count = jnp.sum(mask, dtype=jnp.int32)
     valid = top != sentinel
     idxs = jnp.where(valid, -top, -1)
     snrs = jnp.where(valid, spec[jnp.clip(-top, 0, stop_idx - 1)], 0.0)
     if k_eff < capacity:
         idxs = jnp.pad(idxs, (0, capacity - k_eff), constant_values=-1)
         snrs = jnp.pad(snrs, (0, capacity - k_eff))
-    count = jnp.sum(mask, dtype=jnp.int32)
     return idxs, snrs.astype(jnp.float32), count
 
 

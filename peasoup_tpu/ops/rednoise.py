@@ -9,6 +9,9 @@ division of the Fourier series by the median curve with bins 0-4 zeroed
 
 from __future__ import annotations
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 
@@ -19,6 +22,8 @@ def median_scrunch5(x: jnp.ndarray) -> jnp.ndarray:
     (median / mean-of-middle pair), `src/kernels.cu:947-981`.
     """
     n = x.shape[0]
+    if n >= _LANE_SCRUNCH_MIN:
+        return _median_scrunch5_lanes(x)
     if n >= 5:
         groups = x[: (n // 5) * 5].reshape(-1, 5)
         return jnp.sort(groups, axis=1)[:, 2]
@@ -32,6 +37,49 @@ def median_scrunch5(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(s[1:3], keepdims=True)  # n == 4
 
 
+# above this input length the lane-aligned path replaces the
+# (n//5, 5) reshape+sort: a minor dim of 5 pads 25.6x to the 128-lane
+# tile on TPU (~3 GB of HLO temp per 2^23-size whiten when vmapped)
+_LANE_SCRUNCH_MIN = 1 << 19
+
+
+def _median_scrunch5_lanes(x: jnp.ndarray) -> jnp.ndarray:
+    """Lane-aligned median-scrunch-by-5.
+
+    Views the output as (R, 128) rows; out[r, l] needs x[640r + 5l + c]
+    for c in 0..4 — max offset 5*127 + 4 = 639, so row r's inputs are
+    exactly the contiguous 640-wide window starting at 640r: ONE free
+    reshape, then five STATIC lane selections, each a one-hot
+    (640, 128) matmul (exact under Precision.HIGHEST, as in
+    ops/harmonics.py).  The median itself is a 9-exchange sorting
+    network of elementwise min/max — identical values to a sort, with
+    no lane-hostile (n//5, 5) intermediate.
+    """
+    n5 = x.shape[0] // 5
+    R = -(-n5 // 128)
+    pad_len = R * 640
+    xp = jnp.pad(x, (0, max(0, pad_len - x.shape[0])))
+    W = xp[: R * 640].reshape(R, 640)
+    l = np.arange(128)
+    cols = []
+    for c in range(5):
+        M = np.zeros((640, 128), np.float32)
+        M[5 * l + c, l] = 1.0
+        cols.append(jnp.matmul(
+            W, jnp.asarray(M), precision=jax.lax.Precision.HIGHEST))
+    v = cols
+    # optimal 5-element sorting network; median = 3rd smallest
+    def cx(i, j):
+        lo = jnp.minimum(v[i], v[j])
+        hi = jnp.maximum(v[i], v[j])
+        v[i], v[j] = lo, hi
+
+    for i, j in ((0, 1), (3, 4), (2, 4), (2, 3), (0, 3), (0, 2),
+                 (1, 4), (1, 3), (1, 2)):
+        cx(i, j)
+    return v[2].reshape(-1)[:n5]
+
+
 def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
     """Linear-interpolation stretch to ``out_count`` points.
 
@@ -43,7 +91,12 @@ def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
     xi = jnp.arange(out_count, dtype=jnp.float32) * step
     j = xi.astype(jnp.int32)
     frac = xi - j.astype(jnp.float32)
-    nxt = x[jnp.minimum(j + 1, in_count - 1)]
+    # gather base and next from DIFFERENT operands: gathering x[j] and
+    # x[j+1] from the same array lets XLA fuse them into one
+    # (out_count, 2) gather whose minor dim pads 64x to the 128-lane
+    # tile — 2 GB of HBM temp per 2^23-size whiten on v5e
+    x_next = jnp.concatenate([x[1:], x[-1:]])
+    nxt = x_next[j]
     base = x[j]
     return jnp.where(frac > 1e-5, base + frac * (nxt - base), base)
 

@@ -34,7 +34,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.dedisperse import dedisperse
+from ..ops.dedisperse import (
+    dedisperse,
+    dedisperse_flat,
+    split_flat_channels,
+)
 from ..search.pipeline import (
     PulsarSearch,
     SearchResult,
@@ -141,6 +145,18 @@ def sharded_search_program(
 from functools import lru_cache
 
 
+def _check_f32_packable(size: int) -> None:
+    """The packed peak buffer ships bin indices and per-spectrum counts
+    as plain f32 (see `_compact_peaks`), which is exact only below
+    2^24.  Both are bounded by the spectrum length size//2 + 1."""
+    if size // 2 + 1 > 1 << 24:
+        raise ValueError(
+            f"fft size {size} gives spectra longer than 2^24 bins; bin "
+            f"indices would not be exactly representable in the f32 "
+            f"peak packing — split the observation or reduce --fft_size"
+        )
+
+
 def _compact_peaks(idxs, snrs, counts, compact_k):
     """Shared device-side tail of both fused programs: compact all
     (dm, accel, level) peak buffers of a shard into one packed f32
@@ -148,26 +164,43 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
     flat_bin = idxs.reshape(-1)
     flat_snr = snrs.reshape(-1)
     n = flat_bin.shape[0]
-    pos = jnp.arange(n, dtype=jnp.int32)
+    if n > 2**31 - 2:
+        raise ValueError(
+            f"peak-buffer slot count {n} overflows int32 slot indices; "
+            f"reduce peak_capacity, accel count per dispatch "
+            f"(accel_block) or DM rows per shard"
+        )
     valid = flat_bin >= 0
-    sentinel = jnp.int32(-n - 1)
-    score = jnp.where(valid, -pos, sentinel)
-    top, _ = lax.top_k(score, compact_k)  # first compact_k valid slots
-    got = top != sentinel
-    sel = jnp.where(got, -top, 0)
+    # stream compaction via cumsum + scatter.  (A top_k(score,
+    # compact_k) formulation is algebraically equivalent but k ~ 10^5
+    # top_k MISCOMPILES on v5e: shape-dependent garbage output or a
+    # TPU worker crash.  The scatter runs once per dispatch.)
+    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    dest = jnp.where(valid, pos, compact_k)  # OOB -> dropped
     # the host reconstructs each entry's (dm, accel, level, slot) tag
     # from ``counts`` alone: valid slots appear in flat spectrum
     # order, so only bins+snrs are shipped
-    sel_bin = jnp.where(got, flat_bin[sel], -1)
-    sel_snr = jnp.where(got, flat_snr[sel], 0.0).astype(jnp.float32)
+    sel_bin = (
+        jnp.full((compact_k,), -1, flat_bin.dtype)
+        .at[dest].set(flat_bin, mode="drop")
+    )
+    sel_snr = (
+        jnp.zeros((compact_k,), jnp.float32)
+        .at[dest].set(flat_snr.astype(jnp.float32), mode="drop")
+    )
     nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
-    # pack everything into ONE f32 buffer (ints bitcast) so the
-    # host pays a single device->host round trip
+    # pack everything into ONE f32 buffer so the host pays a single
+    # device->host round trip.  Ints travel as PLAIN f32 values — all
+    # exactly representable: bins < 2^24, per-spectrum counts <=
+    # stop_idx < 2^24; nvalid (which can exceed 2^24) ships as two
+    # 16-bit halves.  (bitcast_convert_type int32->f32 MISCOMPILES
+    # inside this program on v5e: shape-dependent zeroed outputs.)
     return jnp.concatenate([
-        lax.bitcast_convert_type(sel_bin, jnp.float32),
+        sel_bin.astype(jnp.float32),
         sel_snr,
-        lax.bitcast_convert_type(counts.reshape(-1), jnp.float32),
-        lax.bitcast_convert_type(nvalid, jnp.float32),
+        counts.reshape(-1).astype(jnp.float32),
+        (nvalid // 65536).astype(jnp.float32),
+        (nvalid % 65536).astype(jnp.float32),
     ])
 
 
@@ -227,6 +260,7 @@ def build_fused_search(
     """
     from ..ops.unpack import unpack_bits_device
 
+    _check_f32_packable(size)
     nlevels = nharms + 1
     use_tables = block is not None
 
@@ -310,6 +344,7 @@ def build_chunked_search(
     chan_group: int = 16,
     max_delay_samples: int = 0,
     block: int | None = None,
+    n_parts: int = 1,
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -346,6 +381,7 @@ def build_chunked_search(
     """
     from ..ops.dedisperse_pallas import dedisperse_pallas
 
+    _check_f32_packable(size)
     nlevels = nharms + 1
     n_chunks = ndm_local // dm_chunk
     n_ablocks = namax // accel_block
@@ -353,8 +389,15 @@ def build_chunked_search(
     assert namax == n_ablocks * accel_block
     use_tables = block is not None
 
-    def shard_fn(data, delays, accs, uidx, d0_u, pos_u, step_u, birdies,
-                 widths):
+    def shard_fn(*args):
+        # data arrives AND STAYS flat, split into int32-indexable
+        # whole-channel parts — any 2-D view (even a reshape) costs a
+        # full-size relayout copy under shard_map, 8 GB at production
+        # scale (see ops.dedisperse.dedisperse_flat)
+        parts = list(args[:n_parts])
+        (delays, accs, uidx, d0_u, pos_u, step_u, birdies,
+         widths) = args[n_parts:]
+        nsamps_dev = sum(p.shape[0] for p in parts) // nchans
         def chunk_body(_, ci):
             z = jnp.int32(0)  # literal 0 is weak-i64 under x64
             delays_c = lax.dynamic_slice(
@@ -368,13 +411,15 @@ def build_chunked_search(
             )
             if dedisp_method == "pallas":
                 trials = dedisperse_pallas(
-                    data, delays_c, out_nsamps,
+                    jnp.concatenate(parts).reshape(nchans, -1),
+                    delays_c, out_nsamps,
                     window_slack=window_slack, dm_tile=dm_tile,
                     time_tile=time_tile, chan_group=chan_group,
                     max_delay=max_delay_samples,
                 )
             else:
-                trials = dedisperse(data, delays_c, out_nsamps)
+                trials = dedisperse_flat(
+                    parts, delays_c, nsamps_dev, out_nsamps)
             if out_nsamps >= size:
                 trials_sz = trials[:, :size]
             else:
@@ -446,8 +491,9 @@ def build_chunked_search(
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(), P("dm", None), P("dm", None), P("dm", None),
-                  P(), P(), P(), P(), P()),
+        in_specs=(P(),) * n_parts + (
+            P("dm", None), P("dm", None), P("dm", None),
+            P(), P(), P(), P(), P()),
         out_specs=P("dm"),
         # pallas_call out_shapes carry no varying-mesh-axes annotation;
         # every output here is trivially dm-varying, so skip the check
@@ -556,13 +602,13 @@ class MeshPulsarSearch(PulsarSearch):
 
     # -- bounded-HBM chunked path (production scale) --------------------
 
-    # rough per-element coefficients for the planner: the batched
-    # search chain's biggest concurrent buffers (f64 resample indices,
-    # complex spectra, harmonic sums) cost ~32 B per sample per live
-    # spectrum; whiten ~24 B/sample/row.  Deliberately conservative —
-    # the scan reuses buffers across steps, so only one chunk's worth
-    # is ever live.
-    _SPECTRUM_BYTES = 32
+    # per-element coefficients for the planner, calibrated against
+    # XLA-reported HLO-temp usage at 2^23 samples on v5e (after fixing
+    # the linear_stretch paired-gather layout blowup that used to cost
+    # 2 GB/row): whiten keeps ~6 full-length f32 buffers live per row,
+    # the accel step ~12 per live spectrum (resample windows, fft,
+    # interbin, harmonic-sum einsum windows).
+    _SPECTRUM_BYTES = 48
     _WHITEN_BYTES = 24
 
     def _data_bytes(self) -> int:
@@ -617,11 +663,23 @@ class MeshPulsarSearch(PulsarSearch):
         )
         dm_tile = min(32, dm_chunk)
         on_tpu = jax.devices()[0].platform == "tpu"
-        use_pallas = (
+        # The Pallas kernel is DISABLED on the chunked path for now:
+        # its custom call pins a tiled 2-D operand layout, and XLA
+        # assigns 2-D u8 entry params the OPPOSITE (column-major)
+        # layout, materialising a full-size relayout copy of the
+        # filterbank inside the program (8 GB at production scale,
+        # straight to OOM).  Data therefore ships FLAT (unique layout,
+        # copy-free) and dedispersion uses the XLA dynamic-slice scan,
+        # whose accumulator traffic (~nchans * dm_chunk * out_nsamps *
+        # 4 B per chunk) costs ~20 s at 2^23 x 1024 chans x 500 DMs —
+        # small against the search itself.  TODO: rework the kernel to
+        # take the flat ref and DMA per-channel rows, then re-enable.
+        use_pallas = False and (
             on_tpu
             and time_tile > 0
             and self.fil.nchans % chan_group == 0
             and dm_chunk % dm_tile == 0
+            and dm_tile % 8 == 0
         )
         plan = dict(
             dm_chunk=dm_chunk, accel_block=accel_block,
@@ -647,10 +705,16 @@ class MeshPulsarSearch(PulsarSearch):
         return plan
 
     def _device_inputs_chunked(self, plan, acc_lists):
-        """Channel-major (killmask-applied, tail-padded) data plus the
-        padded trial grid, uploaded once and cached in HBM."""
-        if getattr(self, "_dev_inputs_chunked", None) is not None:
-            return self._dev_inputs_chunked
+        """Upload-once device state for the per-chunk dispatches.
+
+        Big replicated arrays (flat data, unique resample tables,
+        zap lists) live in HBM across all dispatches in
+        ``self._dev_chunk_static``; the per-row arrays (delays, accel
+        grid, table indices) stay HOST-side in
+        ``self._host_chunk_arrays`` — each dispatch uploads only its
+        chunk's (tiny) row slices."""
+        if getattr(self, "_dev_chunk_static", None) is not None:
+            return
         ndm = len(self.dm_list)
         ndm_pp = plan["ndm_local_p"] * self.ndev
         namax_p = plan["namax_p"]
@@ -675,20 +739,20 @@ class MeshPulsarSearch(PulsarSearch):
         if self.killmask is not None:
             data[:, :nsamps] *= self.killmask[:, None].astype(data.dtype)
         rep = NamedSharding(self.mesh, P())
-        shard = NamedSharding(self.mesh, P("dm", None))
         uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
-        self._dev_inputs_chunked = (
-            jax.device_put(jnp.asarray(data), rep),
-            jax.device_put(jnp.asarray(delays), shard),
-            jax.device_put(jnp.asarray(accs), shard),
-            jax.device_put(jnp.asarray(uidx), shard),
+        self._host_chunk_arrays = (delays, accs, uidx)
+        parts = tuple(
+            jax.device_put(jnp.asarray(p), rep)
+            for p in split_flat_channels(data)
+        )
+        self._dev_chunk_static = (
+            parts,
             jax.device_put(jnp.asarray(d0_u), rep),
             jax.device_put(jnp.asarray(pos_u), rep),
             jax.device_put(jnp.asarray(step_u), rep),
             jax.device_put(jnp.asarray(self.birdies), rep),
             jax.device_put(jnp.asarray(self.bwidths), rep),
         )
-        return self._dev_inputs_chunked
 
     def _fold_trials_provider(self, dm_idxs):
         """Re-dedisperse just the candidate DM rows for folding (the
@@ -697,7 +761,8 @@ class MeshPulsarSearch(PulsarSearch):
         plan = self._chunk_plan
         uniq = sorted(set(int(i) for i in dm_idxs))
         row_map = {dm: r for r, dm in enumerate(uniq)}
-        data = self._dev_inputs_chunked[0]
+        data_parts = self._dev_chunk_static[0]  # flat parts (see
+        nchans = self.fil.nchans                # _device_inputs_chunked)
         delays_sel = jnp.asarray(self.delays[uniq])
         if plan["dedisp_method"] == "pallas":
             from ..ops.dedisperse_pallas import dedisperse_pallas
@@ -707,54 +772,83 @@ class MeshPulsarSearch(PulsarSearch):
             # (1, chan_group) block's spread is <= the plan's
             # (dm_tile, chan_group) bound, so the plan slack is valid
             # and the pre-padded data needs no re-pad
-            trials = dedisperse_pallas(
-                data, delays_sel, self.out_nsamps,
-                window_slack=plan["window_slack"],
-                dm_tile=1, time_tile=plan["time_tile"],
-                chan_group=plan["chan_group"],
-                max_delay=self.max_delay,
-            )
+            trials = jax.jit(
+                lambda d, *fs: dedisperse_pallas(
+                    jnp.concatenate(fs).reshape(nchans, -1), d,
+                    self.out_nsamps,
+                    window_slack=plan["window_slack"],
+                    dm_tile=1, time_tile=plan["time_tile"],
+                    chan_group=plan["chan_group"],
+                    max_delay=self.max_delay,
+                )
+            )(delays_sel, *data_parts)
         else:
-            trials = dedisperse(data, delays_sel, self.out_nsamps)
+            nsamps_dev = sum(p.shape[0] for p in data_parts) // nchans
+            trials = jax.jit(
+                lambda d, *fs: dedisperse_flat(
+                    list(fs), d, nsamps_dev, self.out_nsamps)
+            )(delays_sel, *data_parts)
         return trials, row_map
 
     def _run_chunked(self, plan, acc_lists, namax, timers, t_total, ckpt,
                      ckpt_done):
+        """Bounded-HBM production driver: ONE dispatch per DM chunk.
+
+        A single whole-search dispatch at production scale (500 DM x
+        21 accel x 2^23 samples) runs for minutes inside one XLA
+        program — long enough to hit backend execution limits (the v5e
+        worker died mid-run with a kernel-fault report), with no
+        progress visibility and an all-or-nothing failure mode.  Each
+        chunk of ``dm_chunk`` rows per device is instead its own
+        dispatch (~10 s of device time): the per-chunk program is
+        compiled once (and persistent-cached), results stream home,
+        the checkpoint advances as chunks land, and buffer escalation
+        re-runs one chunk instead of the whole search.  The reference
+        streams trials the same way (`src/pipeline_multi.cu:145-157`).
+        """
         import time
 
         cfg = self.config
         ndm = len(self.dm_list)
         ndm_local_p = plan["ndm_local_p"]
+        dm_chunk = plan["dm_chunk"]
         namax_p = plan["namax_p"]
         nlevels = cfg.nharmonics + 1
         cap = cfg.peak_capacity
-        total_slots = ndm_local_p * namax_p * nlevels * cap
-        compact_k = min(cfg.compact_capacity, total_slots)
+        # per-SHARD slot count: compact_k and nvalid are per-shard
+        chunk_slots = dm_chunk * namax_p * nlevels * cap
         self._chunk_plan = plan
         from ..utils import trace_range
 
         t0 = time.time()
-        inputs = self._device_inputs_chunked(plan, acc_lists)
-        while True:
-            program = build_chunked_search(
+        self._device_inputs_chunked(plan, acc_lists)
+        data_parts, d0_u, pos_u, step_u, birdies_d, widths_d = (
+            self._dev_chunk_static
+        )
+        delays_h, accs_h, uidx_h = self._host_chunk_arrays
+        rep = NamedSharding(self.mesh, P())
+        shard = NamedSharding(self.mesh, P("dm", None))
+
+        def build(cap_, ck_):
+            return build_chunked_search(
                 self.mesh,
                 nchans=self.fil.nchans,
                 out_nsamps=self.out_nsamps,
                 size=self.size,
-                ndm_local=ndm_local_p,
-                dm_chunk=plan["dm_chunk"],
+                ndm_local=dm_chunk,
+                dm_chunk=dm_chunk,
                 namax=namax_p,
                 accel_block=plan["accel_block"],
                 bin_width=self.bin_width,
                 tsamp=float(self.fil.tsamp),
                 nharms=cfg.nharmonics,
                 bounds=self.bounds,
-                capacity=cap,
+                capacity=cap_,
                 min_snr=cfg.min_snr,
                 b5=cfg.boundary_5_freq,
                 b25=cfg.boundary_25_freq,
                 use_zap=bool(len(self.birdies)),
-                compact_k=compact_k,
+                compact_k=ck_,
                 max_shift=self.max_shift,
                 dedisp_method=plan["dedisp_method"],
                 window_slack=plan["window_slack"],
@@ -763,28 +857,93 @@ class MeshPulsarSearch(PulsarSearch):
                 chan_group=plan["chan_group"],
                 max_delay_samples=self.max_delay,
                 block=self.resample_block,
+                n_parts=len(data_parts),
             )
-            with trace_range("Chunked-Search"):
-                packed = fetch_to_host(program(*inputs))
-            per_dm_groups, mx_count, mx_valid = self._decode_packed(
-                packed, ndm_local_p, namax_p, nlevels, cap, compact_k
-            )
-            nxt = self._escalated(
-                cap, compact_k, mx_count, mx_valid,
-                ndm_local_p * namax_p * nlevels * cap,
-            )
-            if nxt is None:
-                break
-            cap, compact_k = nxt
+
+        n_chunks = ndm_local_p // dm_chunk
+        dm_cands = CandidateCollection()
+        all_clipped: dict[int, int] = {}  # global row -> max count
+        for ci in range(n_chunks):
+            # per-device row block ci: rows d*ndm_local_p + [c0, c0+dm_chunk)
+            c0 = ci * dm_chunk
+            rows = np.concatenate([
+                np.arange(d * ndm_local_p + c0,
+                          d * ndm_local_p + c0 + dm_chunk)
+                for d in range(self.ndev)
+            ])
+            rows_in = np.minimum(rows, delays_h.shape[0] - 1)
+            if all(int(r) in ckpt_done or int(r) >= ndm
+                   or int(r) != int(rows_in[k])
+                   for k, r in enumerate(rows)):
+                continue  # checkpoint resume: chunk already searched
+            # per-chunk, the FULL slot count is a small buffer (~7 MB
+            # at dm_chunk=8 x 21 accels x 5 levels x 1024): sizing the
+            # compacted buffer to it makes truncation impossible, so
+            # the truncation-escalation recompile (~10 min mid-run on
+            # the remote compiler) never fires
+            ck = chunk_slots
+            cap_c = cap
+            while True:
+                program = build(cap_c, ck)
+                with trace_range(f"Chunked-Search-{ci}"):
+                    packed = fetch_to_host(program(
+                        *data_parts,
+                        jax.device_put(jnp.asarray(delays_h[rows_in]),
+                                       shard),
+                        jax.device_put(jnp.asarray(accs_h[rows_in]),
+                                       shard),
+                        jax.device_put(jnp.asarray(uidx_h[rows_in]),
+                                       shard),
+                        d0_u, pos_u, step_u, birdies_d, widths_d,
+                    ))
+                (groups_l, mx_count, mx_valid, counts_l,
+                 clipped_l, truncated_l) = self._decode_packed(
+                    packed, dm_chunk, namax_p, nlevels, cap_c, ck
+                )
+                nxt = self._escalated(
+                    cap_c, ck, mx_count, mx_valid, chunk_slots,
+                    len(truncated_l), self.ndev * dm_chunk,
+                )
+                if nxt is None:
+                    break
+                cap_c, ck = nxt
+            for key, grp in groups_l.items():
+                ii = int(rows[key])
+                if ii >= ndm or ii != rows_in[key]:
+                    continue  # padding rows
+                if key in clipped_l:
+                    continue  # re-searched below with a bigger buffer
+                cands_ii = self._distill_dm_row(ii, grp, acc_lists[ii])
+                ckpt_done[ii] = cands_ii
+            for key in clipped_l:
+                ii = int(rows[key])
+                if ii < ndm and ii == rows_in[key]:
+                    all_clipped[ii] = int(counts_l[key].max())
+            # rows with NO peaks at all produce no group entry
+            for key in range(len(rows)):
+                ii = int(rows[key])
+                if (ii < ndm and ii == rows_in[key]
+                        and ii not in ckpt_done and key not in clipped_l):
+                    cands_ii = self._distill_dm_row(
+                        ii, groups_l.get(key), acc_lists[ii])
+                    ckpt_done[ii] = cands_ii
+            if ckpt:
+                # honours cfg.checkpoint_interval (counted in DM rows,
+                # like the host-loop path)
+                ckpt.maybe_save(ckpt_done)
+            if cfg.verbose:
+                print(f"chunk {ci + 1}/{n_chunks} done "
+                      f"({time.time() - t0:.0f}s)", flush=True)
+
+        rerun = self._rerun_clipped_rows(
+            set(all_clipped), all_clipped, self._fold_trials_provider,
+        )
+        for ii, cands_ii in rerun.items():
+            ckpt_done[ii] = cands_ii
         timers["dedispersion"] = 0.0  # fused into the search program
         timers["searching_device"] = time.time() - t0
-        dm_cands = CandidateCollection()
         for ii in range(ndm):
-            cands_ii = self._distill_dm_row(
-                ii, per_dm_groups.get(ii), acc_lists[ii]
-            )
-            ckpt_done[ii] = cands_ii
-            dm_cands.append(cands_ii)
+            dm_cands.append(ckpt_done.get(ii, []))
         if ckpt:
             ckpt.save(ckpt_done)
         timers["searching"] = time.time() - t0
@@ -809,25 +968,28 @@ class MeshPulsarSearch(PulsarSearch):
         at 100000, `peakfinder.hpp:17,61`)."""
         ndev = self.ndev
         nspec_local = ndm_local * namax * nlevels
-        blk_len = 2 * compact_k + nspec_local + 1
+        # layout: sel_bin | sel_snr | counts | nvalid_hi | nvalid_lo —
+        # int values travel as plain (exactly-representable) f32, see
+        # _compact_peaks
+        blk_len = 2 * compact_k + nspec_local + 2
         sel_bin = np.empty(ndev * compact_k, np.int32)
         sel_snr = np.empty(ndev * compact_k, np.float32)
         counts = np.empty((ndev * ndm_local, namax, nlevels), np.int32)
-        nvalid = np.empty(ndev, np.int32)
+        nvalid = np.empty(ndev, np.int64)
         for sidx in range(ndev):
             blk = packed[sidx * blk_len : (sidx + 1) * blk_len]
             sel_bin[sidx * compact_k : (sidx + 1) * compact_k] = (
-                blk[:compact_k].view(np.int32)
+                blk[:compact_k].astype(np.int32)
             )
             sel_snr[sidx * compact_k : (sidx + 1) * compact_k] = (
                 blk[compact_k : 2 * compact_k]
             )
             counts[sidx * ndm_local : (sidx + 1) * ndm_local] = (
                 blk[2 * compact_k : 2 * compact_k + nspec_local]
-                .view(np.int32)
+                .astype(np.int32)
                 .reshape(ndm_local, namax, nlevels)
             )
-            nvalid[sidx] = blk[-1:].view(np.int32)[0]
+            nvalid[sidx] = int(blk[-2]) * 65536 + int(blk[-1])
 
         # reconstruct each entry's (dm_local, accel, level) tag from
         # counts (the device compaction keeps valid slots in flat
@@ -835,13 +997,27 @@ class MeshPulsarSearch(PulsarSearch):
         # spectra in one native segmented call per shard
         factors = np.array([b[2] for b in self.bounds])
         per_dm_groups: dict[int, tuple] = {}
+        clipped_rows: set[int] = set()
+        truncated_rows: set[int] = set()
         for s in range(ndev):
-            k = np.minimum(
-                counts[s * ndm_local : (s + 1) * ndm_local], cap
-            ).reshape(-1)
+            shard_counts = counts[s * ndm_local : (s + 1) * ndm_local]
+            k = np.minimum(shard_counts, cap).reshape(-1)
             seg_bounds = np.minimum(
                 np.concatenate([[0], np.cumsum(k)]), compact_k
             )
+            # rows whose slots ran past the compacted buffer (dropped
+            # tail) or whose per-spectrum buffers clipped: re-searched
+            # by the caller on the small host path.  The two causes
+            # are tracked separately: only TRUNCATION is fixable by
+            # regrowing compact_k (see `_escalated`)
+            truncated = np.cumsum(k) > compact_k
+            over = (shard_counts > cap).any(axis=(1, 2))
+            for d in range(ndm_local):
+                sl = slice(d * namax * nlevels, (d + 1) * namax * nlevels)
+                if truncated[sl].any():
+                    truncated_rows.add(s * ndm_local + d)
+                if truncated[sl].any() or over[d]:
+                    clipped_rows.add(s * ndm_local + d)
             total = int(seg_bounds[-1])
             blk = slice(s * compact_k, s * compact_k + total)
             merged_bin, merged_snr, seg_counts = segmented_unique_peaks(
@@ -859,28 +1035,69 @@ class MeshPulsarSearch(PulsarSearch):
                 per_dm_groups[int(s * ndm_local + d)] = (
                     freqs[m], merged_snr[m], acc_i[m], lvl[m]
                 )
-        return per_dm_groups, int(counts.max(initial=0)), int(nvalid.max())
+        return (per_dm_groups, int(counts.max(initial=0)),
+                int(nvalid.max()), counts, clipped_rows, truncated_rows)
 
-    @staticmethod
-    def _escalated(cap, compact_k, max_count, max_nvalid, total_slots):
-        """Next (capacity, compact_k) after an overflow, or None."""
+    def _rerun_clipped_rows(self, clipped_rows, counts, trials_provider):
+        """Re-search DM rows whose peak buffers clipped, on the small
+        host-loop path with a capacity sized to their true counts.
+
+        Replaces the old escalate-and-redispatch design: the whole
+        fused/chunked program would otherwise be recompiled and
+        re-executed for a handful of RFI-loud rows (and large per-trial
+        top_k capacities inside the big program crash the v5e
+        backend).  Returns {dm_idx: distilled candidates}.
+        """
         import warnings
 
-        new_cap, new_ck = cap, compact_k
-        if max_count > cap:
-            new_cap = 1 << int(np.ceil(np.log2(max_count)))
-        if max_nvalid > compact_k and compact_k < total_slots:
+        ndm = len(self.dm_list)
+        rows = sorted(ii for ii in clipped_rows if ii < ndm)
+        if not rows:
+            return {}
+        warnings.warn(
+            f"peak buffers clipped on {len(rows)} DM trial(s); "
+            f"re-searching those rows with escalated capacity"
+        )
+        trials_sel, row_map = trials_provider(rows)
+        out = {}
+        for ii in rows:
+            # ``counts`` maps row -> max above-threshold count (or an
+            # array indexable by row on the fused path)
+            row_max = counts[ii]
+            if not np.isscalar(row_max) and not isinstance(row_max, int):
+                row_max = int(np.asarray(row_max).max())
+            cap2 = 1 << int(np.ceil(np.log2(max(
+                int(row_max), self.config.peak_capacity) + 1)))
+            tim = self._trial_tim(trials_sel, row_map[ii])
+            out[ii] = self._search_tim(tim, ii, start_capacity=cap2)
+        return out
+
+    @staticmethod
+    def _escalated(cap, compact_k, max_count, max_nvalid, total_slots,
+                   n_truncated, ndm):
+        """Next (capacity, compact_k) after a compacted-buffer
+        overflow, or None.
+
+        Per-spectrum capacity is NEVER escalated here (clipped rows are
+        re-searched individually, `_rerun_clipped_rows`); the shared
+        compacted buffer is only regrown when so many rows TRUNCATED
+        by it (over-capacity rows would stay clipped regardless of
+        compact_k) that per-row re-runs would cost more than
+        recompiling the dispatch."""
+        import warnings
+
+        if (max_nvalid > compact_k and compact_k < total_slots
+                and n_truncated > max(4, ndm // 4)):
             new_ck = int(min(
                 total_slots, 1 << int(np.ceil(np.log2(max_nvalid)))
             ))
-        if (new_cap, new_ck) == (cap, compact_k):
-            return None
-        warnings.warn(
-            f"peak buffers overflowed (count {max_count}/{cap}, "
-            f"compacted {max_nvalid}/{compact_k}); re-running with "
-            f"capacity={new_cap}, compact_capacity={new_ck}"
-        )
-        return new_cap, new_ck
+            warnings.warn(
+                f"compacted peak buffer truncated {n_truncated} rows "
+                f"({max_nvalid}/{compact_k}); re-running with "
+                f"compact_capacity={new_ck}"
+            )
+            return cap, new_ck
+        return None
 
     def _distill_dm_row(self, ii, group, acc_list):
         """Build + distill one DM trial's candidates from its decoded
@@ -998,25 +1215,34 @@ class MeshPulsarSearch(PulsarSearch):
                 # ONE gather over ICI/DCN -> host; ``trials`` stays on
                 # device for the folding phase
                 packed = fetch_to_host(packed)
-            per_dm_groups, mx_count, mx_valid = self._decode_packed(
+            (per_dm_groups, mx_count, mx_valid, counts_arr,
+             clipped, truncated) = self._decode_packed(
                 packed, ndm_local, namax, nlevels, cap, compact_k
             )
             nxt = self._escalated(
                 cap, compact_k, mx_count, mx_valid,
                 ndm_local * namax * nlevels * cap,
+                len(truncated), ndm,
             )
             if nxt is None:
                 break
             cap, compact_k = nxt
+        rerun = self._rerun_clipped_rows(
+            clipped, counts_arr,
+            lambda rows: (trials, {ii: ii for ii in rows}),
+        )
         timers["dedispersion"] = 0.0  # fused into the search program
         # sub-span of "searching" (which covers device + host decode)
         timers["searching_device"] = time.time() - t0
         dm_cands = CandidateCollection()
         ckpt_done = {}
         for ii in range(ndm):
-            cands_ii = self._distill_dm_row(
-                ii, per_dm_groups.get(ii), acc_lists[ii]
-            )
+            if ii in rerun:
+                cands_ii = rerun[ii]
+            else:
+                cands_ii = self._distill_dm_row(
+                    ii, per_dm_groups.get(ii), acc_lists[ii]
+                )
             ckpt_done[ii] = cands_ii
             dm_cands.append(cands_ii)
         if ckpt:

@@ -264,9 +264,21 @@ class PulsarSearch:
         return jnp.concatenate([tim, pad])
 
     def search_dm_trial(self, trials: jax.Array, idx: int) -> list[Candidate]:
+        return self._search_tim(self._trial_tim(trials, idx), idx)
+
+    def _search_tim(self, tim: jax.Array, idx: int,
+                    start_capacity: int | None = None) -> list[Candidate]:
+        """Whiten + accel-search one prepared (fft-size) time series.
+
+        Also the targeted re-run path for mesh overflow handling: a DM
+        row whose peak buffers clipped in the big fused/chunked
+        program is re-searched here with ``start_capacity`` sized to
+        its true count — a small program where large top_k capacities
+        are safe, instead of recompiling and re-running the whole
+        multi-minute dispatch.
+        """
         cfg = self.config
         dm = float(self.dm_list[idx])
-        tim = self._trial_tim(trials, idx)
         tim_w, mean, std = whiten_trial(
             tim,
             jnp.asarray(self.birdies),
@@ -282,7 +294,7 @@ class PulsarSearch:
         padded = int(np.ceil(n / chunk)) * chunk
         accs = np.zeros(padded, np.float32)
         accs[:n] = acc_list
-        cap = cfg.peak_capacity
+        cap = start_capacity or cfg.peak_capacity
         chunk_tables = {}
         if self.resample_block is not None:
             from ..ops.resample import resample2_tables

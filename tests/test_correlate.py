@@ -54,3 +54,26 @@ def test_matches_numpy_reference():
 
 def test_no_baselines_for_single_antenna():
     assert find_delays(np.zeros((1, 128), np.complex64), 8) == []
+
+
+def test_accmap_cli(tmp_path, capsys):
+    """`peasoup-tpu accmap` recovers a known inter-antenna delay from a
+    raw complex8 file (the reference accmap.cpp payload layout)."""
+    from peasoup_tpu.cli import main
+
+    rng = np.random.default_rng(7)
+    size, lag = 4096, 37
+    base = rng.integers(-60, 60, size + lag)
+    a = base[:size]
+    b = base[lag : size + lag]  # antenna 1 sees the signal `lag` early
+    raw = np.zeros((2, size, 2), np.int8)
+    raw[0, :, 0] = a
+    raw[1, :, 0] = b
+    path = tmp_path / "antennas.bin"
+    raw.tofile(path)
+    rc = main(["accmap", str(path), "--nant", "2", "--size", str(size),
+               "--max_delay", "128"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "baseline 0-1" in out
+    assert f"lag {lag} " in out or f"lag {-lag} " in out

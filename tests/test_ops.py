@@ -429,6 +429,33 @@ def test_extract_above_threshold():
     assert np.all(idxs[4:] == -1)
 
 
+@pytest.mark.parametrize("thresh", [0.5, 2.0, 9.0])
+def test_extract_two_stage_matches_reference(thresh):
+    """The large-spectrum two-stage extraction must return exactly the
+    first `capacity` qualifying indices, like the single top_k path —
+    including when hits are spread one-per-row (the case the row
+    selection argument has to cover)."""
+    from peasoup_tpu.ops.peaks import _TWO_STAGE_MIN_SIZE
+
+    n = _TWO_STAGE_MIN_SIZE + 4097
+    cap = 64
+    spec = np.abs(rng.normal(size=n)).astype(np.float32)
+    # sprinkle guaranteed hits one per 600 bins (one per row-ish)
+    spec[::600] += 12.0
+    start, stop = 100, n - 50
+    idxs, snrs, count = extract_above_threshold(
+        jnp.asarray(spec), thresh, start, stop, cap)
+    i = np.arange(n)
+    m = (i >= start) & (i < stop) & (spec > thresh)
+    want = i[m][:cap]
+    got = np.asarray(idxs)[np.asarray(idxs) >= 0]
+    np.testing.assert_array_equal(np.sort(got), np.sort(want))
+    assert int(count) == int(m.sum())
+    np.testing.assert_allclose(
+        np.sort(np.asarray(snrs)[np.asarray(idxs) >= 0]),
+        np.sort(spec[want]), rtol=1e-6)
+
+
 def test_identify_unique_peaks():
     # Two clusters within min_gap, one isolated peak.
     idxs = np.array([100, 105, 120, 200, 500])
@@ -450,3 +477,20 @@ def test_spectrum_search_bounds():
     assert start2 == pytest.approx(4 * start0, abs=4)
     assert stop2 == size  # max_bin exceeds size
     assert f2 == pytest.approx(f0 / 4)
+
+
+def test_median_scrunch5_lane_path_exact():
+    """The lane-aligned scrunch (matmul selection + sorting network)
+    must match the reshape+sort formulation bit-for-bit across the
+    dispatch threshold."""
+    from peasoup_tpu.ops.rednoise import (
+        _LANE_SCRUNCH_MIN,
+        _median_scrunch5_lanes,
+    )
+
+    for n in (_LANE_SCRUNCH_MIN + 1013, _LANE_SCRUNCH_MIN + 640 * 7):
+        x = rng.normal(size=n).astype(np.float32)
+        want = np.sort(
+            x[: (n // 5) * 5].reshape(-1, 5), axis=1)[:, 2]
+        got = np.asarray(_median_scrunch5_lanes(jnp.asarray(x)))
+        np.testing.assert_array_equal(got, want)
