@@ -429,55 +429,54 @@ def build_chunked_search(
                 )
                 trials_sz = jnp.concatenate([trials, pad], axis=1)
 
-            whiten = lambda tim: whiten_core(
-                tim, birdies, widths, bin_width, b5, b25, use_zap
-            )
-            tim_w, mean, std = jax.vmap(whiten)(trials_sz)
-
-            def ab_body(_, ai):
-                accs_blk = lax.dynamic_slice(
-                    accs_c, (jnp.int32(0), ai * accel_block),
-                    (dm_chunk, accel_block),
-                )
-                uidx_blk = lax.dynamic_slice(
-                    uidx_c, (jnp.int32(0), ai * accel_block),
-                    (dm_chunk, accel_block),
+            # scan over DM ROWS with a WIDE accel vmap per step: a
+            # wide trial batch keeps the chip fed (measured 18.6
+            # ms/trial at 2^23 for a 21-wide vmap vs ~72 ms/trial for
+            # the inverted nesting of an 8-row vmap stepping accels
+            # one at a time); accel_block bounds the live spectra per
+            # step for the HBM budget
+            def row_body(_, row_in):
+                tim, arow, urow = row_in
+                tw, m, s = whiten_core(
+                    tim, birdies, widths, bin_width, b5, b25, use_zap
                 )
 
-                def row(tw, m, s, arow, urow):
+                def ab_body(__, ai):
+                    a_blk = lax.dynamic_slice(
+                        arow, (ai * accel_block,), (accel_block,))
+                    u_blk = lax.dynamic_slice(
+                        urow, (ai * accel_block,), (accel_block,))
                     if use_tables:
                         search = lambda ui: search_one_accel(
                             tw, (d0_u[ui], pos_u[ui], step_u[ui]), m, s,
                             tsamp, nharms, bounds, capacity, min_snr,
                             max_shift, block,
                         )
-                        i2, s2, c2 = jax.vmap(search)(urow)
+                        i2, s2, c2 = jax.vmap(search)(u_blk)
                     else:
                         search = lambda a: search_one_accel_legacy(
                             tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
                             bounds, capacity, min_snr, max_shift,
                         )
-                        i2, s2, c2 = jax.vmap(search)(arow)
-                    valid = ~jnp.isnan(arow)
+                        i2, s2, c2 = jax.vmap(search)(a_blk)
+                    valid = ~jnp.isnan(a_blk)
                     i2 = jnp.where(valid[:, None, None], i2, -1)
                     s2 = jnp.where(valid[:, None, None], s2, 0.0)
                     c2 = jnp.where(valid[:, None], c2, 0)
-                    return i2, s2, c2
+                    return 0, (i2, s2, c2)
 
-                return 0, jax.vmap(row)(tim_w, mean, std, accs_blk,
-                                        uidx_blk)
+                _, (bi, bs, bc) = lax.scan(
+                    ab_body, 0, jnp.arange(n_ablocks, dtype=jnp.int32)
+                )
+                return 0, (
+                    bi.reshape(namax, nlevels, capacity),
+                    bs.reshape(namax, nlevels, capacity),
+                    bc.reshape(namax, nlevels),
+                )
 
             _, (bi, bs, bc) = lax.scan(
-                ab_body, 0, jnp.arange(n_ablocks, dtype=jnp.int32)
+                row_body, 0, (trials_sz, accs_c, uidx_c)
             )
-            # (n_ablocks, dm_chunk, accel_block, ...) -> (dm_chunk, namax, ...)
-            bi = jnp.moveaxis(bi, 0, 1).reshape(
-                dm_chunk, namax, nlevels, capacity
-            )
-            bs = jnp.moveaxis(bs, 0, 1).reshape(
-                dm_chunk, namax, nlevels, capacity
-            )
-            bc = jnp.moveaxis(bc, 0, 1).reshape(dm_chunk, namax, nlevels)
             return 0, (bi, bs, bc)
 
         _, (idxs, snrs, counts) = lax.scan(
@@ -639,18 +638,22 @@ class MeshPulsarSearch(PulsarSearch):
                 f"filterbank alone ({self._data_bytes()/1e9:.1f} GB) "
                 f"exceeds hbm_budget_gb={cfg.hbm_budget_gb}"
             )
-        # half the remaining budget to whiten+trials, half to spectra
+        # the row scan keeps ONE whiten + accel_block spectra live;
+        # dm_chunk rows only cost their dedispersed trials.  A quarter
+        # of the budget goes to trials, the rest to the accel batch
+        # (wider batches keep the chip fed: 21-wide measured 18.6
+        # ms/trial vs 72 ms/trial for 8-row x 1-accel nesting)
         if cfg.dm_chunk:
             dm_chunk = cfg.dm_chunk
         else:
-            per_row = (self._WHITEN_BYTES * self.size
+            per_row = (self._WHITEN_BYTES * self.size // 4
                        + 8 * self.out_nsamps)
-            dm_chunk = int(max(1, min(32, (avail // 2) // per_row)))
+            dm_chunk = int(max(1, min(32, (avail // 4) // per_row)))
         if cfg.accel_block:
             accel_block = cfg.accel_block
         else:
-            live = (avail // 2) // (self._SPECTRUM_BYTES * self.size)
-            accel_block = int(max(1, min(namax, live // dm_chunk)))
+            live = (avail * 3 // 4) // (self._SPECTRUM_BYTES * self.size)
+            accel_block = int(max(1, min(namax, live)))
         ndm_local_p = int(np.ceil(ndm_local / dm_chunk)) * dm_chunk
         namax_p = int(np.ceil(namax / accel_block)) * accel_block
 
@@ -871,75 +874,76 @@ class MeshPulsarSearch(PulsarSearch):
                           d * ndm_local_p + c0 + dm_chunk)
                 for d in range(self.ndev)
             ])
-            rows_in = np.minimum(rows, delays_h.shape[0] - 1)
-            if all(int(r) in ckpt_done or int(r) >= ndm
-                   or int(r) != int(rows_in[k])
-                   for k, r in enumerate(rows)):
+            if all(int(r) in ckpt_done or int(r) >= ndm for r in rows):
                 continue  # checkpoint resume: chunk already searched
             # per-chunk, the FULL slot count is a small buffer (~7 MB
             # at dm_chunk=8 x 21 accels x 5 levels x 1024): sizing the
             # compacted buffer to it makes truncation impossible, so
-            # the truncation-escalation recompile (~10 min mid-run on
-            # the remote compiler) never fires
-            ck = chunk_slots
-            cap_c = cap
-            while True:
-                program = build(cap_c, ck)
-                with trace_range(f"Chunked-Search-{ci}"):
-                    packed = fetch_to_host(program(
-                        *data_parts,
-                        jax.device_put(jnp.asarray(delays_h[rows_in]),
-                                       shard),
-                        jax.device_put(jnp.asarray(accs_h[rows_in]),
-                                       shard),
-                        jax.device_put(jnp.asarray(uidx_h[rows_in]),
-                                       shard),
-                        d0_u, pos_u, step_u, birdies_d, widths_d,
-                    ))
-                (groups_l, mx_count, mx_valid, counts_l,
-                 clipped_l, truncated_l) = self._decode_packed(
-                    packed, dm_chunk, namax_p, nlevels, cap_c, ck
-                )
-                nxt = self._escalated(
-                    cap_c, ck, mx_count, mx_valid, chunk_slots,
-                    len(truncated_l), self.ndev * dm_chunk,
-                )
-                if nxt is None:
-                    break
-                cap_c, ck = nxt
+            # no escalation/recompile path exists here (per-spectrum
+            # capacity overflow is handled by the row re-runs below)
+            program = build(cap, chunk_slots)
+            with trace_range(f"Chunked-Search-{ci}"):
+                packed = fetch_to_host(program(
+                    *data_parts,
+                    jax.device_put(jnp.asarray(delays_h[rows]), shard),
+                    jax.device_put(jnp.asarray(accs_h[rows]), shard),
+                    jax.device_put(jnp.asarray(uidx_h[rows]), shard),
+                    d0_u, pos_u, step_u, birdies_d, widths_d,
+                ))
+            (groups_l, _mx_count, _mx_valid, counts_l,
+             clipped_l, _truncated_l) = self._decode_packed(
+                packed, dm_chunk, namax_p, nlevels, cap, chunk_slots
+            )
+            n_new = 0
             for key, grp in groups_l.items():
                 ii = int(rows[key])
-                if ii >= ndm or ii != rows_in[key]:
+                if ii >= ndm:
                     continue  # padding rows
                 if key in clipped_l:
                     continue  # re-searched below with a bigger buffer
-                cands_ii = self._distill_dm_row(ii, grp, acc_lists[ii])
-                ckpt_done[ii] = cands_ii
+                ckpt_done[ii] = self._distill_dm_row(
+                    ii, grp, acc_lists[ii])
+                n_new += 1
             for key in clipped_l:
                 ii = int(rows[key])
-                if ii < ndm and ii == rows_in[key]:
+                if ii < ndm:
                     all_clipped[ii] = int(counts_l[key].max())
             # rows with NO peaks at all produce no group entry
             for key in range(len(rows)):
                 ii = int(rows[key])
-                if (ii < ndm and ii == rows_in[key]
-                        and ii not in ckpt_done and key not in clipped_l):
-                    cands_ii = self._distill_dm_row(
+                if (ii < ndm and ii not in ckpt_done
+                        and key not in clipped_l):
+                    ckpt_done[ii] = self._distill_dm_row(
                         ii, groups_l.get(key), acc_lists[ii])
-                    ckpt_done[ii] = cands_ii
+                    n_new += 1
             if ckpt:
-                # honours cfg.checkpoint_interval (counted in DM rows,
-                # like the host-loop path)
-                ckpt.maybe_save(ckpt_done)
+                # cfg.checkpoint_interval counts DM rows (host-loop
+                # cadence); tick once per completed row
+                for _ in range(n_new):
+                    ckpt.maybe_save(ckpt_done)
             if cfg.verbose:
                 print(f"chunk {ci + 1}/{n_chunks} done "
                       f"({time.time() - t0:.0f}s)", flush=True)
 
+        if all_clipped:
+            # drop the per-chunk executables before the re-search
+            # programs compile: their retained workspace plus the
+            # resident filterbank left too little HBM for the
+            # escalated-capacity host path (observed RESOURCE_EXHAUSTED
+            # at production scale); the persistent compile cache makes
+            # any later rebuild cheap
+            build_chunked_search.cache_clear()
+            jax.clear_caches()
         rerun = self._rerun_clipped_rows(
             set(all_clipped), all_clipped, self._fold_trials_provider,
         )
         for ii, cands_ii in rerun.items():
             ckpt_done[ii] = cands_ii
+        if all_clipped:
+            # ...and again before folding: the escalated-capacity
+            # re-search programs retain their own workspace (the fold
+            # dispatch OOM'd after the re-runs at production scale)
+            jax.clear_caches()
         timers["dedispersion"] = 0.0  # fused into the search program
         timers["searching_device"] = time.time() - t0
         for ii in range(ndm):
@@ -1069,7 +1073,12 @@ class MeshPulsarSearch(PulsarSearch):
             cap2 = 1 << int(np.ceil(np.log2(max(
                 int(row_max), self.config.peak_capacity) + 1)))
             tim = self._trial_tim(trials_sel, row_map[ii])
-            out[ii] = self._search_tim(tim, ii, start_capacity=cap2)
+            # narrow accel batches: at production scale the replicated
+            # filterbank already occupies most of HBM, and escalated
+            # capacities widen every per-trial buffer (a 16-wide batch
+            # OOM'd on v5e with 8.6 GB of data resident)
+            out[ii] = self._search_tim(tim, ii, start_capacity=cap2,
+                                       accel_chunk=4)
         return out
 
     @staticmethod

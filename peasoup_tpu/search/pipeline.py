@@ -267,7 +267,8 @@ class PulsarSearch:
         return self._search_tim(self._trial_tim(trials, idx), idx)
 
     def _search_tim(self, tim: jax.Array, idx: int,
-                    start_capacity: int | None = None) -> list[Candidate]:
+                    start_capacity: int | None = None,
+                    accel_chunk: int | None = None) -> list[Candidate]:
         """Whiten + accel-search one prepared (fft-size) time series.
 
         Also the targeted re-run path for mesh overflow handling: a DM
@@ -290,7 +291,7 @@ class PulsarSearch:
         )
         acc_list = self.acc_plan.generate_accel_list(dm)
         n = len(acc_list)
-        chunk = max(1, min(cfg.accel_chunk, n))
+        chunk = max(1, min(accel_chunk or cfg.accel_chunk, n))
         padded = int(np.ceil(n / chunk)) * chunk
         accs = np.zeros(padded, np.float32)
         accs[:n] = acc_list
@@ -539,11 +540,12 @@ _rewhiten_for_fold = jax.jit(_rewhiten_core, static_argnames=("bin_width",))
 
 @partial(
     jax.jit,
-    static_argnames=("bin_width", "fold_nsamps", "tsamp", "nbins", "nints"),
+    static_argnames=("bin_width", "fold_nsamps", "tsamp", "nbins", "nints",
+                     "max_shift", "block"),
 )
 def _batched_fold_program(
-    trials, dm_idxs, accs, periods, bin_width, fold_nsamps, tsamp, nbins,
-    nints,
+    trials, dm_idxs, rtabs, periods, bin_width, fold_nsamps, tsamp, nbins,
+    nints, max_shift, block,
 ):
     """Re-whiten + resample + fold + optimise every candidate in ONE
     dispatch (vmapped); ships home only the optimum per candidate.
@@ -552,25 +554,33 @@ def _batched_fold_program(
     (`folder.hpp:376-389`); here each candidate redundantly re-whitens
     its row — identical numerics, and a few duplicate FFTs are far
     cheaper than per-candidate program dispatches on a remote TPU.
-    """
 
-    def one(dm_idx, acc, period):
+    ``rtabs`` are host-exact KERNEL-I staircase tables per candidate
+    (`resample1_tables`): device-side f64 index math is both inexact
+    on real TPUs (emulated rint) and a full random gather
+    (`ops/resample.py`).
+    """
+    from ..ops.resample import resample2_from_tables
+
+    def one(dm_idx, rtab, period):
         # the caller guarantees fold_nsamps <= trials.shape[1]
         tim = jax.lax.dynamic_slice(
             trials, (dm_idx, jnp.int32(0)), (1, fold_nsamps)
         ).reshape(-1)
         tim_w = _rewhiten_core(tim, bin_width)
-        tim_r = resample(tim_w, acc, tsamp)
+        d0, pos_t, step_t = rtab
+        tim_r = resample2_from_tables(tim_w, d0, pos_t, step_t,
+                                      max_shift, block=block)
         subints = fold_time_series_core(tim_r, period, tsamp, nbins, nints)
         return optimise_device(subints)
 
-    argmaxes, opt_folds, opt_profs = jax.vmap(one)(dm_idxs, accs, periods)
-    # one packed f32 buffer -> a single device->host round trip
+    argmaxes, opt_folds, opt_profs = jax.vmap(one)(dm_idxs, rtabs, periods)
+    # one packed f32 buffer -> a single device->host round trip.
+    # argmax < nshifts*nbins*ntemplates ~ 2^18 is exact in f32 (and
+    # bitcast_convert_type miscompiles on v5e, see parallel/mesh.py)
     ncand = dm_idxs.shape[0]
     return jnp.concatenate([
-        jax.lax.bitcast_convert_type(
-            argmaxes.astype(jnp.int32), jnp.float32
-        ),
+        argmaxes.astype(jnp.float32),
         opt_folds.reshape(ncand * nints * nbins),
         opt_profs.reshape(ncand * nbins),
     ])
@@ -618,22 +628,42 @@ def fold_candidates(
         [lookup.get(cands[i].dm_idx, cands[i].dm_idx) for i in fold_ids],
         jnp.int32,
     )
-    accs = jnp.asarray([cands[i].acc for i in fold_ids], jnp.float32)
+    accs = [float(cands[i].acc) for i in fold_ids]
     # f32: x64 is disabled on TPU and the relative phase error over a
     # 2^17-sample fold (~1e-7) is far below one phase bin
     periods = jnp.asarray(
         [1.0 / cands[i].freq for i in fold_ids], jnp.float32
     )
+    from ..ops.resample import resample1_tables, resample2_max_shift
     from ..utils.hostfetch import fetch_to_host
 
-    packed = fetch_to_host(_batched_fold_program(
-        trials, dm_idxs, accs, periods, bin_width, nsamps, float(tsamp),
-        nbins, nints,
-    ))
+    fold_ms = max(
+        resample2_max_shift(max(abs(a) for a in accs), tsamp, nsamps), 1)
+    fold_block = resample_block_for(nsamps, fold_ms) or min(nsamps, 128)
+    rtabs_np = resample1_tables(
+        accs, float(tsamp), nsamps, fold_ms, block=fold_block)
+    # fold in small batches: a 10-wide vmap of 2^23-sample
+    # rewhiten+resample+fold chains ran out of HBM at production scale
+    # with the filterbank resident; batches of 4 cost two extra
+    # dispatches and shrink the peak working set 2.5x
     n = len(fold_ids)
-    argmaxes = packed[:n].view(np.int32)
-    opt_folds = packed[n : n + n * nints * nbins].reshape(n, nints, nbins)
-    opt_profs = packed[n + n * nints * nbins :].reshape(n, nbins)
+    batch = 4
+    argmaxes = np.empty(n, np.int64)
+    opt_folds = np.empty((n, nints, nbins), np.float32)
+    opt_profs = np.empty((n, nbins), np.float32)
+    for b0 in range(0, n, batch):
+        b1 = min(b0 + batch, n)
+        rtabs = tuple(jnp.asarray(a[b0:b1]) for a in rtabs_np)
+        packed = fetch_to_host(_batched_fold_program(
+            trials, dm_idxs[b0:b1], rtabs, periods[b0:b1], bin_width,
+            nsamps, float(tsamp), nbins, nints, fold_ms, fold_block,
+        ))
+        m = b1 - b0
+        argmaxes[b0:b1] = packed[:m].astype(np.int64)
+        opt_folds[b0:b1] = packed[m : m + m * nints * nbins].reshape(
+            m, nints, nbins)
+        opt_profs[b0:b1] = packed[m + m * nints * nbins :].reshape(
+            m, nbins)
     for k, ci in enumerate(fold_ids):
         cand = cands[ci]
         period = 1.0 / cand.freq

@@ -494,3 +494,26 @@ def test_median_scrunch5_lane_path_exact():
             x[: (n // 5) * 5].reshape(-1, 5), axis=1)[:, 2]
         got = np.asarray(_median_scrunch5_lanes(jnp.asarray(x)))
         np.testing.assert_array_equal(got, want)
+
+
+def test_linear_stretch_lane_path_exact():
+    """Windowed-select stretch must be bit-identical with the gather
+    formulation above the dispatch threshold (identical f32 index
+    expressions)."""
+    from peasoup_tpu.ops.rednoise import (
+        _LANE_STRETCH_MIN,
+        _linear_stretch_lanes,
+    )
+
+    out_count = _LANE_STRETCH_MIN + 12345
+    for ratio in (5, 25, 125):
+        x = (rng.normal(size=out_count // ratio) ** 2).astype(np.float32)
+        in_count = x.shape[0]
+        step = np.float32(in_count - 1) / np.float32(out_count - 1)
+        xi = np.arange(out_count, dtype=np.float32) * step
+        j = xi.astype(np.int32)
+        frac = xi - j.astype(np.float32)
+        jn = np.minimum(j + 1, in_count - 1)
+        want = np.where(frac > 1e-5, x[j] + frac * (x[jn] - x[j]), x[j])
+        got = np.asarray(_linear_stretch_lanes(jnp.asarray(x), out_count))
+        np.testing.assert_array_equal(got, want, err_msg=f"ratio {ratio}")
