@@ -59,6 +59,12 @@ class _NativeLib:
             ctypes.c_size_t, u8pp, i64p, i64p,
         ]
         self._dll.distill_greedy.restype = ctypes.c_size_t
+        self._dll.distill_greedy_segmented.argtypes = [
+            ctypes.c_int, f64p, f64p, i64p, ctypes.c_size_t,
+            ctypes.c_double, ctypes.c_int64, ctypes.c_double, ctypes.c_int,
+            ctypes.c_size_t, u8pp, i64p, i64p,
+        ]
+        self._dll.distill_greedy_segmented.restype = ctypes.c_size_t
 
     def unpack_bits(self, raw: np.ndarray, nbits: int) -> np.ndarray:
         raw = np.ascontiguousarray(raw, dtype=np.uint8)
@@ -109,6 +115,39 @@ class _NativeLib:
         # generous first guess; the C side keeps counting past capacity,
         # so one exact-size retry covers the (rare) overflow instead of
         # preallocating the O(n^2) worst case
+        cap = (16 * n + 1024) if record_pairs else 0
+        npairs, pf, pa = run(cap)
+        if record_pairs and npairs > cap:
+            npairs, pf, pa = run(npairs)
+        return unique.astype(bool), pf[:npairs], pa[:npairs]
+
+    def distill_greedy_segmented(self, type_: int, freqs, aux, seg_bounds,
+                                 tol: float, max_harm: int,
+                                 tobs_over_c: float, record_pairs: bool):
+        """Segment-batched distill_greedy; pair indices are global."""
+        freqs = np.ascontiguousarray(freqs, dtype=np.float64)
+        aux = np.ascontiguousarray(aux, dtype=np.float64)
+        seg_bounds = np.ascontiguousarray(seg_bounds, dtype=np.int64)
+        n = freqs.size
+        nseg = seg_bounds.size - 1
+        unique = np.empty(n, dtype=np.uint8)
+        f64p = ctypes.POINTER(ctypes.c_double)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+
+        def run(cap):
+            pf = np.empty(max(cap, 1), dtype=np.int64)
+            pa = np.empty(max(cap, 1), dtype=np.int64)
+            npairs = self._dll.distill_greedy_segmented(
+                type_, freqs.ctypes.data_as(f64p),
+                aux.ctypes.data_as(f64p),
+                seg_bounds.ctypes.data_as(i64p), nseg, tol, max_harm,
+                tobs_over_c, int(record_pairs), cap,
+                unique.ctypes.data_as(u8p), pf.ctypes.data_as(i64p),
+                pa.ctypes.data_as(i64p),
+            )
+            return npairs, pf, pa
+
         cap = (16 * n + 1024) if record_pairs else 0
         npairs, pf, pa = run(cap)
         if record_pairs and npairs > cap:

@@ -91,4 +91,39 @@ size_t distill_greedy(int type, const double* freqs, const double* aux,
     return npairs;
 }
 
+// Segmented variant: runs the same greedy dedup independently on each
+// [seg_bounds[s], seg_bounds[s+1]) slice in ONE call — the per-DM /
+// per-accel-trial distillation passes are thousands of small segments,
+// and per-call ctypes marshalling dominates their host cost otherwise.
+// Pair indices are returned in GLOBAL coordinates.  Like
+// distill_greedy, the TRUE total pair count is returned even when it
+// exceeds pair_capacity (recorded pairs are truncated).
+size_t distill_greedy_segmented(int type, const double* freqs,
+                                const double* aux,
+                                const int64_t* seg_bounds, size_t nseg,
+                                double tol, int64_t max_harm,
+                                double tobs_over_c, int record_pairs,
+                                size_t pair_capacity, uint8_t* unique,
+                                int64_t* pair_fundi,
+                                int64_t* pair_absorbed) {
+    size_t npairs = 0;
+    for (size_t s = 0; s < nseg; ++s) {
+        const int64_t lo = seg_bounds[s];
+        const int64_t hi = seg_bounds[s + 1];
+        const size_t rec0 = npairs < pair_capacity ? npairs : pair_capacity;
+        const size_t rem = pair_capacity - rec0;
+        const size_t np = distill_greedy(
+            type, freqs + lo, aux + lo, static_cast<size_t>(hi - lo), tol,
+            max_harm, tobs_over_c, record_pairs, rem, unique + lo,
+            pair_fundi + rec0, pair_absorbed + rec0);
+        const size_t rec = np < rem ? np : rem;
+        for (size_t p = 0; p < rec; ++p) {
+            pair_fundi[rec0 + p] += lo;
+            pair_absorbed[rec0 + p] += lo;
+        }
+        npairs += np;
+    }
+    return npairs;
+}
+
 }  // extern "C"

@@ -13,6 +13,7 @@ from .resample import resample, resample2
 from .harmonics import harmonic_sums
 from .peaks import (
     extract_above_threshold,
+    extract_top_peaks,
     identify_unique_peaks,
     spectrum_search_bounds,
 )

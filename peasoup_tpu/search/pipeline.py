@@ -34,7 +34,7 @@ from ..ops import (
     dedisperse,
     delay_table,
     delays_in_samples,
-    extract_above_threshold,
+    extract_top_peaks,
     form_interpolated,
     form_power,
     generate_dm_list,
@@ -110,8 +110,10 @@ def _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr):
     pspec = ((pspec - mean) / std).astype(jnp.float32)
     spectra = [pspec] + harmonic_sums(pspec, nharms)
     idxs, snrs, counts = [], [], []
+    # value-ordered extraction (slots descend by SNR, not bin index) —
+    # every consumer sorts segments host-side before the peak merge
     for spec, (start, stop, _f) in zip(spectra, bounds):
-        i, s, c = extract_above_threshold(spec, min_snr, start, stop, capacity)
+        i, s, c = extract_top_peaks(spec, min_snr, start, stop, capacity)
         idxs.append(i)
         snrs.append(s)
         counts.append(c)
@@ -243,6 +245,12 @@ class PulsarSearch:
             for nh in nh_levels
         )
 
+    def _data_bytes(self) -> int:
+        """Device-resident footprint of the raw filterbank (the mesh
+        drivers keep it in HBM across runs)."""
+        itemsize = 1 if self.fil.header.nbits <= 8 else 4
+        return self.fil.nchans * self.fil.nsamps * itemsize
+
     # -- stages ------------------------------------------------------------
 
     def dedisperse(self) -> jax.Array:
@@ -360,6 +368,123 @@ class PulsarSearch:
         ]
         return self._distill_accel_groups(groups)
 
+    def _distill_dm_row(self, ii, group, acc_list):
+        """Build + distill one DM trial's candidates from its decoded
+        peak group (None -> no peaks); the per-row fallback behind
+        :meth:`_distill_rows_batch`."""
+        if group is None:
+            return []
+        efreq, esnr, eacc, elvl = group
+        dm = float(self.dm_list[ii])
+        groups = []
+        for j in range(len(acc_list)):
+            m = eacc == j
+            acc = float(acc_list[j])
+            groups.append([
+                Candidate(dm=dm, dm_idx=ii, acc=acc, nh=int(nh),
+                          snr=float(sn), freq=float(fq))
+                for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
+            ])
+        return self._distill_accel_groups(groups)
+
+    def _distill_rows_batch(self, rows) -> dict[int, list[Candidate]]:
+        """Vectorised per-DM distillation tail for many DM rows at once.
+
+        ``rows``: iterable of ``(dm_idx, group_or_None, acc_list)`` with
+        ``group = (freqs, snrs, acc_slot, level)`` arrays as produced by
+        the mesh decode.  Semantically identical to calling
+        ``_distill_dm_row`` per row (harmonic distillation within each
+        accel trial, then acceleration distillation across them,
+        `pipeline_multi.cu:238,243`), but runs ONE segmented native call
+        per distiller stage instead of ~4 ctypes calls per DM row, and
+        builds Candidate objects only for the harmonic-stage survivors
+        — the per-call marshalling otherwise dominates the host tail
+        (~0.1 s of a 59-trial tutorial run).
+        """
+        from ..native import lib as _native
+        from .distill import SPEED_OF_LIGHT
+
+        cfg = self.config
+        rows = list(rows)
+        if _native is None:
+            return {
+                ii: self._distill_dm_row(ii, grp, acc_list)
+                for ii, grp, acc_list in rows
+            }
+        out: dict[int, list[Candidate]] = {}
+        # ---- stage A: harmonic distill per (dm, accel) segment -------
+        fa, sa, nha, acca = [], [], [], []
+        bounds_a = [0]
+        row_meta = []  # (dm_idx, n_accel_trials)
+        for ii, grp, acc_list in rows:
+            if grp is None:
+                out[ii] = []
+                continue
+            efreq, esnr, eacc, elvl = grp
+            for j, acc in enumerate(acc_list):
+                m = eacc == j
+                # stable SNR-descending order, matching the
+                # std::sort-by-snr each BaseDistiller.distill applies
+                order = np.argsort(-esnr[m], kind="stable")
+                fa.append(np.asarray(efreq[m], np.float64)[order])
+                sa.append(np.asarray(esnr[m], np.float64)[order])
+                nha.append(np.asarray(elvl[m], np.int64)[order])
+                acca.append(np.full(int(m.sum()), float(acc)))
+                bounds_a.append(bounds_a[-1] + int(m.sum()))
+            row_meta.append((ii, len(acc_list)))
+        if not fa:
+            return out
+        fa = np.concatenate(fa)
+        sa = np.concatenate(sa)
+        nha = np.concatenate(nha)
+        acca = np.concatenate(acca)
+        uniq_a, _, _ = _native.distill_greedy_segmented(
+            0, fa, (2.0 ** nha).astype(np.float64), bounds_a,
+            cfg.freq_tol, cfg.max_harm, 0.0, False,
+        )
+        # ---- stage B: acceleration distill per DM segment ------------
+        fb, sb, nhb, accb = [], [], [], []
+        bounds_b = [0]
+        seg = 0
+        for ii, naccel in row_meta:
+            sel = np.concatenate([
+                np.nonzero(uniq_a[bounds_a[seg + j]:bounds_a[seg + j + 1]])[0]
+                + bounds_a[seg + j]
+                for j in range(naccel)
+            ]) if naccel else np.zeros(0, np.int64)
+            seg += naccel
+            order = np.argsort(-sa[sel], kind="stable")
+            sel = sel[order]
+            fb.append(fa[sel])
+            sb.append(sa[sel])
+            nhb.append(nha[sel])
+            accb.append(acca[sel])
+            bounds_b.append(bounds_b[-1] + len(sel))
+        fb = np.concatenate(fb)
+        sb = np.concatenate(sb)
+        nhb = np.concatenate(nhb)
+        accb = np.concatenate(accb)
+        uniq_b, pf, pa_ = _native.distill_greedy_segmented(
+            1, fb, accb, bounds_b, cfg.freq_tol, 0,
+            self.tobs / SPEED_OF_LIGHT, True,
+        )
+        # ---- materialise Candidate objects (assoc via pair list) -----
+        dmib = np.repeat([ii for ii, _na in row_meta],
+                         np.diff(bounds_b))
+        objs = [
+            Candidate(dm=float(self.dm_list[dmib[k]]),
+                      dm_idx=int(dmib[k]), acc=float(accb[k]),
+                      nh=int(nhb[k]), snr=float(sb[k]),
+                      freq=float(fb[k]))
+            for k in range(len(fb))
+        ]
+        for fi, ai in zip(pf, pa_):
+            objs[fi].append(objs[ai])
+        for (ii, _na), lo, hi in zip(row_meta, bounds_b[:-1],
+                                     bounds_b[1:]):
+            out[ii] = [objs[k] for k in range(lo, hi) if uniq_b[k]]
+        return out
+
     def _distill_accel_groups(
         self, groups: list[list[Candidate]]
     ) -> list[Candidate]:
@@ -389,9 +514,12 @@ class PulsarSearch:
                     f"peak buffer overflow: {cnt} > capacity {cap} "
                     f"(dm={dm}, acc={acc}, nh={level}); raise peak_capacity"
                 )
-            bi = idxs[level][:take]
-            bs = snrs[level][:take]
-            pidx, psnr = identify_unique_peaks(bi, bs)
+            bi = np.asarray(idxs[level][:take])
+            bs = np.asarray(snrs[level][:take])
+            # device buffers are SNR-ordered (extract_top_peaks); the
+            # merge walk needs ascending bin order
+            order = np.argsort(bi, kind="stable")
+            pidx, psnr = identify_unique_peaks(bi[order], bs[order])
             for p, s in zip(pidx, psnr):
                 cands.append(
                     Candidate(dm=dm, dm_idx=dm_idx, acc=acc, nh=level,
@@ -494,6 +622,11 @@ class PulsarSearch:
                 if fold_dms:
                     trials, dm_row_lookup = trials_provider(fold_dms)
             if trials is not None:
+                # reserve 2 GB for workspace retained by lru-cached
+                # search executables (observed RESOURCE_EXHAUSTED when
+                # unaccounted, parallel/mesh.py:935-946)
+                resident = self._data_bytes() + trials.size * 4 + (2 << 30)
+                free = int(cfg.hbm_budget_gb * 1e9) - resident
                 with trace_range("Folding"):
                     fold_candidates(
                         cands, trials, self.out_nsamps, hdr.tsamp,
@@ -501,6 +634,7 @@ class PulsarSearch:
                         boundary_5_freq=cfg.boundary_5_freq,
                         boundary_25_freq=cfg.boundary_25_freq,
                         dm_row_lookup=dm_row_lookup,
+                        hbm_free_bytes=max(free, 0),
                     )
         timers["folding"] = time.time() - t0
 
@@ -599,6 +733,7 @@ def fold_candidates(
     boundary_5_freq: float = 0.05,
     boundary_25_freq: float = 0.5,
     dm_row_lookup: dict | None = None,
+    hbm_free_bytes: int | None = None,
 ) -> None:
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
     by max(snr, folded_snr) (`folder.hpp:424-434,25-31`).
@@ -642,12 +777,18 @@ def fold_candidates(
     fold_block = resample_block_for(nsamps, fold_ms) or min(nsamps, 128)
     rtabs_np = resample1_tables(
         accs, float(tsamp), nsamps, fold_ms, block=fold_block)
-    # fold in small batches: a 10-wide vmap of 2^23-sample
-    # rewhiten+resample+fold chains ran out of HBM at production scale
-    # with the filterbank resident; batches of 4 cost two extra
-    # dispatches and shrink the peak working set 2.5x
+    # batch size from free HBM: each candidate's rewhiten+resample+fold
+    # chain keeps ~a few dozen full-length f32 buffers live (256 B/samp
+    # is the calibrated-safe coefficient: at 2^23-sample production
+    # scale with the 8.6 GB filterbank resident a 10-wide vmap OOM'd
+    # and 4-wide fit).  At tutorial scale this folds every candidate in
+    # ONE dispatch — each extra dispatch costs a ~0.11 s host
+    # round-trip on the remote-attached TPU.
     n = len(fold_ids)
-    batch = 4
+    if hbm_free_bytes is not None:
+        batch = int(max(1, min(n, hbm_free_bytes // (256 * nsamps))))
+    else:
+        batch = 4  # calibrated-safe on v5e at 2^23 with data resident
     argmaxes = np.empty(n, np.int64)
     opt_folds = np.empty((n, nints, nbins), np.float32)
     opt_profs = np.empty((n, nbins), np.float32)
