@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="candidate checkpoint for crash-resume")
     p.add_argument("--checkpoint_interval", type=int, default=8,
                    help="DM trials between checkpoint saves (host loop)")
+    p.add_argument("--dump_dir", default="",
+                   help="Dump per-DM-trial whitening stages (power "
+                        "spectrum, running median, whitened series) as "
+                        ".npy for golden-file debugging")
     p.add_argument("--profile_dir", default="",
                    help="capture a jax.profiler trace into this directory")
     p.add_argument("--single_device", action="store_true",

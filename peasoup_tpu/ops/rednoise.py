@@ -86,7 +86,11 @@ def linear_stretch(x: jnp.ndarray, out_count: int) -> jnp.ndarray:
     Matches `src/kernels.cu:983-1011`: float32 step arithmetic, and the
     interpolation term is dropped when the fractional part is <= 1e-5.
     """
-    if out_count >= _LANE_STRETCH_MIN and out_count > x.shape[0]:
+    # the lanes path's window-start product f32(rb*B) * step is exact
+    # only while rb*B < 2^24; beyond that (fft size > 2^25) fall back
+    # to the gather path, whose f32 semantics are the reference's own
+    if (_LANE_STRETCH_MIN <= out_count < 1 << 24
+            and out_count > x.shape[0]):
         return _linear_stretch_lanes(x, out_count)
     in_count = x.shape[0]
     step = jnp.float32(in_count - 1) / jnp.float32(out_count - 1)

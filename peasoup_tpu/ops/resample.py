@@ -124,6 +124,18 @@ def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
     A = afs.shape[0]
     nb = n // block
     m = residual_width(max_shift, block, n)
+    if 4 * max_shift >= n:
+        # the bisection below assumes the rounded staircase u(i) is
+        # monotone with unit steps on each side of n/2, which holds
+        # only while |af|*n < 1 (i.e. 4*max_shift < n); beyond that
+        # (extreme accel or tiny n) the tables would be silently wrong
+        # without tripping the k1/step-density checks
+        raise ValueError(
+            f"max_shift={max_shift} too large for n={n} "
+            f"(needs 4*max_shift < n): the staircase bisection is only "
+            f"valid for |af|*n < 1 — use the on-device resampler or a "
+            f"longer series"
+        )
     col = afs[:, None]
     if kernel == 2:
         d_of = lambda i: np.rint(i + i * col * (i - np.float64(n))) - i

@@ -23,9 +23,12 @@ import numpy as np
 
 from ..data.candidates import Candidate
 
-# v2: JSON payload (v1 was pickle — dropped because unpickling a
-# user-named file executes arbitrary code on a substituted checkpoint)
-_FORMAT_VERSION = 2
+# v3: append-only JSONL — header line then one line per completed DM
+# row, so each save is O(rows added) not O(all rows accumulated)
+# (v2 re-serialised the whole dict per save: O(ndm^2/interval) I/O
+# over a run; v1 was pickle — dropped because unpickling a user-named
+# file executes arbitrary code on a substituted checkpoint)
+_FORMAT_VERSION = 3
 
 
 # presentation/runtime knobs that do not change the search's results
@@ -112,7 +115,14 @@ def _cand_from_obj(obj: dict) -> Candidate:
 
 
 class SearchCheckpoint:
-    """Atomic JSON checkpoint of {dm_idx: [Candidate]} progress.
+    """Append-only JSONL checkpoint of {dm_idx: [Candidate]} progress.
+
+    Line 1 is the header ``{"version", "key"}``; every further line is
+    one completed DM row ``{"dm_idx", "cands"}``.  Saves append ONLY
+    rows not yet on disk, so ``maybe_save`` cost is independent of how
+    many rows have accumulated.  A torn final line (crash mid-append)
+    is detected on load, dropped, and truncated away so the resumed
+    run's appends continue from a clean tail.
 
     JSON, not pickle: the path is user-named, and unpickling a
     corrupted or substituted file would execute arbitrary code."""
@@ -122,6 +132,8 @@ class SearchCheckpoint:
         self.key = key
         self.interval = max(int(interval), 1)
         self._since_save = 0
+        self._written: set[int] = set()
+        self._resuming = False  # load() found a valid same-key file
 
     def load(self) -> dict[int, list[Candidate]] | None:
         """Return completed per-DM candidates, or None if absent/stale."""
@@ -129,59 +141,82 @@ class SearchCheckpoint:
             return None
         try:
             with open(self.path) as f:
-                payload = json.load(f)
-            if not isinstance(payload, dict):
-                raise ValueError("payload is not a dict")
+                lines = f.readlines()
+            header = json.loads(lines[0]) if lines else None
+            if not isinstance(header, dict):
+                raise ValueError("missing header line")
         except Exception as exc:
             warnings.warn(
                 f"ignoring unreadable checkpoint {self.path!r}: {exc}"
             )
             return None
-        if payload.get("version") != _FORMAT_VERSION:
+        if header.get("version") != _FORMAT_VERSION:
             warnings.warn(
                 f"ignoring checkpoint {self.path!r}: format version "
-                f"{payload.get('version')} != {_FORMAT_VERSION}"
+                f"{header.get('version')} != {_FORMAT_VERSION}"
             )
             return None
-        if payload.get("key") != self.key:
+        if header.get("key") != self.key:
             warnings.warn(
                 f"ignoring checkpoint {self.path!r}: it belongs to a "
                 "different search (input/config mismatch)"
             )
             return None
-        try:
-            return {
-                int(k): [_cand_from_obj(o) for o in v]
-                for k, v in payload["cands_by_dm"].items()
-            }
-        except Exception as exc:
-            warnings.warn(
-                f"ignoring corrupt checkpoint {self.path!r}: {exc}"
-            )
-            return None
+        out: dict[int, list[Candidate]] = {}
+        good_bytes = len(lines[0])
+        for ln, line in enumerate(lines[1:], start=2):
+            try:
+                if not line.endswith("\n"):
+                    # a crash between json.dump(row) and the newline
+                    # write leaves a VALID-JSON newline-less tail; the
+                    # next append would merge two rows onto one line,
+                    # so a missing terminator is torn regardless of
+                    # parseability
+                    raise ValueError("unterminated final line")
+                row = json.loads(line)
+                out[int(row["dm_idx"])] = [
+                    _cand_from_obj(o) for o in row["cands"]
+                ]
+            except Exception:
+                # torn tail from a crash mid-append: keep the rows
+                # before it and truncate the garbage so this run's
+                # appends land on a clean line boundary
+                warnings.warn(
+                    f"checkpoint {self.path!r}: dropping corrupt data "
+                    f"from line {ln} ({len(out)} completed rows kept)"
+                )
+                with open(self.path, "r+") as f:
+                    f.truncate(good_bytes)
+                break
+            good_bytes += len(line)
+        self._written = set(out)
+        self._resuming = True
+        return out
+
+    def _append_rows(self, cands_by_dm: dict) -> None:
+        new = [k for k in cands_by_dm if k not in self._written]
+        if not new and self._resuming:
+            return
+        mode = "a" if (self._resuming or self._written) else "w"
+        with open(self.path, mode) as f:
+            if mode == "w":
+                json.dump({"version": _FORMAT_VERSION, "key": self.key},
+                          f)
+                f.write("\n")
+            for k in new:
+                json.dump({"dm_idx": int(k),
+                           "cands": [_cand_to_obj(c)
+                                     for c in cands_by_dm[k]]}, f)
+                f.write("\n")
+        self._written.update(new)
+        self._resuming = True  # header now on disk
 
     def save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
-        tmp = self.path + ".tmp"
-        payload = {
-            "version": _FORMAT_VERSION,
-            "key": self.key,
-            "cands_by_dm": {
-                str(k): [_cand_to_obj(c) for c in v]
-                for k, v in cands_by_dm.items()
-            },
-        }
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, self.path)
+        self._append_rows(cands_by_dm)
 
     def maybe_save(self, cands_by_dm: dict[int, list[Candidate]]) -> None:
-        """Save every ``interval`` calls (host-loop cadence control).
-
-        Each save re-serialises the whole accumulated dict, so total
-        checkpoint I/O over a run is O(ndm^2 / interval); keep
-        ``interval`` >= the default for searches with many DM trials
-        (interval=1 is for tests/tiny runs).
-        """
+        """Append new rows every ``interval`` calls (host-loop cadence
+        control); each save's cost is O(rows added since last save)."""
         self._since_save += 1
         if self._since_save >= self.interval:
             self.save(cands_by_dm)

@@ -87,13 +87,42 @@ whiten_trial = jax.jit(
 )
 
 
+def dump_whiten_stages(dump_dir, idx, tim, birdies, widths, bin_width,
+                       b5, b25, use_zap) -> None:
+    """``--dump_dir`` debug hook (`Utils::dump_device_buffer`,
+    `include/utils/utils.hpp:62-72`): re-derive and save the whitening
+    chain's intermediates for one DM trial as .npy, enabling the
+    reference's golden-file debugging workflow
+    (`src/rednoise_test.cpp:84-102`) without ad-hoc scripts."""
+    import os
+
+    os.makedirs(dump_dir, exist_ok=True)
+    fseries = jnp.fft.rfft(tim.astype(jnp.float32)).astype(jnp.complex64)
+    pspec = form_power(fseries)
+    median = running_median(pspec, bin_width, b5, b25)
+    fseries_d = deredden(fseries, median)
+    if use_zap:
+        fseries_d = zap_birdies(fseries_d, birdies, widths, bin_width)
+    pspec_i = form_interpolated(fseries_d)
+    tim_w = jnp.fft.irfft(fseries_d, n=tim.shape[0]).astype(jnp.float32)
+    for name, arr in (
+        ("tim", tim), ("pspec", pspec), ("median", median),
+        ("interp_spec", pspec_i), ("tim_white", tim_w),
+    ):
+        np.save(os.path.join(dump_dir, f"trial{idx:04d}_{name}.npy"),
+                np.asarray(arr))
+
+
 def resample_block_for(n: int, max_shift: int) -> int | None:
     """Block size for the table-driven resampler: the largest power of
     two dividing ``n``, capped at 16384 (the measured sweet spot on
-    v5e).  None if ``n`` has no useful power-of-two factor (the legacy
-    on-device path handles that)."""
+    v5e).  None if ``n`` has no useful power-of-two factor, or the
+    shift is outside the staircase tables' validity domain
+    (4*max_shift >= n) — the legacy on-device path handles both."""
     from ..ops.resample import residual_width
 
+    if 4 * max_shift >= n:
+        return None  # table bisection invalid (see _staircase_tables_np)
     b = n & -n  # largest power-of-two divisor
     b = min(b, 16384)
     if b < 128:
@@ -288,6 +317,13 @@ class PulsarSearch:
         """
         cfg = self.config
         dm = float(self.dm_list[idx])
+        if cfg.dump_dir:
+            dump_whiten_stages(
+                cfg.dump_dir, idx, tim, jnp.asarray(self.birdies),
+                jnp.asarray(self.bwidths), self.bin_width,
+                cfg.boundary_5_freq, cfg.boundary_25_freq,
+                bool(len(self.birdies)),
+            )
         tim_w, mean, std = whiten_trial(
             tim,
             jnp.asarray(self.birdies),
@@ -774,7 +810,15 @@ def fold_candidates(
 
     fold_ms = max(
         resample2_max_shift(max(abs(a) for a in accs), tsamp, nsamps), 1)
-    fold_block = resample_block_for(nsamps, fold_ms) or min(nsamps, 128)
+    fold_block = resample_block_for(nsamps, fold_ms)
+    if fold_block is None:
+        if 4 * fold_ms >= nsamps:
+            raise ValueError(
+                f"candidate acceleration shift {fold_ms} is outside the "
+                f"fold resampler's validity domain for a {nsamps}-sample "
+                f"fold (needs 4*shift < nsamps)"
+            )
+        fold_block = min(nsamps, 128)  # power-of-two nsamps guaranteed
     rtabs_np = resample1_tables(
         accs, float(tsamp), nsamps, fold_ms, block=fold_block)
     # batch size from free HBM: each candidate's rewhiten+resample+fold

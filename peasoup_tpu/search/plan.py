@@ -66,6 +66,10 @@ class SearchConfig:
     checkpoint_file: str = ""      # per-DM candidate checkpoint (resume)
     checkpoint_interval: int = 8   # host-loop trials between checkpoint saves
     infilename: str = ""
+    # debug buffer dumps (`Utils::dump_device_buffer`,
+    # `include/utils/utils.hpp:62-72`): per-DM-trial whitening stages
+    # saved as .npy under this directory when non-empty
+    dump_dir: str = ""
 
 
 class AccelerationPlan:

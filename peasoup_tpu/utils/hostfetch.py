@@ -25,3 +25,25 @@ def fetch_to_host(arr) -> np.ndarray:
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def put_global(arr, sharding):
+    """``device_put`` that works for global shardings in multi-process
+    runs.
+
+    Multi-process ``jax.device_put`` verifies the value is identical on
+    every process with an array equality check that trips on NaN
+    padding (NaN != NaN) — and the accel grid is NaN-padded by design.
+    ``make_array_from_callback`` assembles the same global array from
+    per-shard slices without the check; all callers pass
+    process-identical host values."""
+    import jax
+
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    import numpy as np_
+
+    host = np_.asarray(arr)
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx]
+    )

@@ -1,0 +1,59 @@
+"""Subprocess worker for the 2-process multi-host test.
+
+Each process owns 2 virtual CPU devices; the 4-device GLOBAL mesh spans
+both processes, so the packed peak buffer is a global array spanning
+non-addressable devices and ``fetch_to_host`` must take its
+``process_allgather`` branch (SURVEY section 2.8's DCN path).
+
+Usage: python mh_worker.py <process_id> <coordinator_port> <tutorial.fil>
+Prints one line ``SIG:<json candidate signature>`` on success.
+"""
+
+import json
+import os
+import sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+tutorial = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+try:
+    import jax.extend
+
+    # the host sitecustomize may have initialised a TPU plugin backend;
+    # distributed init must precede (re-)backend creation
+    jax.extend.backend.clear_backends()
+except Exception:
+    pass
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from peasoup_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(coordinator_address=f"localhost:{port}",
+                     num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 4
+
+from peasoup_tpu.io import read_filterbank  # noqa: E402
+from peasoup_tpu.parallel.mesh import MeshPulsarSearch  # noqa: E402
+from peasoup_tpu.search.plan import SearchConfig  # noqa: E402
+
+fil = read_filterbank(tutorial)
+cfg = SearchConfig(
+    dm_start=0.0, dm_end=30.0, acc_start=-5.0, acc_end=5.0,
+    acc_pulse_width=64000.0, npdmp=0, limit=20,
+)
+result = MeshPulsarSearch(fil, cfg, mesh=mesh).run()
+sig = [
+    [c.freq, c.snr, c.dm, c.acc, c.count_assoc()]
+    for c in result.candidates
+]
+print("SIG:" + json.dumps(sig), flush=True)

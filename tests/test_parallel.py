@@ -124,3 +124,62 @@ def test_overflow_auto_escalation(tutorial_fil):
             assert a.freq == pytest.approx(b.freq, rel=1e-9)
             assert a.snr == pytest.approx(b.snr, rel=1e-6)
             assert a.dm == b.dm and a.acc == b.acc
+
+
+def test_two_process_distributed_search(tutorial_fil):
+    """2-process jax.distributed run on a 4-device global CPU mesh
+    (VERDICT r2 item 5): exercises ``multihost.initialize``,
+    ``multihost.global_mesh`` and ``fetch_to_host``'s
+    ``process_allgather`` branch — the only parallel code single-process
+    tests cannot reach.  Both processes must produce the identical
+    candidate set, matching the single-process reference."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(port), tutorial_fil],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, out[-3000:]
+        outs.append(out)
+    sigs = []
+    for out in outs:
+        line = next(ln for ln in out.splitlines() if ln.startswith("SIG:"))
+        sigs.append(json.loads(line[4:]))
+    # deterministic distillation: every host computes the same answer
+    assert sigs[0] == sigs[1]
+    assert len(sigs[0]) > 0
+
+    # and it is the same answer a single-process search produces
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(
+        dm_start=0.0, dm_end=30.0, acc_start=-5.0, acc_end=5.0,
+        acc_pulse_width=64000.0, npdmp=0, limit=20,
+    )
+    ref = PulsarSearch(fil, cfg).run()
+    ref_sig = [
+        [c.freq, c.snr, c.dm, c.acc, c.count_assoc()]
+        for c in ref.candidates
+    ]
+    for got, want in zip(sigs[0], ref_sig):
+        assert got[0] == pytest.approx(want[0], rel=1e-6)  # freq
+        assert got[1] == pytest.approx(want[1], rel=1e-5)  # snr
+        assert got[2:] == want[2:]                         # dm, acc, assoc
+    assert len(sigs[0]) == len(ref_sig)

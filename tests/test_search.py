@@ -229,3 +229,83 @@ class TestCustomDMList:
         fil = read_filterbank(tutorial_fil)
         with pytest.raises(ValueError):
             PulsarSearch(fil, SearchConfig(dm_list=[]))
+
+
+class TestDumpDir:
+    """--dump_dir debug buffer dumps (`Utils::dump_device_buffer`,
+    `include/utils/utils.hpp:62-72`)."""
+
+    def test_dump_whiten_stages(self, tutorial_fil, tmp_path):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.io import read_filterbank
+        from peasoup_tpu.search.pipeline import PulsarSearch, whiten_trial
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil = read_filterbank(tutorial_fil)
+        dump = tmp_path / "dumps"
+        cfg = SearchConfig(
+            dm_list=[0.0, 30.0], acc_start=0.0, acc_end=0.0, npdmp=0,
+            dump_dir=str(dump),
+        )
+        search = PulsarSearch(fil, cfg)
+        search.run()
+        for idx in (0, 1):
+            files = {
+                name: np.load(dump / f"trial{idx:04d}_{name}.npy")
+                for name in ("tim", "pspec", "median", "interp_spec",
+                             "tim_white")
+            }
+            nspec = search.size // 2 + 1
+            assert files["tim"].shape == (search.size,)
+            assert files["pspec"].shape == (nspec,)
+            assert files["median"].shape == (nspec,)
+            assert files["interp_spec"].shape == (nspec,)
+            # the dumped whitened series must match the series the
+            # search used (last-ulp differences allowed: the dump path
+            # recomputes outside the jitted program, so XLA fusion
+            # boundaries differ)
+            tim_w, _, _ = whiten_trial(
+                jnp.asarray(files["tim"]), jnp.zeros(0, np.float32),
+                jnp.zeros(0, np.float32), search.bin_width,
+                cfg.boundary_5_freq, cfg.boundary_25_freq, False,
+            )
+            np.testing.assert_allclose(
+                files["tim_white"], np.asarray(tim_w),
+                rtol=1e-4, atol=1e-6)
+
+
+class TestNumericGuards:
+    def test_staircase_rejects_extreme_shift(self):
+        from peasoup_tpu.ops.resample import _staircase_tables_np
+
+        with pytest.raises(ValueError, match="4\\*max_shift"):
+            _staircase_tables_np(np.array([1e-4]), n=1024, max_shift=300,
+                                 block=128)
+
+    def test_linear_stretch_falls_back_above_2_24(self):
+        import jax.numpy as jnp
+
+        from peasoup_tpu.ops import rednoise
+
+        calls = []
+        orig = rednoise._linear_stretch_lanes
+        rednoise._linear_stretch_lanes = (
+            lambda *a, **k: calls.append(1) or orig(*a, **k))
+        try:
+            x = np.linspace(0.0, 1.0, 4096).astype(np.float32)
+            rednoise.linear_stretch(jnp.asarray(x), 1 << 19)
+            assert calls  # lanes path used below the exactness bound
+            calls.clear()
+            # above 2^24 outputs the (exact-by-construction) gather
+            # path must be chosen; just check dispatch, not the 64 MB
+            # result
+            import unittest.mock as mock
+
+            with mock.patch.object(
+                rednoise, "_linear_stretch_lanes",
+                side_effect=AssertionError("lanes path above 2^24"),
+            ):
+                rednoise.linear_stretch(jnp.asarray(x), (1 << 24) + 640)
+        finally:
+            rednoise._linear_stretch_lanes = orig
