@@ -90,6 +90,130 @@ def dedisperse_window_slack(
     return -(-(slack + 1) // 128) * 128  # pad + round up to 128
 
 
+def _dedisperse_flat_kernel(
+    gmins_ref, delays_ref, *refs, dm_tile, time_tile, chan_group, slack,
+    part_chans, nsamps, delays_blocked, align,
+):
+    """Flat-input variant: the filterbank arrives as 1-D u8/f32 part
+    refs (whole channels each), so no 2-D entry-parameter layout exists
+    for XLA to disagree about (a 2-D u8 operand gets a column-major
+    entry layout and relayout-copies the full 8 GB input at production
+    scale — the bug that kept the original kernel off the hot path).
+
+    Per (group, channel) ONE contiguous window
+    ``[astart, astart + T + S + 128)`` is DMA'd from the channel's flat
+    offset; the 8 sublane chunks are repacked in VMEM (the windows
+    overlap, so separate sublane DMAs would re-read HBM 8x... they are
+    slices of the one window instead).
+    """
+    G = chan_group
+    nparts = len(refs) - 3 - 2 * G
+    part_refs = refs[:nparts]
+    out_ref = refs[nparts]
+    # 2*G separate 1-D (W1,) per-channel window refs — one per
+    # (parity, channel).  u8 VMEM planes tile sublanes in blocks the
+    # kernel cannot slice per channel, so every DMA destination is a
+    # WHOLE 1-D ref; the double-buffer parity is STATIC (groups are
+    # processed in pairs) because selecting among separate refs needs a
+    # python-level index
+    win_refs = refs[nparts + 1 : nparts + 1 + 2 * G]
+    winf_ref, sem_ref = refs[nparts + 1 + 2 * G :]
+    T, S, A = time_tile, slack, align
+    TQ = T // 8          # per-sublane chunk
+    RW = TQ + 128        # rotate width (power of two, checked by wrapper)
+    WQ = TQ + S + A      # per-sublane window width
+    # whole per-channel window (covers all 8 chunks); 1-D HBM memrefs
+    # carry an (align,) tiling, so DMA starts AND lengths must be
+    # align-multiples (1024 for u8, 256 for f32)
+    W1 = -(-(T + S + A) // A) * A
+    i_tile = pl.program_id(0)
+    t0 = pl.program_id(1) * T
+
+    def group_astart(g):
+        start = t0 + gmins_ref[i_tile, g]
+        return pl.multiple_of((start // A) * A, A)
+
+    def group_dmas(part_ref, slot, g, g_local):
+        astart = group_astart(g)
+        return [
+            pltpu.make_async_copy(
+                part_ref.at[pl.ds(
+                    (g_local * G + c) * nsamps + astart, W1)],
+                win_refs[slot * G + c],
+                sem_ref.at[slot, c],
+            )
+            for c in range(G)
+        ]
+
+    def process_group(slot, g, astart):
+        # sublane repack + f32 conversion, once per window (~3% of the
+        # inner-loop work): the 8 overlapping sublane chunks are static
+        # slices of the one DMA'd window (Mosaic has no u8->f32 cast;
+        # go via i32)
+        for c in range(G):
+            w = win_refs[slot * G + c][:]
+            if w.dtype == jnp.uint8:
+                w = w.astype(jnp.int32)
+            wf = w.astype(jnp.float32)
+            for s in range(8):
+                winf_ref[c, s, :] = wf[s * TQ : s * TQ + WQ]
+
+        def d_body(d, _):
+            dd = d if delays_blocked else i_tile * dm_tile + d
+
+            def chan(c, acc):
+                off = t0 + delays_ref[dd, g * G + c] - astart
+                coarse = pl.multiple_of((off // 128) * 128, 128)
+                fine = off - coarse
+                v = winf_ref[c, :, pl.ds(coarse, RW)]  # (8, RW)
+                return acc + pltpu.roll(v, -fine, 1)[:, :TQ]
+
+            acc = chan(0, jnp.zeros((8, TQ), jnp.float32))
+            for c in range(1, G):
+                acc = chan(c, acc)
+            out_ref[pl.ds(d, 1), 0] += acc[None]
+            return 0
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(dm_tile), d_body, 0)
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    # python loop over parts (a traced channel index cannot select
+    # among refs); groups inside a part run PAIRWISE so the
+    # double-buffer parity stays static — the wrapper guarantees every
+    # part's group count is even
+    g_base = 0
+    for pi, part_ref in enumerate(part_refs):
+        ngroups_p = part_chans[pi] // G
+        npairs = ngroups_p // 2
+
+        for cp in group_dmas(part_ref, 0, g_base, 0):
+            cp.start()
+
+        def pair_body(k, _, part_ref=part_ref, g_base=g_base,
+                      npairs=npairs):
+            ge, go = 2 * k, 2 * k + 1  # even/odd local group ids
+            for cp in group_dmas(part_ref, 1, g_base + go, go):
+                cp.start()
+            for cp in group_dmas(part_ref, 0, g_base + ge, ge):
+                cp.wait()
+            process_group(0, g_base + ge, group_astart(g_base + ge))
+
+            @pl.when(k + 1 < npairs)
+            def _():
+                for cp in group_dmas(part_ref, 0, g_base + go + 1,
+                                     go + 1):
+                    cp.start()
+
+            for cp in group_dmas(part_ref, 1, g_base + go, go):
+                cp.wait()
+            process_group(1, g_base + go, group_astart(g_base + go))
+            return 0
+
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(npairs), pair_body, 0)
+        g_base += ngroups_p
+
+
 def _dedisperse_kernel(
     gmins_ref, delays_ref, data_ref, out_ref, win_ref, winf_ref, sem_ref,
     *, dm_tile, time_tile, chan_group, slack, nchans, delays_blocked,
@@ -180,6 +304,173 @@ def _dedisperse_kernel(
         return 0
 
     jax.lax.fori_loop(jnp.int32(0), jnp.int32(ngroups), group_body, 0)
+
+
+def dedisperse_flat_pad_to(out_nsamps: int, max_delay: int,
+                           window_slack: int, time_tile: int,
+                           uint8: bool = True) -> int:
+    """Per-channel stride (samples, incl. padding) the flat kernel
+    needs: every window DMA must stay in bounds and tile-aligned."""
+    align = 1024 if uint8 else 256
+    T, S = time_tile, window_slack
+    out_p = -(-out_nsamps // T) * T
+    W1 = -(-(T + S + align) // align) * align
+    need = out_p - T + max_delay + W1
+    return -(-need // align) * align
+
+
+def _flat_checks(time_tile, window_slack):
+    T, S = time_tile, window_slack
+    TQ, rem = divmod(T, 8)
+    if rem or TQ % 128 or (TQ + 128) & (TQ + 127):
+        raise ValueError(
+            f"time_tile must be 8*TQ with TQ+128 a power of two (got "
+            f"{T}); e.g. 7168, 15360 or 31744"
+        )
+    if S % 128:
+        raise ValueError(
+            f"window_slack must be a multiple of 128 (got {S}); use "
+            f"dedisperse_window_slack()"
+        )
+    return TQ
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "nsamps", "out_nsamps", "window_slack", "dm_tile", "time_tile",
+        "chan_group", "interpret", "max_delay",
+    ),
+)
+def dedisperse_pallas_flat(
+    parts,
+    delays: jax.Array,
+    nsamps: int,
+    out_nsamps: int,
+    *,
+    window_slack: int,
+    max_delay: int,
+    dm_tile: int = 32,
+    time_tile: int = 15360,
+    chan_group: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Dedisperse FLAT channel-major part arrays with the tiled kernel.
+
+    The hot-path entry: ``parts`` is the :func:`split_flat_channels`
+    -style list of 1-D u8/f32 arrays (whole channels each, every part's
+    channel count a multiple of ``chan_group``), exactly as the chunked
+    driver keeps the filterbank in HBM — no 2-D operand exists, so the
+    column-major u8 entry-layout relayout that disabled the original
+    kernel cannot occur.
+
+    Requirements (all checked): ``nsamps`` (the per-channel stride,
+    INCLUDING caller padding) is a multiple of 128 so every channel
+    starts lane-aligned; each channel has
+    ``ceil(out_nsamps/T)*T + max_delay + slack + 128`` valid samples
+    (the caller pre-pads; in-program padding of flat parts would
+    relayout-copy them).
+    """
+    with enable_x64(False):
+        ndm, nchans = delays.shape
+        if not isinstance(parts, (list, tuple)):
+            parts = [parts]
+        T, S = time_tile, window_slack
+        TQ = _flat_checks(T, S)
+        dtype = parts[0].dtype
+        # 1-D HBM memrefs are tiled in 1024-byte units: u8 -> (1024,),
+        # f32 -> (256,); DMA slice starts and lengths must be multiples
+        align = 1024 if dtype == jnp.uint8 else 256
+        if nsamps % align:
+            raise ValueError(
+                f"flat-part channel stride {nsamps} must be a multiple "
+                f"of {align} (pad the tail) for tile-aligned window DMAs"
+            )
+        part_chans = []
+        for p in parts:
+            cp, rem = divmod(p.shape[0], nsamps)
+            if rem:
+                raise ValueError(
+                    f"part length {p.shape[0]} is not a multiple of the "
+                    f"channel stride {nsamps}"
+                )
+            if cp % (2 * chan_group):
+                raise ValueError(
+                    f"part channel count {cp} not a multiple of "
+                    f"2*{chan_group=} (pairwise static double "
+                    f"buffering); use split_flat_channels(..., "
+                    f"align={2 * chan_group})"
+                )
+            part_chans.append(cp)
+        if sum(part_chans) != nchans:
+            raise ValueError(
+                f"parts hold {sum(part_chans)} channels, delays expect "
+                f"{nchans}"
+            )
+        if out_nsamps < T:
+            raise ValueError(
+                f"input too short for the kernel window ({out_nsamps=} "
+                f"< {T}); use the XLA scan path"
+            )
+        delays = delays.astype(jnp.int32)
+        ndm_p = -(-ndm // dm_tile) * dm_tile
+        out_p = -(-out_nsamps // T) * T
+        nj = out_p // T
+        W1 = -(-(T + S + align) // align) * align
+        need = out_p - T + max_delay + W1
+        if nsamps < need:
+            raise ValueError(
+                f"flat parts hold {nsamps} samples per channel but the "
+                f"kernel windows need {need}; pre-pad the data "
+                f"(use dedisperse_flat_pad_to())"
+            )
+        if ndm_p != ndm:
+            delays = jnp.pad(delays, ((0, ndm_p - ndm), (0, 0)),
+                             mode="edge")
+        ntiles, ngroups = ndm_p // dm_tile, nchans // chan_group
+        gmins = (
+            delays.reshape(ntiles, dm_tile, ngroups, chan_group)
+            .min(axis=(1, 3))
+            .astype(jnp.int32)
+        )
+        WQ = TQ + S + align
+        delays_blocked = dm_tile % 8 == 0 or ntiles == 1
+        delays_spec = (
+            pl.BlockSpec(
+                (dm_tile, nchans), lambda i, j: (i, 0),
+                memory_space=pltpu.SMEM,
+            )
+            if delays_blocked
+            else pl.BlockSpec(memory_space=pltpu.SMEM)
+        )
+        out = pl.pallas_call(
+            partial(
+                _dedisperse_flat_kernel,
+                dm_tile=dm_tile, time_tile=T, chan_group=chan_group,
+                slack=S, part_chans=tuple(part_chans), nsamps=nsamps,
+                delays_blocked=delays_blocked, align=align,
+            ),
+            grid=(ntiles, nj),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # gmins
+                delays_spec,
+            ] + [pl.BlockSpec(memory_space=pl.ANY)] * len(parts),
+            out_specs=pl.BlockSpec(
+                (dm_tile, 1, 8, TQ), lambda i, j: (i, j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct((ndm_p, nj, 8, TQ),
+                                           jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((W1,), dtype)
+                for _ in range(2 * chan_group)
+            ] + [
+                pltpu.VMEM((chan_group, 8, WQ), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, chan_group)),
+            ],
+            interpret=interpret,
+        )(gmins, delays, *parts)
+        return out.reshape(ndm_p, out_p)[:ndm, :out_nsamps]
 
 
 @partial(

@@ -399,7 +399,7 @@ def build_chunked_search(
     The table args are always required; with ``block=None`` they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
     """
-    from ..ops.dedisperse_pallas import dedisperse_pallas
+    from ..ops.dedisperse_pallas import dedisperse_pallas_flat
 
     _check_f32_packable(size)
     nlevels = nharms + 1
@@ -430,9 +430,8 @@ def build_chunked_search(
                 uidx, (ci * dm_chunk, z), (dm_chunk, namax)
             )
             if dedisp_method == "pallas":
-                trials = dedisperse_pallas(
-                    jnp.concatenate(parts).reshape(nchans, -1),
-                    delays_c, out_nsamps,
+                trials = dedisperse_pallas_flat(
+                    parts, delays_c, nsamps_dev, out_nsamps,
                     window_slack=window_slack, dm_tile=dm_tile,
                     time_tile=time_tile, chan_group=chan_group,
                     max_delay=max_delay_samples,
@@ -550,10 +549,15 @@ class MeshPulsarSearch(PulsarSearch):
         shard = NamedSharding(self.mesh, P("dm", None))
         data = put_global(data, rep)
         delays_d = put_global(delays, shard)
-        fn = jax.jit(
-            partial(dedisperse, out_nsamps=self.out_nsamps),
-            out_shardings=shard,
-        )
+        # jit object cached on the object: its compile cache lives on
+        # the callable, so repeat calls (stage measurement) reuse it
+        fn = getattr(self, "_dedisp_sharded_jit", None)
+        if fn is None:
+            fn = jax.jit(
+                partial(dedisperse, out_nsamps=self.out_nsamps),
+                out_shardings=shard,
+            )
+            self._dedisp_sharded_jit = fn
         if km is not None:
             return fn(data, delays_d, killmask=put_global(km, rep))
         return fn(data, delays_d)
@@ -673,32 +677,27 @@ class MeshPulsarSearch(PulsarSearch):
         ndm_local_p = int(np.ceil(ndm_local / dm_chunk)) * dm_chunk
         namax_p = int(np.ceil(namax / accel_block)) * accel_block
 
-        # dedispersion method: the tiled Pallas kernel needs a TPU, a
-        # chan_group-divisible channel count and a full time tile
+        # dedispersion method: the FLAT-input tiled Pallas kernel
+        # (ops/dedisperse_pallas.py:_dedisperse_flat_kernel) needs a
+        # TPU, a 2*chan_group-divisible channel count (pairwise static
+        # double buffering) and a full time tile.  The XLA scan
+        # fallback's unaligned u8 slices run at ~3% of the HBM
+        # roofline — 11.2 s vs the kernel's ~0.7 s per 9-row chunk at
+        # 2^23 x 1024 chans on v5e.
         chan_group = 16
         time_tile = next(
             (t for t in (31744, 15360, 7168, 3072, 1024)
              if t <= self.out_nsamps), 0,
         )
-        dm_tile = min(32, dm_chunk)
+        # one DM tile per chunk program (ntiles == 1), so any dm_chunk
+        # satisfies the kernel's SMEM delay-blocking rule
+        dm_tile = dm_chunk
         on_tpu = jax.devices()[0].platform == "tpu"
-        # The Pallas kernel is DISABLED on the chunked path for now:
-        # its custom call pins a tiled 2-D operand layout, and XLA
-        # assigns 2-D u8 entry params the OPPOSITE (column-major)
-        # layout, materialising a full-size relayout copy of the
-        # filterbank inside the program (8 GB at production scale,
-        # straight to OOM).  Data therefore ships FLAT (unique layout,
-        # copy-free) and dedispersion uses the XLA dynamic-slice scan,
-        # whose accumulator traffic (~nchans * dm_chunk * out_nsamps *
-        # 4 B per chunk) costs ~20 s at 2^23 x 1024 chans x 500 DMs —
-        # small against the search itself.  TODO: rework the kernel to
-        # take the flat ref and DMA per-channel rows, then re-enable.
-        use_pallas = False and (
+        use_pallas = (
             on_tpu
-            and time_tile > 0
-            and self.fil.nchans % chan_group == 0
-            and dm_chunk % dm_tile == 0
-            and dm_tile % 8 == 0
+            and time_tile >= 7168  # kernel needs 8*TQ with TQ >= 896
+            and self.out_nsamps >= time_tile
+            and self.fil.nchans % (2 * chan_group) == 0
         )
         plan = dict(
             dm_chunk=dm_chunk, accel_block=accel_block,
@@ -708,7 +707,10 @@ class MeshPulsarSearch(PulsarSearch):
             window_slack=0, pad_to=self.fil.nsamps,
         )
         if use_pallas:
-            from ..ops.dedisperse_pallas import dedisperse_window_slack
+            from ..ops.dedisperse_pallas import (
+                dedisperse_flat_pad_to,
+                dedisperse_window_slack,
+            )
 
             ndm_pp = ndm_local_p * self.ndev
             # edge-pad (like the kernel wrapper): zero-padding would put
@@ -718,9 +720,11 @@ class MeshPulsarSearch(PulsarSearch):
             delays_p[:ndm] = self.delays
             delays_p[ndm:] = self.delays[-1]
             slack = dedisperse_window_slack(delays_p, dm_tile, chan_group)
-            out_p = int(np.ceil(self.out_nsamps / time_tile)) * time_tile
             plan["window_slack"] = slack
-            plan["pad_to"] = out_p + self.max_delay + slack + 128
+            plan["pad_to"] = dedisperse_flat_pad_to(
+                self.out_nsamps, self.max_delay, slack, time_tile,
+                uint8=self.fil.header.nbits <= 8,
+            )
         return plan
 
     def _device_inputs_chunked(self, plan, acc_lists):
@@ -762,7 +766,11 @@ class MeshPulsarSearch(PulsarSearch):
         self._host_chunk_arrays = (delays, accs, uidx)
         parts = tuple(
             put_global(p, rep)
-            for p in split_flat_channels(data)
+            for p in split_flat_channels(
+                data,
+                align=(2 * plan["chan_group"]
+                       if plan["dedisp_method"] == "pallas" else 1),
+            )
         )
         self._dev_chunk_static = (
             parts,
@@ -773,40 +781,53 @@ class MeshPulsarSearch(PulsarSearch):
             put_global(self.bwidths, rep),
         )
 
+    def _dedisperse_rows_device(self, delays_rows, dm_tile=1):
+        """One dedispersion-only dispatch over the resident flat parts
+        for the given delay rows (fold re-dedispersion and stage
+        measurement).
+
+        ``dm_tile=1`` is always slack-valid — a (1, chan_group)
+        block's delay spread is <= the plan's (dm_tile, chan_group)
+        bound — and is required when the rows are scattered DMs; the
+        stage measurement passes the plan's tile to reflect the real
+        chunk configuration."""
+        plan = self._chunk_plan
+        data_parts = self._dev_chunk_static[0]  # flat parts (see
+        nchans = self.fil.nchans                # _device_inputs_chunked)
+        nsamps_dev = sum(p.shape[0] for p in data_parts) // nchans
+        # one jit object per dm_tile, cached on the search object: a
+        # fresh jax.jit per call would recompile every invocation (the
+        # jit cache lives on the callable)
+        cache = self.__dict__.setdefault("_dedisp_rows_jit", {})
+        fn = cache.get(dm_tile)
+        if fn is None:
+            if plan["dedisp_method"] == "pallas":
+                from ..ops.dedisperse_pallas import dedisperse_pallas_flat
+
+                fn = jax.jit(
+                    lambda d, *fs: dedisperse_pallas_flat(
+                        list(fs), d, nsamps_dev, self.out_nsamps,
+                        window_slack=plan["window_slack"],
+                        dm_tile=dm_tile, time_tile=plan["time_tile"],
+                        chan_group=plan["chan_group"],
+                        max_delay=self.max_delay,
+                    )
+                )
+            else:
+                fn = jax.jit(
+                    lambda d, *fs: dedisperse_flat(
+                        list(fs), d, nsamps_dev, self.out_nsamps)
+                )
+            cache[dm_tile] = fn
+        return fn(jnp.asarray(delays_rows), *data_parts)
+
     def _fold_trials_provider(self, dm_idxs):
         """Re-dedisperse just the candidate DM rows for folding (the
         chunked program cannot keep (ndm, out_nsamps) trials resident;
         the reference holds them host-side, `pipeline_multi.cu:258`)."""
-        plan = self._chunk_plan
         uniq = sorted(set(int(i) for i in dm_idxs))
         row_map = {dm: r for r, dm in enumerate(uniq)}
-        data_parts = self._dev_chunk_static[0]  # flat parts (see
-        nchans = self.fil.nchans                # _device_inputs_chunked)
-        delays_sel = jnp.asarray(self.delays[uniq])
-        if plan["dedisp_method"] == "pallas":
-            from ..ops.dedisperse_pallas import dedisperse_pallas
-
-            # dm_tile=1: the selected rows are scattered DMs, so any
-            # multi-row tile would have an unbounded delay spread; a
-            # (1, chan_group) block's spread is <= the plan's
-            # (dm_tile, chan_group) bound, so the plan slack is valid
-            # and the pre-padded data needs no re-pad
-            trials = jax.jit(
-                lambda d, *fs: dedisperse_pallas(
-                    jnp.concatenate(fs).reshape(nchans, -1), d,
-                    self.out_nsamps,
-                    window_slack=plan["window_slack"],
-                    dm_tile=1, time_tile=plan["time_tile"],
-                    chan_group=plan["chan_group"],
-                    max_delay=self.max_delay,
-                )
-            )(delays_sel, *data_parts)
-        else:
-            nsamps_dev = sum(p.shape[0] for p in data_parts) // nchans
-            trials = jax.jit(
-                lambda d, *fs: dedisperse_flat(
-                    list(fs), d, nsamps_dev, self.out_nsamps)
-            )(delays_sel, *data_parts)
+        trials = self._dedisperse_rows_device(self.delays[uniq])
         return trials, row_map
 
     def _run_chunked(self, plan, acc_lists, namax, timers, t_total, ckpt,
@@ -893,8 +914,9 @@ class MeshPulsarSearch(PulsarSearch):
         all_clipped: dict[int, int] = {}  # global row -> max count
         # per-phase breakdown across all chunks (VERDICT r2 item 2:
         # the wall/device-model gap must be attributable)
-        phases = {"compile": 0.0, "dispatch": 0.0, "fetch": 0.0,
-                  "decode": 0.0, "distill": 0.0, "checkpoint": 0.0}
+        phases = {"upload": 0.0, "compile": 0.0, "dispatch": 0.0,
+                  "fetch": 0.0, "decode": 0.0, "distill": 0.0,
+                  "checkpoint": 0.0}
         self._chunk_phases = phases
 
         tc = time.time()
@@ -930,9 +952,23 @@ class MeshPulsarSearch(PulsarSearch):
         if todo:
             # the first dispatch triggers the (possibly minutes-long
             # remote) XLA compile; charge it separately from steady
-            # -state dispatch latency
+            # -state dispatch latency.  The multi-GB filterbank h2d
+            # transfer (async since _device_inputs_chunked) overlaps
+            # the compile; the residual wait is charged to "upload" so
+            # the first chunk's fetch time stays comparable to the rest
             out = dispatch(*todo[0])
             phases["compile"] = time.time() - tc
+            tc = time.time()
+            # a computed scalar over every part proves the h2d upload
+            # landed (device_put'ed arrays keep a host copy, so
+            # np.asarray of them returns instantly).  The probe queues
+            # behind chunk 1's execution, so "upload" here = residual
+            # transfer after compile + one chunk's device time; the
+            # multi-GB transfer dominates it at production scale
+            np.asarray(jax.jit(
+                lambda *ps: sum(p[-1].astype(jnp.float32) for p in ps)
+            )(*data_parts))
+            phases["upload"] = time.time() - tc
         pending = out if todo else None
         for k, (ci, rows) in enumerate(todo):
             # double-buffer: the NEXT chunk is dispatched before this
@@ -985,27 +1021,57 @@ class MeshPulsarSearch(PulsarSearch):
 
         tp = time.time()
         if all_clipped:
-            # drop the per-chunk executables before the re-search
-            # programs compile: their retained workspace plus the
-            # resident filterbank left too little HBM for the
-            # escalated-capacity host path (observed RESOURCE_EXHAUSTED
-            # at production scale); the persistent compile cache makes
-            # any later rebuild cheap
+            # drop OUR per-chunk executables before the re-search
+            # programs load: TPU executables reserve their temp arenas
+            # at load time, and the chunk programs' (accel_block
+            # full-length spectra, ~3 GB at 2^23) plus the resident
+            # filterbank left too little HBM for the escalated-capacity
+            # host path (observed RESOURCE_EXHAUSTED at production
+            # scale).  Fine-grained — unlike the previous process-wide
+            # jax.clear_caches(), every other compiled program (fold,
+            # whiten, tutorial-scale paths) survives.  (Program caches
+            # keyed on Mesh are safe across equal meshes: jax interns
+            # Mesh instances, so equal-by-content IS identical.)
+            import gc
+
             build_chunked_search.cache_clear()
-            jax.clear_caches()
+            gc.collect()
         rerun = self._rerun_clipped_rows(
             set(all_clipped), all_clipped, self._fold_trials_provider,
         )
         for ii, cands_ii in rerun.items():
             ckpt_done[ii] = cands_ii
         if all_clipped:
-            # ...and again before folding: the escalated-capacity
-            # re-search programs retain their own workspace (the fold
-            # dispatch OOM'd after the re-runs at production scale)
-            jax.clear_caches()
+            # ...and drop the escalated-capacity re-search executables
+            # before folding (their arenas OOM'd the fold dispatch at
+            # production scale) — again only the specific programs
+            import gc
+
+            from ..search.pipeline import (
+                search_accel_chunk,
+                search_accel_chunk_legacy,
+            )
+
+            search_accel_chunk.clear_cache()
+            search_accel_chunk_legacy.clear_cache()
+            gc.collect()
         phases["research"] = time.time() - tp
         phases["n_clipped_rows"] = len(all_clipped)
-        timers["dedispersion"] = 0.0  # fused into the search program
+        # dedispersion is fused into the chunk dispatches; when stage
+        # measurement is on, time one real dedisp-only dispatch and
+        # scale by the number of chunks executed
+        timers["dedispersion"] = 0.0
+        if cfg.measure_stages and todo:
+            rows0 = todo[0][1]
+            # warm (compile) untimed, then time a steady-state dispatch
+            warm = self._dedisperse_rows_device(
+                delays_h[rows0], dm_tile=plan["dm_tile"])
+            np.asarray(warm[:1, :1])
+            tp = time.time()
+            trials0 = self._dedisperse_rows_device(
+                delays_h[rows0], dm_tile=plan["dm_tile"])
+            np.asarray(trials0[:1, :1])
+            timers["dedispersion"] = (time.time() - tp) * len(todo)
         timers.update({f"chunk_{p}": round(v, 2)
                        for p, v in phases.items()})
         timers["searching_device"] = time.time() - t0
@@ -1337,6 +1403,16 @@ class MeshPulsarSearch(PulsarSearch):
             if hint < cap0 or new_ck < compact_k:
                 warm_shapes = (hint, new_ck)
         timers["dedispersion"] = 0.0  # fused into the search program
+        if cfg.measure_stages:
+            # one real timed dedisp-only dispatch (the fused program
+            # has no separable stage boundary to clock); first call
+            # warms the compile untimed
+            w_trials = self.dedisperse_sharded()
+            np.asarray(w_trials[:1, :1])
+            tm = time.time()
+            d_trials = self.dedisperse_sharded()
+            np.asarray(d_trials[:1, :1])
+            timers["dedispersion"] = time.time() - tm
         # sub-span of "searching" (which covers device + host decode)
         timers["searching_device"] = time.time() - t0
         dm_cands = CandidateCollection()

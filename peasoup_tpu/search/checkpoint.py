@@ -36,7 +36,7 @@ _FORMAT_VERSION = 3
 # can alter the mesh driver's candidate set via buffer truncation)
 _NON_IDENTITY_FIELDS = {
     "verbose", "progress_bar", "checkpoint_file", "checkpoint_interval",
-    "outdir", "accel_chunk",
+    "outdir", "accel_chunk", "dump_dir", "measure_stages",
 }
 
 
@@ -163,7 +163,9 @@ class SearchCheckpoint:
             )
             return None
         out: dict[int, list[Candidate]] = {}
-        good_bytes = len(lines[0])
+        # byte offsets, not character counts: truncate() takes bytes
+        # and the key can embed non-ASCII input paths
+        good_bytes = len(lines[0].encode("utf-8"))
         for ln, line in enumerate(lines[1:], start=2):
             try:
                 if not line.endswith("\n"):
@@ -188,7 +190,7 @@ class SearchCheckpoint:
                 with open(self.path, "r+") as f:
                     f.truncate(good_bytes)
                 break
-            good_bytes += len(line)
+            good_bytes += len(line.encode("utf-8"))
         self._written = set(out)
         self._resuming = True
         return out

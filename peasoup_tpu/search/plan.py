@@ -70,6 +70,12 @@ class SearchConfig:
     # `include/utils/utils.hpp:62-72`): per-DM-trial whitening stages
     # saved as .npy under this directory when non-empty
     dump_dir: str = ""
+    # measure the dedispersion stage with a dedicated timed dispatch so
+    # overview.xml's <execution_times> is non-degenerate (the mesh
+    # programs fuse dedispersion into the search dispatch, so the
+    # per-stage number otherwise does not exist); costs one extra
+    # dedisp execution — the CLI turns it on, benchmarks leave it off
+    measure_stages: bool = False
 
 
 class AccelerationPlan:

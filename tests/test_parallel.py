@@ -80,8 +80,14 @@ def test_chunked_search_matches_full_path(tutorial_fil):
         dm_start=0.0, dm_end=60.0, acc_start=-5.0, acc_end=5.0,
         acc_pulse_width=64000.0, nharmonics=4, npdmp=4, limit=50,
         dm_chunk=2, accel_block=2,  # force chunking + ragged padding
+        measure_stages=True,
     )
     chunked = MeshPulsarSearch(fil, cfg_chunked).run()
+    # VERDICT r2 item 8: execution_times must be non-degenerate — the
+    # chunked path reports its per-phase breakdown and a measured
+    # dedispersion stage time
+    assert chunked.timers["dedispersion"] > 0.0
+    assert chunked.timers["chunk_fetch"] > 0.0
     assert len(full.candidates) == len(chunked.candidates)
     for a, b in zip(full.candidates, chunked.candidates):
         assert a.freq == pytest.approx(b.freq, rel=1e-9)
