@@ -46,6 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--acc_start", type=float, default=0.0)
     p.add_argument("--acc_end", type=float, default=0.0)
     p.add_argument("--acc_tol", type=float, default=1.10)
+    p.add_argument("--acc_step", type=float, default=0.0,
+                   help="Fixed acceleration step (the unshipped serial "
+                        "driver's 0.5 m/s/s grid, src/pipeline.cpp:287); "
+                        "0 = tolerance-stepped DM-dependent grid")
     p.add_argument("--acc_pulse_width", type=float, default=64.0)
     p.add_argument("--boundary_5_freq", type=float, default=0.05)
     p.add_argument("--boundary_25_freq", type=float, default=0.5)

@@ -535,32 +535,39 @@ class MeshPulsarSearch(PulsarSearch):
 
     def dedisperse_sharded(self) -> jax.Array:
         """Dedisperse with the DM axis sharded across the mesh."""
-        ndm = len(self.dm_list)
-        ndm_p = self._padded_trial_count()
-        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
-        delays[:ndm] = self.delays
-        data = np.ascontiguousarray(self.fil.data.T, dtype=np.float32)
-        km = (
-            np.asarray(self.killmask, dtype=np.float32)
-            if self.killmask is not None
-            else None
-        )
-        rep = NamedSharding(self.mesh, P())
-        shard = NamedSharding(self.mesh, P("dm", None))
-        data = put_global(data, rep)
-        delays_d = put_global(delays, shard)
-        # jit object cached on the object: its compile cache lives on
-        # the callable, so repeat calls (stage measurement) reuse it
-        fn = getattr(self, "_dedisp_sharded_jit", None)
-        if fn is None:
+        # jit object AND device inputs cached on the object: repeat
+        # calls (stage measurement warms then times) must pay neither a
+        # recompile nor a fresh host transpose + multi-GB h2d upload
+        cached = getattr(self, "_dedisp_sharded_state", None)
+        if cached is None:
+            ndm = len(self.dm_list)
+            ndm_p = self._padded_trial_count()
+            delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+            delays[:ndm] = self.delays
+            data = np.ascontiguousarray(self.fil.data.T,
+                                        dtype=np.float32)
+            km = (
+                np.asarray(self.killmask, dtype=np.float32)
+                if self.killmask is not None
+                else None
+            )
+            rep = NamedSharding(self.mesh, P())
+            shard = NamedSharding(self.mesh, P("dm", None))
             fn = jax.jit(
                 partial(dedisperse, out_nsamps=self.out_nsamps),
                 out_shardings=shard,
             )
-            self._dedisp_sharded_jit = fn
-        if km is not None:
-            return fn(data, delays_d, killmask=put_global(km, rep))
-        return fn(data, delays_d)
+            cached = (
+                fn,
+                put_global(data, rep),
+                put_global(delays, shard),
+                None if km is None else put_global(km, rep),
+            )
+            self._dedisp_sharded_state = cached
+        fn, data_d, delays_d, km_d = cached
+        if km_d is not None:
+            return fn(data_d, delays_d, killmask=km_d)
+        return fn(data_d, delays_d)
 
     def _device_inputs(self, acc_lists, ndm_p: int, namax: int):
         """Build (once) and cache the device-resident static inputs.
@@ -689,15 +696,17 @@ class MeshPulsarSearch(PulsarSearch):
             (t for t in (31744, 15360, 7168, 3072, 1024)
              if t <= self.out_nsamps), 0,
         )
-        # one DM tile per chunk program (ntiles == 1), so any dm_chunk
-        # satisfies the kernel's SMEM delay-blocking rule
-        dm_tile = dm_chunk
+        # VMEM out-block is (dm_tile, 8, TQ) f32 — cap the tile at 32
+        # rows (~2 MB at TQ=1920) so a large user-set dm_chunk cannot
+        # blow VMEM; dm_chunk must tile evenly or the scan path runs
+        dm_tile = dm_chunk if dm_chunk <= 32 else 32
         on_tpu = jax.devices()[0].platform == "tpu"
         use_pallas = (
             on_tpu
             and time_tile >= 7168  # kernel needs 8*TQ with TQ >= 896
             and self.out_nsamps >= time_tile
             and self.fil.nchans % (2 * chan_group) == 0
+            and dm_chunk % dm_tile == 0
         )
         plan = dict(
             dm_chunk=dm_chunk, accel_block=accel_block,
@@ -1034,6 +1043,11 @@ class MeshPulsarSearch(PulsarSearch):
             # Mesh instances, so equal-by-content IS identical.)
             import gc
 
+            # clear_cache() on the jit object itself: the local
+            # `program` / `dispatch` closure still hold the callable,
+            # so dropping only the lru entry would leave the compiled
+            # executable (and its reserved arena) alive
+            program.clear_cache()
             build_chunked_search.cache_clear()
             gc.collect()
         rerun = self._rerun_clipped_rows(
@@ -1197,6 +1211,12 @@ class MeshPulsarSearch(PulsarSearch):
             f"peak buffers clipped on {len(rows)} DM trial(s); "
             f"re-searching those rows with escalated capacity"
         )
+        # NOTE: a one-dispatch batched re-search (an escalated-capacity
+        # chunk program over all clipped rows) was tried and REVERTED:
+        # its fresh program shape cost a ~550 s remote compile at
+        # production scale, more than the whole per-row loop below
+        # (130-240 s, dominated by 1-2 search_accel_chunk compiles
+        # shared across rows with equal escalated capacity).
         trials_sel, row_map = trials_provider(rows)
         out = {}
         for ii in rows:

@@ -243,10 +243,18 @@ class PulsarSearch:
         self.size = config.size or prev_power_of_two(fil.nsamps)
         self.tobs = self.size * hdr.tsamp
         self.bin_width = 1.0 / self.tobs
-        self.acc_plan = AccelerationPlan(
-            config.acc_start, config.acc_end, config.acc_tol,
-            config.acc_pulse_width, self.size, hdr.tsamp, hdr.cfreq, hdr.foff,
-        )
+        if config.acc_step > 0:
+            from .plan import FixedAccelerationPlan
+
+            self.acc_plan = FixedAccelerationPlan(
+                config.acc_start, config.acc_end, config.acc_step,
+            )
+        else:
+            self.acc_plan = AccelerationPlan(
+                config.acc_start, config.acc_end, config.acc_tol,
+                config.acc_pulse_width, self.size, hdr.tsamp, hdr.cfreq,
+                hdr.foff,
+            )
         from ..ops.resample import resample2_max_shift
 
         self.max_shift = resample2_max_shift(

@@ -36,6 +36,12 @@ class SearchConfig:
     acc_end: float = 0.0
     acc_tol: float = 1.10
     acc_pulse_width: float = 64.0  # us
+    # fixed-step acceleration grid (`src/pipeline.cpp:287`, the
+    # unshipped serial driver: for jj=acc_start; jj<acc_end; jj+=0.5
+    # in float32 — DM-independent, acc_end excluded, no forced zero
+    # trial).  0 keeps the tolerance-stepped DM-dependent grid of
+    # pipeline_multi.
+    acc_step: float = 0.0
     boundary_5_freq: float = 0.05
     boundary_25_freq: float = 0.5
     nharmonics: int = 4
@@ -128,3 +134,44 @@ class AccelerationPlan:
 
     def max_trials(self, dm_list: np.ndarray) -> int:
         return max(len(self.generate_accel_list(dm)) for dm in dm_list)
+
+
+class FixedAccelerationPlan:
+    """Fixed-step acceleration grid of the reference's unshipped serial
+    driver (`src/pipeline.cpp:287`): ``for (float jj=acc_start;
+    jj<acc_end; jj+=step)`` — float32 accumulation, DM-independent,
+    ``acc_end`` excluded, no forced zero trial."""
+
+    def __init__(self, acc_lo: float, acc_hi: float, step: float):
+        self.acc_lo = np.float32(acc_lo)
+        self.acc_hi = np.float32(acc_hi)
+        self.step = np.float32(step)
+        if len(self._grid()) == 0:
+            raise ValueError(
+                f"empty fixed-step accel grid (acc_start={acc_lo} >= "
+                f"acc_end={acc_hi}): the serial driver would search "
+                f"zero trials"
+            )
+
+    def _grid(self) -> np.ndarray:
+        out = []
+        jj = self.acc_lo
+        while jj < self.acc_hi:
+            out.append(jj)
+            nxt = np.float32(jj + self.step)
+            if not nxt > jj:
+                # f32 increment no longer advances (step <= 0 or below
+                # the magnitude's epsilon): the C loop would spin
+                # forever — fail instead
+                raise ValueError(
+                    f"acc_step={float(self.step)} does not advance the "
+                    f"float32 grid at {float(jj)}; use a larger step"
+                )
+            jj = nxt
+        return np.array(out, dtype=np.float32)
+
+    def generate_accel_list(self, dm: float) -> np.ndarray:
+        return self._grid()
+
+    def max_trials(self, dm_list: np.ndarray) -> int:
+        return len(self._grid())

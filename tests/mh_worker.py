@@ -16,6 +16,7 @@ import sys
 pid = int(sys.argv[1])
 port = sys.argv[2]
 tutorial = sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "fused"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -47,9 +48,14 @@ from peasoup_tpu.parallel.mesh import MeshPulsarSearch  # noqa: E402
 from peasoup_tpu.search.plan import SearchConfig  # noqa: E402
 
 fil = read_filterbank(tutorial)
+extra = {}
+if mode == "chunked":
+    # force the bounded-HBM path: per-chunk put_global uploads and a
+    # fetch_to_host allgather per chunk across both processes
+    extra = dict(dm_chunk=2, accel_block=2)
 cfg = SearchConfig(
     dm_start=0.0, dm_end=30.0, acc_start=-5.0, acc_end=5.0,
-    acc_pulse_width=64000.0, npdmp=0, limit=20,
+    acc_pulse_width=64000.0, npdmp=0, limit=20, **extra,
 )
 result = MeshPulsarSearch(fil, cfg, mesh=mesh).run()
 sig = [

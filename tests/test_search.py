@@ -309,3 +309,54 @@ class TestNumericGuards:
                 rednoise.linear_stretch(jnp.asarray(x), (1 << 24) + 640)
         finally:
             rednoise._linear_stretch_lanes = orig
+
+
+class TestFixedAccelGrid:
+    """Serial-driver fixed-step acceleration grid
+    (`src/pipeline.cpp:287`, VERDICT r2 missing item 2)."""
+
+    def test_grid_matches_c_loop_semantics(self):
+        from peasoup_tpu.search.plan import FixedAccelerationPlan
+
+        plan = FixedAccelerationPlan(-5.0, 5.0, 0.5)
+        # float32 `for (jj=start; jj<end; jj+=0.5)`: end EXCLUDED,
+        # f32 accumulation order
+        want = []
+        jj = np.float32(-5.0)
+        while jj < np.float32(5.0):
+            want.append(jj)
+            jj = np.float32(jj + np.float32(0.5))
+        got = plan.generate_accel_list(123.0)
+        np.testing.assert_array_equal(got, np.array(want, np.float32))
+        assert got[-1] < 5.0  # acc_end excluded, unlike the multi grid
+        assert len(got) == 20
+        # DM-independent
+        np.testing.assert_array_equal(got, plan.generate_accel_list(0.0))
+
+    def test_empty_grid_raises(self):
+        from peasoup_tpu.search.plan import FixedAccelerationPlan
+
+        with pytest.raises(ValueError, match="empty"):
+            FixedAccelerationPlan(5.0, -5.0, 0.5)
+
+    def test_e2e_with_fixed_grid(self, tutorial_fil):
+        from peasoup_tpu.io import read_filterbank
+        from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+        from peasoup_tpu.search.plan import SearchConfig
+
+        fil = read_filterbank(tutorial_fil)
+        cfg = SearchConfig(
+            dm_start=0.0, dm_end=30.0, acc_start=-5.0, acc_end=5.0,
+            acc_step=5.0, npdmp=0, limit=20,
+        )
+        r = MeshPulsarSearch(fil, cfg).run()
+        np.testing.assert_array_equal(r.acc_list_dm0, [-5.0, 0.0])
+        assert len(r.candidates) > 0
+
+    def test_step_below_f32_epsilon_raises(self):
+        from peasoup_tpu.search.plan import FixedAccelerationPlan
+
+        # f32 increment stops advancing partway (the C loop would
+        # spin forever) — must raise, not hang
+        with pytest.raises(ValueError, match="does not advance"):
+            FixedAccelerationPlan(0.0, 5.0, 1e-7)
