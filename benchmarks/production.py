@@ -176,7 +176,10 @@ def main(argv=None):
         dedisp_s = transfer_s = 0.0
         if plan:
             n_chunks = -(-ndm // plan["dm_chunk"])
-            dedisp_s = 0.7 * n_chunks * (nsamps / (1 << 23))
+            # the Pallas dedisp kernel is VPU-bound: ~78 ms per DM row
+            # at 2^23 x 1024 chans (0.7 s per 9-row chunk measured),
+            # i.e. proportional to rows, independent of chunking
+            dedisp_s = 0.078 * ndm * (nsamps / (1 << 23)) * (nchans / 1024)
             slots = (plan["dm_chunk"] * plan["namax_p"]
                      * (cfg.nharmonics + 1) * cfg.peak_capacity)
             transfer_s = n_chunks * (2 * slots * 4) / 35e6

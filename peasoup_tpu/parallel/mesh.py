@@ -632,14 +632,12 @@ class MeshPulsarSearch(PulsarSearch):
 
     # -- bounded-HBM chunked path (production scale) --------------------
 
-    # per-element coefficients for the planner, calibrated against
-    # XLA-reported HLO-temp usage at 2^23 samples on v5e (after fixing
-    # the linear_stretch paired-gather layout blowup that used to cost
-    # 2 GB/row): whiten keeps ~6 full-length f32 buffers live per row,
-    # the accel step ~12 per live spectrum (resample windows, fft,
-    # interbin, harmonic-sum einsum windows).
+    # per-element planner coefficient, validated against
+    # compiled-program memory_analysis at 2^23 x 1024 chans on v5e
+    # (temp = ~0.42 GB per live accel spectrum at accel_block 8->12):
+    # ~12 full-length f32 buffers per live spectrum (resample windows,
+    # fft, interbin, harmonic-sum einsum windows).
     _SPECTRUM_BYTES = 48
-    _WHITEN_BYTES = 24
 
     def _plan_chunking(self, namax: int) -> dict | None:
         """Decide full-materialisation vs chunked execution and pick
@@ -673,8 +671,13 @@ class MeshPulsarSearch(PulsarSearch):
         if cfg.dm_chunk:
             dm_chunk = cfg.dm_chunk
         else:
-            per_row = (self._WHITEN_BYTES * self.size // 4
-                       + 8 * self.out_nsamps)
+            # marginal HBM cost per DM row, validated against the
+            # compiler's memory_analysis at 2^23 x 1024 chans: 68 MB/row
+            # = two f32 trial-length buffers (the whiten workspace is
+            # per-spectrum, not per-row — one row is whitened at a time
+            # inside the scan).  Larger chunks matter: dedispersion
+            # re-reads the whole filterbank once per chunk
+            per_row = 8 * self.out_nsamps
             dm_chunk = int(max(1, min(32, (avail // 4) // per_row)))
         if cfg.accel_block:
             accel_block = cfg.accel_block
@@ -698,15 +701,16 @@ class MeshPulsarSearch(PulsarSearch):
         )
         # VMEM out-block is (dm_tile, 8, TQ) f32 — cap the tile at 32
         # rows (~2 MB at TQ=1920) so a large user-set dm_chunk cannot
-        # blow VMEM; dm_chunk must tile evenly or the scan path runs
-        dm_tile = dm_chunk if dm_chunk <= 32 else 32
+        # blow VMEM; the largest divisor <= 32 always tiles dm_chunk
+        # evenly, so no dm_chunk value forces the slow scan fallback
+        dm_tile = next(t for t in range(min(32, dm_chunk), 0, -1)
+                       if dm_chunk % t == 0)
         on_tpu = jax.devices()[0].platform == "tpu"
         use_pallas = (
             on_tpu
             and time_tile >= 7168  # kernel needs 8*TQ with TQ >= 896
             and self.out_nsamps >= time_tile
             and self.fil.nchans % (2 * chan_group) == 0
-            and dm_chunk % dm_tile == 0
         )
         plan = dict(
             dm_chunk=dm_chunk, accel_block=accel_block,
@@ -1409,7 +1413,11 @@ class MeshPulsarSearch(PulsarSearch):
         # sizes (margins — +32 counts, x1.1 valid peaks — keep
         # same-data reruns from ever clipping; different data falls
         # back to the usual re-search/escalation paths)
-        hint = 1 << int(np.ceil(np.log2(max(mx_count + 32, 64))))
+        # multiple-of-64, not power-of-two: top_k/approx_max_k accept
+        # any k and their cost scales with it, so the tightest safe
+        # capacity wins (the +32 margin keeps same-data reruns from
+        # clipping; different data re-searches as usual)
+        hint = max(64, -(-(mx_count + 32) // 64) * 64)
         hint = min(hint, cfg.peak_capacity)
         ck_hint = min(cfg.compact_capacity,
                       max(8192, -(-int(mx_valid * 1.1) // 8192) * 8192))

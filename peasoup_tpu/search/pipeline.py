@@ -243,6 +243,11 @@ class PulsarSearch:
         self.size = config.size or prev_power_of_two(fil.nsamps)
         self.tobs = self.size * hdr.tsamp
         self.bin_width = 1.0 / self.tobs
+        if config.acc_step < 0:
+            raise ValueError(
+                f"acc_step={config.acc_step} must be positive (the "
+                f"serial driver's grid steps upward from acc_start)"
+            )
         if config.acc_step > 0:
             from .plan import FixedAccelerationPlan
 

@@ -146,7 +146,9 @@ class FixedAccelerationPlan:
         self.acc_lo = np.float32(acc_lo)
         self.acc_hi = np.float32(acc_hi)
         self.step = np.float32(step)
-        if len(self._grid()) == 0:
+        # DM-independent: build once, serve every generate_accel_list
+        self._cached = self._grid()
+        if len(self._cached) == 0:
             raise ValueError(
                 f"empty fixed-step accel grid (acc_start={acc_lo} >= "
                 f"acc_end={acc_hi}): the serial driver would search "
@@ -171,7 +173,7 @@ class FixedAccelerationPlan:
         return np.array(out, dtype=np.float32)
 
     def generate_accel_list(self, dm: float) -> np.ndarray:
-        return self._grid()
+        return self._cached.copy()
 
     def max_trials(self, dm_list: np.ndarray) -> int:
-        return len(self._grid())
+        return len(self._cached)
