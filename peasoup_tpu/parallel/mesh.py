@@ -1033,46 +1033,32 @@ class MeshPulsarSearch(PulsarSearch):
                                  phases.items()) + ")", flush=True)
 
         tp = time.time()
-        if all_clipped:
-            # drop OUR per-chunk executables before the re-search
-            # programs load: TPU executables reserve their temp arenas
-            # at load time, and the chunk programs' (accel_block
-            # full-length spectra, ~3 GB at 2^23) plus the resident
-            # filterbank left too little HBM for the escalated-capacity
-            # host path (observed RESOURCE_EXHAUSTED at production
-            # scale).  Fine-grained — unlike the previous process-wide
-            # jax.clear_caches(), every other compiled program (fold,
-            # whiten, tutorial-scale paths) survives.  (Program caches
-            # keyed on Mesh are safe across equal meshes: jax interns
-            # Mesh instances, so equal-by-content IS identical.)
-            import gc
+        # drop OUR per-chunk executables before the re-search / fold
+        # phases: TPU executables reserve their temp arenas at load
+        # time, and the chunk program's (accel_block full-length
+        # spectra, ~3.5 GB at 2^23) plus the resident filterbank left
+        # too little HBM for the later phases (observed
+        # RESOURCE_EXHAUSTED at production scale).  Fine-grained —
+        # unlike the previous process-wide jax.clear_caches(), every
+        # other compiled program (fold, whiten, tutorial-scale paths)
+        # survives.  clear_cache() on the jit object itself: the local
+        # `program` / `dispatch` closure still hold the callable, so
+        # dropping only the lru entry would leave the executable (and
+        # its arena) alive.  (Program caches keyed on Mesh are safe
+        # across equal meshes: jax interns Mesh instances.)
+        import gc
 
-            # clear_cache() on the jit object itself: the local
-            # `program` / `dispatch` closure still hold the callable,
-            # so dropping only the lru entry would leave the compiled
-            # executable (and its reserved arena) alive
+        if todo:  # `program` is only bound when any chunk was searched
             program.clear_cache()
-            build_chunked_search.cache_clear()
-            gc.collect()
+        build_chunked_search.cache_clear()
+        gc.collect()
         rerun = self._rerun_clipped_rows(
             set(all_clipped), all_clipped, self._fold_trials_provider,
         )
         for ii, cands_ii in rerun.items():
             ckpt_done[ii] = cands_ii
-        if all_clipped:
-            # ...and drop the escalated-capacity re-search executables
-            # before folding (their arenas OOM'd the fold dispatch at
-            # production scale) — again only the specific programs
-            import gc
-
-            from ..search.pipeline import (
-                search_accel_chunk,
-                search_accel_chunk_legacy,
-            )
-
-            search_accel_chunk.clear_cache()
-            search_accel_chunk_legacy.clear_cache()
-            gc.collect()
+        # (the escalated-capacity re-search executables are freed by
+        # _finalise itself before folding, for every driver)
         phases["research"] = time.time() - tp
         phases["n_clipped_rows"] = len(all_clipped)
         # dedispersion is fused into the chunk dispatches; when stage

@@ -671,9 +671,19 @@ class PulsarSearch:
                 if fold_dms:
                     trials, dm_row_lookup = trials_provider(fold_dms)
             if trials is not None:
-                # reserve 2 GB for workspace retained by lru-cached
-                # search executables (observed RESOURCE_EXHAUSTED when
-                # unaccounted, parallel/mesh.py:935-946)
+                # free the search-phase executables' reserved arenas
+                # before folding — TPU executables hold their temp
+                # buffers while loaded, and the 96 B/samp fold batch
+                # coefficient is calibrated with them GONE (the mesh
+                # driver also frees its chunk program; this covers the
+                # host-loop driver's accel-chunk programs).  2 GB
+                # reserve covers everything not explicitly freed
+                # (whiten/fold programs, allocator slack).
+                import gc
+
+                search_accel_chunk.clear_cache()
+                search_accel_chunk_legacy.clear_cache()
+                gc.collect()
                 resident = self._data_bytes() + trials.size * 4 + (2 << 30)
                 free = int(cfg.hbm_budget_gb * 1e9) - resident
                 with trace_range("Folding"):
@@ -834,18 +844,18 @@ def fold_candidates(
         fold_block = min(nsamps, 128)  # power-of-two nsamps guaranteed
     rtabs_np = resample1_tables(
         accs, float(tsamp), nsamps, fold_ms, block=fold_block)
-    # batch size from free HBM: each candidate's rewhiten+resample+fold
-    # chain keeps ~a few dozen full-length f32 buffers live (256 B/samp
-    # is the calibrated-safe coefficient: at 2^23-sample production
-    # scale with the 8.6 GB filterbank resident a 10-wide vmap OOM'd
-    # and 4-wide fit).  At tutorial scale this folds every candidate in
-    # ONE dispatch — each extra dispatch costs a ~0.11 s host
-    # round-trip on the remote-attached TPU.
+    # batch size from free HBM: compiled-program memory_analysis at
+    # 2^22 fold samples measures ~72 B/samp marginal per candidate
+    # (0.30 GB each); 96 B/samp adds margin.  (The earlier 10-wide OOM
+    # at production scale was the chunk executables' retained arenas —
+    # now freed before folding — plus this chain.)  At tutorial scale
+    # this folds every candidate in ONE dispatch — each extra dispatch
+    # costs a ~0.11 s host round-trip on the remote-attached TPU.
     n = len(fold_ids)
     if hbm_free_bytes is not None:
-        batch = int(max(1, min(n, hbm_free_bytes // (256 * nsamps))))
+        batch = int(max(1, min(n, hbm_free_bytes // (96 * nsamps))))
     else:
-        batch = 4  # calibrated-safe on v5e at 2^23 with data resident
+        batch = 4  # conservative when the caller gives no HBM figure
     argmaxes = np.empty(n, np.int64)
     opt_folds = np.empty((n, nints, nbins), np.float32)
     opt_profs = np.empty((n, nbins), np.float32)
