@@ -517,3 +517,68 @@ def test_linear_stretch_lane_path_exact():
         want = np.where(frac > 1e-5, x[j] + frac * (x[jn] - x[j]), x[j])
         got = np.asarray(_linear_stretch_lanes(jnp.asarray(x), out_count))
         np.testing.assert_array_equal(got, want, err_msg=f"ratio {ratio}")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_extract_top_peaks_matches_reference_semantics(seed):
+    """Fuzz the value-ordered extractor against the index-ordered one:
+    identical true counts; identical hit SETS when count <= capacity;
+    when clipped, the kept subset is the largest-SNR one (any subset is
+    acceptable — clipped rows are re-searched — but the contract is
+    pinned here)."""
+    from peasoup_tpu.ops.peaks import extract_above_threshold, extract_top_peaks
+
+    rng = np.random.default_rng(seed)
+    n = 4096 + 17
+    spec = np.abs(rng.normal(size=n)).astype(np.float32) * 3
+    for cap, thresh, start, stop in [(64, 2.0, 5, n), (8, 4.0, 0, n - 9),
+                                     (256, 9.0, 100, 3000)]:
+        ia, sa, ca = extract_above_threshold(
+            jnp.asarray(spec), thresh, start, stop, cap)
+        iv, sv, cv = extract_top_peaks(
+            jnp.asarray(spec), thresh, start, stop, cap)
+        ia, sa, iv, sv = map(np.asarray, (ia, sa, iv, sv))
+        assert int(ca) == int(cv)
+        hits_v = iv[iv >= 0]
+        vals_v = sv[iv >= 0]
+        # value-ordered: descending SNR prefix, correctly PAIRED with
+        # its indices (catches index-reconstruction mispairing)
+        assert np.all(np.diff(vals_v) <= 0)
+        np.testing.assert_allclose(vals_v, spec[hits_v], rtol=1e-6)
+        i = np.arange(n)
+        m = (i >= start) & (i < min(stop, n)) & (spec > thresh)
+        if int(ca) <= cap:
+            np.testing.assert_array_equal(np.sort(hits_v), i[m])
+            # and the same hit SET as the index-ordered extractor
+            np.testing.assert_array_equal(
+                np.sort(hits_v), np.sort(ia[ia >= 0]))
+            np.testing.assert_allclose(
+                np.sort(vals_v), np.sort(sa[ia >= 0]), rtol=1e-6)
+        else:
+            # largest-SNR subset of size cap
+            want = np.sort(spec[m])[-cap:]
+            np.testing.assert_allclose(np.sort(vals_v), want, rtol=1e-6)
+
+
+def test_extract_top_peaks_two_stage_branch():
+    """Production-scale sizes take the two-stage row-max top_k branch
+    (engaged when stop > max(2^17, cap*512)); its row-selection /
+    index-reconstruction math must reproduce the ground truth exactly,
+    including correct (index, value) pairing."""
+    from peasoup_tpu.ops.peaks import extract_top_peaks
+
+    n = (1 << 17) + 4097
+    cap = 128  # cap*512 = 2^16 < n and n > 2^17 -> two-stage
+    spec = np.abs(rng.normal(size=n)).astype(np.float32)
+    spec[::1201] += 11.0  # ~112 sparse hits (< cap) incl. both ends
+    start, stop = 77, n - 33
+    iv, sv, cv = extract_top_peaks(jnp.asarray(spec), 9.0, start, stop, cap)
+    iv, sv = np.asarray(iv), np.asarray(sv)
+    i = np.arange(n)
+    m = (i >= start) & (i < stop) & (spec > 9.0)
+    hits_v = iv[iv >= 0]
+    assert int(cv) == int(m.sum())
+    assert int(m.sum()) <= cap
+    np.testing.assert_array_equal(np.sort(hits_v), i[m])
+    np.testing.assert_allclose(sv[iv >= 0], spec[hits_v], rtol=1e-6)
+    assert np.all(np.diff(sv[iv >= 0]) <= 0)

@@ -360,3 +360,46 @@ class TestFixedAccelGrid:
         # spin forever) — must raise, not hang
         with pytest.raises(ValueError, match="does not advance"):
             FixedAccelerationPlan(0.0, 5.0, 1e-7)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_distill_rows_batch_matches_per_row(seed, tutorial_fil):
+    """Fuzz the segmented-native batched distillation against the
+    per-row reference path: identical candidates, SNR order, and
+    recursive assoc counts."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    from peasoup_tpu.native import lib as native_lib
+
+    if native_lib is None:
+        pytest.skip("native lib unavailable: the batched path would "
+                    "fall back to the per-row reference itself")
+    rng = np.random.default_rng(seed)
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(dm_start=0.0, dm_end=30.0, acc_start=-5.0,
+                       acc_end=5.0, acc_pulse_width=64000.0, npdmp=0)
+    s = PulsarSearch(fil, cfg)
+    rows = []
+    for ii in range(len(s.dm_list)):
+        acc_list = s.acc_plan.generate_accel_list(float(s.dm_list[ii]))
+        n = rng.integers(0, 60)
+        base = rng.uniform(1.0, 30.0, 4)
+        freqs = np.concatenate([
+            b * rng.integers(1, 4, (n + 3) // 4) for b in base
+        ])[:n].astype(np.float64) * (1 + rng.normal(0, 3e-5, n))
+        grp = (freqs,
+               rng.uniform(9.5, 80.0, n).astype(np.float64),
+               rng.integers(0, len(acc_list), n),
+               rng.integers(0, 5, n))
+        rows.append((ii, grp if n else None, acc_list))
+    batched = s._distill_rows_batch(rows)
+    for ii, grp, acc_list in rows:
+        ref = s._distill_dm_row(ii, grp, acc_list)
+        got = batched[ii]
+        assert len(got) == len(ref)
+        for a, b in zip(got, ref):
+            assert a.freq == b.freq and a.snr == b.snr
+            assert a.acc == b.acc and a.nh == b.nh
+            assert a.count_assoc() == b.count_assoc()
