@@ -1,1 +1,1 @@
-from .mesh import MeshPulsarSearch, make_mesh, sharded_search_program
+from .mesh import MeshPulsarSearch, make_mesh

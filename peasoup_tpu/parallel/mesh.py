@@ -65,86 +65,6 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
-def _search_dm_row(tim, accs_row, birdies, widths, *, bin_width, tsamp,
-                   nharms, bounds, capacity, min_snr, b5, b25, use_zap,
-                   max_shift=None, rtab=None, block=None):
-    """Whiten one DM trial and search its (NaN-padded) accel batch.
-
-    Shared body of both sharded programs: returns (idxs, snrs, counts)
-    with padded accel slots fully masked out.
-
-    ``rtab = (uidx_row, d0_u, pos_u, step_u)`` selects the host-exact
-    table resampler (uidx_row maps each accel slot to its unique-accel
-    table row); None falls back to on-device index math.
-    """
-    tim_w, mean, std = whiten_core(
-        tim, birdies, widths, bin_width, b5, b25, use_zap
-    )
-    if rtab is not None:
-        uidx_row, d0_u, pos_u, step_u = rtab
-        search = lambda ui: search_one_accel(
-            tim_w, (d0_u[ui], pos_u[ui], step_u[ui]), mean, std, tsamp,
-            nharms, bounds, capacity, min_snr, max_shift, block,
-        )
-        idxs, snrs, counts = jax.vmap(search)(uidx_row)
-    else:
-        search = lambda a: search_one_accel_legacy(
-            tim_w, jnp.nan_to_num(a), mean, std, tsamp, nharms, bounds,
-            capacity, min_snr, max_shift,
-        )
-        idxs, snrs, counts = jax.vmap(search)(accs_row)
-    valid = ~jnp.isnan(accs_row)
-    idxs = jnp.where(valid[:, None, None], idxs, -1)
-    snrs = jnp.where(valid[:, None, None], snrs, 0.0)
-    counts = jnp.where(valid[:, None], counts, 0)
-    return idxs, snrs, counts
-
-
-def sharded_search_program(
-    mesh: Mesh,
-    size: int,
-    bin_width: float,
-    tsamp: float,
-    nharms: int,
-    bounds: tuple,
-    capacity: int,
-    min_snr: float,
-    b5: float,
-    b25: float,
-    use_zap: bool,
-):
-    """Build the jitted shard_map search over the ``dm`` mesh axis.
-
-    Returns a callable (trials, accs, birdies, widths) -> (idxs, snrs,
-    counts) where trials is (ndm_padded, size) sharded over dm, accs is
-    (ndm_padded, naccel_max) with NaN padding, and outputs have leading
-    dim ndm_padded (sharded over dm).
-    """
-
-    def per_dm(carry, inp):
-        tim, accs = inp
-        birdies, widths = carry
-        outs = _search_dm_row(
-            tim, accs, birdies, widths, bin_width=bin_width, tsamp=tsamp,
-            nharms=nharms, bounds=bounds, capacity=capacity,
-            min_snr=min_snr, b5=b5, b25=b25, use_zap=use_zap,
-        )
-        return carry, outs
-
-    def shard_fn(trials, accs, birdies, widths):
-        # trials: (ndm_local, size); accs: (ndm_local, naccel_max)
-        _, outs = lax.scan(per_dm, (birdies, widths), (trials, accs))
-        return outs
-
-    mapped = jax.shard_map(
-        shard_fn,
-        mesh=mesh,
-        in_specs=(P("dm", None), P("dm", None), P(None), P(None)),
-        out_specs=(P("dm", None, None), P("dm", None, None), P("dm", None)),
-    )
-    return jax.jit(mapped)
-
-
 from functools import lru_cache
 
 
