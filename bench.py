@@ -116,14 +116,14 @@ def main() -> None:
     search.prewarm_tuned = True  # warmup also compiles the auto-tuned program
     search.run()
 
-    # best of three timed runs: the tunnel to the remote-attached TPU
+    # best of five timed runs: the tunnel to the remote-attached TPU
     # adds 50-100 ms of per-fetch jitter (and occasional multi-second
     # stalls under contention), which a single capture can't separate
     # from real regressions — round 2's driver recorded 5.4 s where a
     # clean rerun gave 1.1 s.  The work is identical each run; min is
     # the standard noise-rejecting statistic.
     runs = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.time()
         result = search.run()
         runs.append((time.time() - t0, result))

@@ -671,21 +671,24 @@ class PulsarSearch:
                 if fold_dms:
                     trials, dm_row_lookup = trials_provider(fold_dms)
             if trials is not None:
-                # free the search-phase executables' reserved arenas
-                # before folding — TPU executables hold their temp
-                # buffers while loaded, and the 96 B/samp fold batch
-                # coefficient is calibrated with them GONE (the mesh
-                # driver also frees its chunk program; this covers the
-                # host-loop driver's accel-chunk programs).  2 GB
-                # reserve covers everything not explicitly freed
-                # (whiten/fold programs, allocator slack).
-                import gc
-
-                search_accel_chunk.clear_cache()
-                search_accel_chunk_legacy.clear_cache()
-                gc.collect()
+                budget = int(cfg.hbm_budget_gb * 1e9)
                 resident = self._data_bytes() + trials.size * 4 + (2 << 30)
-                free = int(cfg.hbm_budget_gb * 1e9) - resident
+                free = budget - resident
+                if free < budget // 4:
+                    # headroom is tight: free the search-phase
+                    # executables' reserved arenas before folding — TPU
+                    # executables hold their temp buffers while loaded,
+                    # and the 96 B/samp fold batch coefficient (plus
+                    # the 2 GB reserve above) is calibrated with them
+                    # GONE (the mesh driver also frees its chunk
+                    # program; this covers the host-loop driver's
+                    # accel-chunk programs).  Skipped when headroom is
+                    # plentiful: gc.collect() costs ~20-30 ms per run.
+                    import gc
+
+                    search_accel_chunk.clear_cache()
+                    search_accel_chunk_legacy.clear_cache()
+                    gc.collect()
                 with trace_range("Folding"):
                     fold_candidates(
                         cands, trials, self.out_nsamps, hdr.tsamp,
