@@ -925,6 +925,14 @@ class MeshPulsarSearch(PulsarSearch):
                 ii = int(rows[key])
                 if ii < ndm:
                     all_clipped[ii] = int(counts_l[key].max())
+            # (overlapping the escalated re-search compiles with the
+            # remaining chunks via a background warm thread was tried
+            # and REVERTED: the warm executable's arena co-resides with
+            # the chunk program's ~3.5 GB arena and the filterbank, and
+            # an allocation failure would abort the MAIN dispatches —
+            # the exact co-residency the post-loop clear exists to
+            # avoid — for a benefit within run-to-run compile-cache
+            # noise)
             # one segmented native call distills every non-clipped row
             # of the chunk (rows with no peaks get an empty group)
             tp = time.time()
