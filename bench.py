@@ -86,6 +86,9 @@ def main() -> None:
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.parallel.mesh import MeshPulsarSearch
     from peasoup_tpu.search.plan import SearchConfig
+    from peasoup_tpu.utils import enable_compile_cache
+
+    enable_compile_cache()
 
     if not os.path.exists(TUTORIAL):
         print(json.dumps({
@@ -129,9 +132,13 @@ def main() -> None:
         runs.append((time.time() - t0, result))
     runs.sort(key=lambda r: r[0])
     elapsed, result = runs[0]
+    median_s = runs[len(runs) // 2][0]
 
     timers = {k: round(v, 4) for k, v in result.timers.items()}
     timers["all_runs_s"] = [round(r[0], 4) for r in runs]
+    # median alongside best-of-5 so tunnel-latency luck is visible in
+    # the recorded artifact (VERDICT r3 weak #6)
+    timers["median_s"] = round(median_s, 4)
     fails = check_parity(result, golden)
     if fails:
         print(json.dumps({
@@ -146,6 +153,8 @@ def main() -> None:
         "value": round(elapsed, 4),
         "unit": "s",
         "vs_baseline": round(BASELINE_TOTAL_S / elapsed, 3),
+        "median_s": round(median_s, 4),
+        "vs_baseline_median": round(BASELINE_TOTAL_S / median_s, 3),
         "timers": timers,
         "parity": f"all {len(golden)} golden candidates matched",
     }))

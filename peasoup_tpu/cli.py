@@ -71,6 +71,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="candidate checkpoint for crash-resume")
     p.add_argument("--checkpoint_interval", type=int, default=8,
                    help="DM trials between checkpoint saves (host loop)")
+    p.add_argument("--tune_file", default="",
+                   help="persistent buffer-tuning sidecar: repeat runs "
+                        "of the same search size their peak buffers "
+                        "from the recorded high-waters (no clipped-row "
+                        "re-search, minimal transfers)")
+    p.add_argument("--subband", default="never", dest="subband_dedisp",
+                   choices=("auto", "always", "never"),
+                   help="two-stage sub-band dedispersion (dedisp's "
+                        "algorithm class; sub-sample smearing like "
+                        "dedisp itself): auto = use when the DM grid "
+                        "is dense enough to compress >= 2x; default "
+                        "never = exact direct sweep")
+    p.add_argument("--no_compile_cache", action="store_true",
+                   help="disable the persistent XLA compilation cache "
+                        "(default cache dir: $PEASOUP_XLA_CACHE or "
+                        "~/.cache/peasoup_tpu/xla)")
     p.add_argument("--dump_dir", default="",
                    help="Dump per-DM-trial whitening stages (power "
                         "spectrum, running median, whitened series) as "
@@ -129,6 +145,14 @@ def main(argv=None) -> int:
     # mesh programs fuse dedispersion into the search dispatch; this
     # clocks a dedicated dedisp dispatch like the reference reports)
     cfg.measure_stages = True
+    if not args.no_compile_cache:
+        from .utils import enable_compile_cache
+
+        enable_compile_cache()
+    if cfg.subband_dedisp != "never" and not args.single_device:
+        print("warning: --subband currently applies only to the "
+              "--single_device driver; the mesh drivers fuse the exact "
+              "direct sweep into their search programs", file=sys.stderr)
 
     import time as _time
 

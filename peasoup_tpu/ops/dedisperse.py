@@ -242,6 +242,162 @@ def dedisperse_flat(
     return acc
 
 
+# --------------------------------------------------------------------------
+# two-stage sub-band dedispersion (dedisp's internal algorithm class)
+# --------------------------------------------------------------------------
+
+def subband_plan(
+    dm_list: np.ndarray,
+    delays: np.ndarray,
+    table: np.ndarray,
+    nsub: int,
+    eps: float = 0.5,
+) -> dict:
+    """Plan a two-stage sub-band dedispersion over a fine DM grid.
+
+    The external ``dedisp`` library the reference links
+    (`include/transforms/dedisperser.hpp:104-112`) internally uses a
+    sub-band decomposition: channels are grouped into ``nsub``
+    sub-bands, each dedispersed over a COARSE set of anchor DMs
+    (stage 1), and every fine trial is then assembled from its
+    anchor's partial sums with one integer shift per sub-band
+    (stage 2).  Cost falls from ``ndm * nchans`` adds to
+    ``ncoarse * nchans + ndm * nsub`` — a large win exactly when the
+    fine grid is dense relative to the delay resolution (tolerance-
+    stepped survey grids; a grid whose step already moves delays by
+    many samples gains nothing and the plan says so via ``n_anchors``).
+
+    Anchors are chosen greedily along the (ascending) DM list so that
+    the residual intra-sub-band smearing ``(dm - dm_anchor) * spread``
+    stays below ``eps`` samples; with delay rounding (+-0.5) the total
+    per-channel delay error is bounded by ``eps + 1`` samples, and the
+    exact bound for this plan is returned as ``max_err``.  ``eps=0``
+    degenerates to anchors == trials (bit-identical to the direct sum
+    for integer inputs).
+
+    Returns a dict: ``bounds`` (per-sub-band channel ranges),
+    ``anchors`` (fine-trial indices used as stage-1 DMs), ``assign``
+    (per-trial anchor slot), ``shifts`` ((ndm, nsub) int32 stage-2
+    shifts), ``shift_max``, ``max_err``, ``n_anchors``.
+    """
+    dm_list = np.asarray(dm_list, np.float64)
+    ndm = len(dm_list)
+    nchans = len(table)
+    nsub = max(1, min(int(nsub), nchans))
+    csub = -(-nchans // nsub)
+    bounds = [
+        (s * csub, min((s + 1) * csub, nchans))
+        for s in range(nsub)
+        if s * csub < nchans
+    ]
+    spread = max(float(table[hi - 1] - table[lo]) for lo, hi in bounds)
+    ascending = bool(np.all(np.diff(dm_list) >= 0))
+    anchors: list[int] = []
+    assign = np.empty(ndm, np.int64)
+    for i in range(ndm):
+        if (not anchors or not ascending
+                or (dm_list[i] - dm_list[anchors[-1]]) * spread > eps):
+            anchors.append(i)
+        assign[i] = len(anchors) - 1
+    anchors_a = np.asarray(anchors, np.int64)
+    ref = np.asarray([lo for lo, _hi in bounds])
+    # stage-2 shift: trial-vs-anchor delay difference at each
+    # sub-band's reference (first) channel; >= 0 on ascending grids
+    shifts = (delays[:, ref] - delays[anchors_a][assign][:, ref]) \
+        .astype(np.int32)
+    # exact per-channel effective-delay error of THIS plan
+    sub_of_chan = np.repeat(
+        np.arange(len(bounds)), [hi - lo for lo, hi in bounds])
+    eff = delays[anchors_a][assign] + shifts[:, sub_of_chan]
+    err = int(np.abs(eff - delays).max()) if ndm else 0
+    return dict(
+        bounds=bounds, anchors=anchors_a, assign=assign, shifts=shifts,
+        shift_max=int(shifts.max(initial=0)), max_err=err,
+        n_anchors=len(anchors),
+    )
+
+
+def dedisperse_subband(
+    data: jax.Array,
+    delays: jax.Array,
+    plan: dict,
+    out_nsamps: int,
+) -> jax.Array:
+    """Two-stage sub-band dedispersion (see :func:`subband_plan`).
+
+    Numerics: each output sample is a sum of the same ``nchans`` input
+    samples as the direct sweep, except any channel whose effective
+    delay differs (bounded by ``plan['max_err']`` samples — 0 when
+    ``eps=0``).  Input is edge-padded by ``shift_max + 1`` samples so
+    stage-1 windows never clamp (a clamped ``dynamic_slice`` would
+    silently misalign whole rows).
+    """
+    ndm = delays.shape[0]
+    bounds = plan["bounds"]
+    anchors = np.asarray(plan["anchors"])
+    assign = np.asarray(plan["assign"])
+    shifts = np.asarray(plan["shifts"])
+    L1 = out_nsamps + int(plan["shift_max"])
+    pad_n = int(plan["shift_max"]) + 1
+    data = jnp.pad(data, ((0, 0), (0, pad_n)), mode="edge")
+    anchor_delays = np.asarray(delays)[anchors]
+
+    # stage 1: per sub-band, dedisperse the anchor rows over its
+    # channels only (the usual channel scan, L1-long windows)
+    partials = []
+    for s, (lo, hi) in enumerate(bounds):
+        partials.append(
+            dedisperse(data[lo:hi], jnp.asarray(anchor_delays[:, lo:hi]),
+                       L1)
+        )
+
+    # stage 2: every fine trial sums one shifted window per sub-band
+    # from its anchor's partials — n_anchors*nchans + ndm*nsub adds
+    # total.  Unrolled slices for small ndm (vmap dynamic_slice lowers
+    # to a slow batched gather, see dedisperse_flat), batched above.
+    acc = jnp.zeros((ndm, out_nsamps), jnp.float32)
+    for s in range(len(bounds)):
+        flat = partials[s].reshape(-1)
+        offs = assign * L1 + shifts[:, s].astype(np.int64)
+        if ndm <= 64:
+            rows = [
+                lax.dynamic_slice(flat, (int(offs[i]),), (out_nsamps,))
+                for i in range(ndm)
+            ]
+            acc = acc + jnp.stack(rows)
+        else:
+            acc = acc + jax.vmap(
+                lambda o: lax.dynamic_slice(flat, (o,), (out_nsamps,))
+            )(jnp.asarray(offs, jnp.int32))
+    return acc
+
+
+def dedisperse_subband_numpy(
+    data: np.ndarray,
+    delays: np.ndarray,
+    plan: dict,
+    out_nsamps: int,
+) -> np.ndarray:
+    """NumPy model of :func:`dedisperse_subband` (for tests)."""
+    ndm = delays.shape[0]
+    pad_n = int(plan["shift_max"]) + 1
+    data = np.pad(data.astype(np.float32), ((0, 0), (0, pad_n)),
+                  mode="edge")
+    L1 = out_nsamps + int(plan["shift_max"])
+    anchors = plan["anchors"]
+    out = np.zeros((ndm, out_nsamps), np.float32)
+    for s, (lo, hi) in enumerate(plan["bounds"]):
+        part = np.zeros((len(anchors), L1), np.float32)
+        for c in range(lo, hi):
+            for j, a in enumerate(anchors):
+                d = delays[a, c]
+                part[j] += data[c, d : d + L1]
+        for i in range(ndm):
+            o = plan["shifts"][i, s]
+            out[i] += part[plan["assign"][i], o : o + out_nsamps]
+    return out
+
+
 def dedisperse_numpy(
     data: np.ndarray,
     delays: np.ndarray,

@@ -68,18 +68,6 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
 from functools import lru_cache
 
 
-def _check_f32_packable(size: int) -> None:
-    """The packed peak buffer ships bin indices and per-spectrum counts
-    as plain f32 (see `_compact_peaks`), which is exact only below
-    2^24.  Both are bounded by the spectrum length size//2 + 1."""
-    if size // 2 + 1 > 1 << 24:
-        raise ValueError(
-            f"fft size {size} gives spectra longer than 2^24 bins; bin "
-            f"indices would not be exactly representable in the f32 "
-            f"peak packing — split the observation or reduce --fft_size"
-        )
-
-
 def _compact_peaks(idxs, snrs, counts, compact_k):
     """Shared device-side tail of both fused programs: compact all
     (dm, accel, level) peak buffers of a shard into one packed f32
@@ -112,16 +100,22 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
         .at[dest].set(flat_snr.astype(jnp.float32), mode="drop")
     )
     nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
+    counts_f = counts.reshape(-1)
     # pack everything into ONE f32 buffer so the host pays a single
-    # device->host round trip.  Ints travel as PLAIN f32 values — all
-    # exactly representable: bins < 2^24, per-spectrum counts <=
-    # stop_idx < 2^24; nvalid (which can exceed 2^24) ships as two
-    # 16-bit halves.  (bitcast_convert_type int32->f32 MISCOMPILES
-    # inside this program on v5e: shape-dependent zeroed outputs.)
+    # device->host round trip.  Every int travels as TWO 16-bit halves
+    # in plain f32 (exactly representable), so bin indices, counts and
+    # nvalid are exact at ANY spectrum length that fits int32 — the
+    # reference has no size ceiling either (`src/pipeline_multi.cu:
+    # 326-331`).  Floor-div semantics keep the -1 invalid sentinel
+    # exact: -1 -> (hi -1, lo 65535) -> -65536 + 65535 = -1.
+    # (bitcast_convert_type int32->f32 MISCOMPILES inside this program
+    # on v5e: shape-dependent zeroed outputs — hence halves, not bits.)
     return jnp.concatenate([
-        sel_bin.astype(jnp.float32),
+        (sel_bin // 65536).astype(jnp.float32),
+        (sel_bin % 65536).astype(jnp.float32),
         sel_snr,
-        counts.reshape(-1).astype(jnp.float32),
+        (counts_f // 65536).astype(jnp.float32),
+        (counts_f % 65536).astype(jnp.float32),
         (nvalid // 65536).astype(jnp.float32),
         (nvalid % 65536).astype(jnp.float32),
     ])
@@ -163,14 +157,16 @@ def build_fused_search(
     neither (its host loop talks to a local PCIe GPU per DM trial,
     `src/pipeline_multi.cu:145-244`), so the TPU-native design moves the
     whole search into one dispatch and ships home ONE packed f32 buffer
-    per shard (ints bitcast), laid out as:
+    per shard, laid out as (k = compact_k, ns = ndm_local*naccel*
+    nlevels; every int travels as two 16-bit halves in plain f32, so
+    transport is exact at any int32 spectrum length):
 
-    * ``[0:compact_k]``  spectrum bin indices (int32 bitcast)
-    * ``[compact_k:2k]`` SNR values (f32)
-    * ``[2k:2k+nspec]``  per-spectrum above-threshold counts
-      (ndm_local*naccel*nlevels int32 bitcast; overflow check + the
-      key to reconstructing each entry's (dm, accel, level) tag)
-    * ``[-1]``           true total valid count (int32 bitcast)
+    * ``[0:k]`` / ``[k:2k]``      bin index hi / lo halves
+    * ``[2k:3k]``                 SNR values (f32)
+    * ``[3k:3k+ns]`` / ``+2ns``   per-spectrum above-threshold count
+      hi / lo halves (overflow check + the key to reconstructing each
+      entry's (dm, accel, level) tag)
+    * ``[-2]`` / ``[-1]``         true total valid count hi / lo
 
     plus ``trials`` (ndm_local, out_nsamps) f32 — full-width, staying
     device-resident for the folding phase; never copied to host.
@@ -183,7 +179,6 @@ def build_fused_search(
     """
     from ..ops.unpack import unpack_bits_device
 
-    _check_f32_packable(size)
     nlevels = nharms + 1
     use_tables = block is not None
 
@@ -321,7 +316,6 @@ def build_chunked_search(
     """
     from ..ops.dedisperse_pallas import dedisperse_pallas_flat
 
-    _check_f32_packable(size)
     nlevels = nharms + 1
     n_chunks = ndm_local // dm_chunk
     n_ablocks = namax // accel_block
@@ -453,41 +447,73 @@ class MeshPulsarSearch(PulsarSearch):
         ndm = len(self.dm_list)
         return int(np.ceil(ndm / self.ndev)) * self.ndev
 
+    def _tune_scoped_key(self, driver: str) -> str:
+        """Tune-sidecar key including mesh geometry: the recorded
+        high-waters are per-SHARD quantities (and fused/chunked count
+        them differently), so a record from another device count or
+        driver must not alias this one."""
+        return f"{driver}:ndev={self.ndev}:" + self._tune_key()
+
     def dedisperse_sharded(self) -> jax.Array:
-        """Dedisperse with the DM axis sharded across the mesh."""
-        # jit object AND device inputs cached on the object: repeat
-        # calls (stage measurement warms then times) must pay neither a
-        # recompile nor a fresh host transpose + multi-GB h2d upload
+        """Dedisperse with the DM axis sharded across the mesh.
+
+        Consumes the PACKED filterbank bytes and unpacks on device —
+        exactly like the fused search program — so the only permanent
+        HBM residents are the (1x) packed bytes, shared with
+        ``_device_inputs`` when that cache exists.  (A previous version
+        permanently cached a replicated f32 host transpose: 4x the u8
+        footprint, invisible to ``_plan_chunking``'s budget, and the
+        reason near-boundary fused searches could RESOURCE_EXHAUST
+        once stage measurement warmed it.)
+        """
         cached = getattr(self, "_dedisp_sharded_state", None)
         if cached is None:
-            ndm = len(self.dm_list)
-            ndm_p = self._padded_trial_count()
-            delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
-            delays[:ndm] = self.delays
-            data = np.ascontiguousarray(self.fil.data.T,
-                                        dtype=np.float32)
-            km = (
-                np.asarray(self.killmask, dtype=np.float32)
-                if self.killmask is not None
-                else None
-            )
+            from ..ops.unpack import unpack_bits_device
+
             rep = NamedSharding(self.mesh, P())
             shard = NamedSharding(self.mesh, P("dm", None))
-            fn = jax.jit(
-                partial(dedisperse, out_nsamps=self.out_nsamps),
-                out_shardings=shard,
-            )
-            cached = (
-                fn,
-                put_global(data, rep),
-                put_global(delays, shard),
-                None if km is None else put_global(km, rep),
-            )
+            if getattr(self, "_dev_inputs", None) is not None:
+                # the fused program's resident inputs already hold the
+                # packed bytes, padded delay table and killmask
+                raw_d, delays_d, km_d = self._dev_inputs[:3]
+            else:
+                ndm = len(self.dm_list)
+                ndm_p = self._padded_trial_count()
+                delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+                delays[:ndm] = self.delays
+                nbits = self.fil.header.nbits
+                if nbits == 32:
+                    raw = np.ascontiguousarray(
+                        self.fil.data, np.float32).ravel()
+                else:
+                    raw = pack_bits(self.fil.data.ravel(), nbits)
+                km = (
+                    np.asarray(self.killmask, dtype=np.float32)
+                    if self.killmask is not None
+                    else np.ones(self.fil.nchans, np.float32)
+                )
+                raw_d = put_global(raw, rep)
+                delays_d = put_global(delays, shard)
+                km_d = put_global(km, rep)
+            nbits = self.fil.header.nbits
+            nchans, nsamps = self.fil.nchans, self.fil.nsamps
+            use_km = self.killmask is not None
+
+            def dedisp_from_raw(raw, delays, km):
+                # the f32 channel-major view is a transient inside this
+                # program (the fused search program materialises the
+                # same transient, so this fits whenever it does)
+                vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
+                data = vals.reshape(nsamps, nchans).T.astype(jnp.float32)
+                if use_km:
+                    data = data * km[:, None]
+                return dedisperse(data, delays, self.out_nsamps)
+
+            fn = jax.jit(dedisp_from_raw, out_shardings=shard)
+            cached = (fn, raw_d, delays_d, km_d)
             self._dedisp_sharded_state = cached
-        fn, data_d, delays_d, km_d = cached
-        if km_d is not None:
-            return fn(data_d, delays_d, killmask=km_d)
-        return fn(data_d, delays_d)
+        fn, raw_d, delays_d, km_d = cached
+        return fn(raw_d, delays_d, km_d)
 
     def _device_inputs(self, acc_lists, ndm_p: int, namax: int):
         """Build (once) and cache the device-resident static inputs.
@@ -573,6 +599,9 @@ class MeshPulsarSearch(PulsarSearch):
             self._SPECTRUM_BYTES * ndm_local * namax * self.size
             + 8 * ndm_local * self.out_nsamps
             + self._data_bytes()
+            # the fused program's device unpack materialises a full f32
+            # channel-major transient alongside the packed input
+            + 4 * self.fil.nchans * self.fil.nsamps
         )
         if est_full <= budget and not cfg.dm_chunk and not cfg.accel_block:
             return None
@@ -796,9 +825,35 @@ class MeshPulsarSearch(PulsarSearch):
         dm_chunk = plan["dm_chunk"]
         namax_p = plan["namax_p"]
         nlevels = cfg.nharmonics + 1
-        cap = cfg.peak_capacity
+        # persistent buffer tuning: a prior run of the SAME search
+        # recorded its true high-water counts, so this run can size the
+        # per-spectrum capacity to never clip (no re-search phase) and
+        # the compacted transfer buffer to the observed total (+margin)
+        # instead of the worst case.  Results are identical either way;
+        # see search/tuning.py.
+        from ..search.tuning import load_tuning, round_up, save_tuning
+
+        tune = (load_tuning(cfg.tune_file, self._tune_scoped_key("chunked"))
+                if cfg.tune_file else None)
+        if tune is not None:
+            # bound the capacity so the stacked per-chunk peak buffers
+            # (dm_chunk x namax x nlevels x cap, idx+snr) stay <= 1 GB
+            cap_ceil = max(64, (1 << 30) // (dm_chunk * namax_p
+                                             * nlevels * 8))
+            cap = round_up(tune["cap_hw"] + 32, 64, 64, cap_ceil)
+        else:
+            cap = cfg.peak_capacity
         # per-SHARD slot count: compact_k and nvalid are per-shard
         chunk_slots = dm_chunk * namax_p * nlevels * cap
+        if tune is not None:
+            # margin absorbs same-data jitter; a genuinely different
+            # input mismatches the tune key and never reaches here
+            compact_k = round_up(int(tune["ck_hw"] * 1.2) + 1024, 8192,
+                                 8192, chunk_slots)
+        else:
+            compact_k = chunk_slots
+        # observability: the benchmark's transfer model reads these
+        self._chunk_buffer_shapes = (cap, compact_k)
         self._chunk_plan = plan
         from ..utils import trace_range
 
@@ -853,13 +908,16 @@ class MeshPulsarSearch(PulsarSearch):
         self._chunk_phases = phases
 
         tc = time.time()
-        # per-chunk, the FULL slot count is a small buffer (~7 MB at
-        # dm_chunk=8 x 21 accels x 5 levels x 1024): sizing the
-        # compacted buffer to it makes truncation impossible, so no
-        # escalation/recompile path exists here (per-spectrum capacity
-        # overflow is handled by the row re-runs below)
-        program = build(cap, chunk_slots)
+        # untuned, the compacted buffer is the FULL slot count (~7 MB
+        # at dm_chunk=8 x 21 accels x 5 levels x 1024): truncation is
+        # impossible, so no escalation/recompile path exists here
+        # (per-spectrum capacity overflow is handled by the row
+        # re-runs below).  Tuned, compact_k < slots and a truncated
+        # row (possible only if the data changed under the tune key)
+        # joins the clipped set for the same re-run path.
+        program = build(cap, compact_k)
         todo = []
+        n_live = 0  # chunks holding any real (non-padding) DM row
         for ci in range(n_chunks):
             # per-device row block ci: rows d*ndm_local_p + [c0, c0+dm_chunk)
             c0 = ci * dm_chunk
@@ -868,6 +926,7 @@ class MeshPulsarSearch(PulsarSearch):
                           d * ndm_local_p + c0 + dm_chunk)
                 for d in range(self.ndev)
             ])
+            n_live += any(int(r) < ndm for r in rows)
             if all(int(r) in ckpt_done or int(r) >= ndm for r in rows):
                 continue  # checkpoint resume: chunk already searched
             todo.append((ci, rows))
@@ -903,6 +962,8 @@ class MeshPulsarSearch(PulsarSearch):
             )(*data_parts))
             phases["upload"] = time.time() - tc
         pending = out if todo else None
+        hw_count = 0  # observed high-waters for the tune sidecar
+        hw_valid = 0
         for k, (ci, rows) in enumerate(todo):
             # double-buffer: the NEXT chunk is dispatched before this
             # chunk's results are fetched/decoded, so host decode,
@@ -916,10 +977,17 @@ class MeshPulsarSearch(PulsarSearch):
             phases["fetch"] += time.time() - tp
             pending = nxt if k + 1 < len(todo) else None
             tp = time.time()
-            (groups_l, _mx_count, _mx_valid, counts_l,
+            (groups_l, mx_count, mx_valid, counts_l,
              clipped_l, _truncated_l) = self._decode_packed(
-                packed, dm_chunk, namax_p, nlevels, cap, chunk_slots
+                packed, dm_chunk, namax_p, nlevels, cap, compact_k
             )
+            hw_count = max(hw_count, mx_count)
+            # per-shard TRUE totals (uncapped counts), not nvalid: when
+            # this run clipped, nvalid under-measures what an unclipped
+            # re-run will ship
+            hw_valid = max(hw_valid, int(
+                counts_l.reshape(self.ndev, -1).sum(axis=1).max()
+            ))
             phases["decode"] += time.time() - tp
             for key in clipped_l:
                 ii = int(rows[key])
@@ -989,6 +1057,12 @@ class MeshPulsarSearch(PulsarSearch):
         # _finalise itself before folding, for every driver)
         phases["research"] = time.time() - tp
         phases["n_clipped_rows"] = len(all_clipped)
+        if cfg.tune_file and len(todo) == n_live:
+            # record high-waters only when EVERY live chunk was
+            # observed this run (a checkpoint resume sees a subset and
+            # would understate them)
+            save_tuning(cfg.tune_file, self._tune_scoped_key("chunked"),
+                        hw_count, hw_valid)
         # dedispersion is fused into the chunk dispatches; when stage
         # measurement is on, time one real dedisp-only dispatch and
         # scale by the number of chunks executed
@@ -1033,27 +1107,30 @@ class MeshPulsarSearch(PulsarSearch):
         at 100000, `peakfinder.hpp:17,61`)."""
         ndev = self.ndev
         nspec_local = ndm_local * namax * nlevels
-        # layout: sel_bin | sel_snr | counts | nvalid_hi | nvalid_lo —
-        # int values travel as plain (exactly-representable) f32, see
+        # layout: bin_hi | bin_lo | sel_snr | counts_hi | counts_lo |
+        # nvalid_hi | nvalid_lo — every int travels as two 16-bit
+        # halves in plain f32 (exact at any int32 spectrum length), see
         # _compact_peaks
-        blk_len = 2 * compact_k + nspec_local + 2
-        sel_bin = np.empty(ndev * compact_k, np.int32)
+        blk_len = 3 * compact_k + 2 * nspec_local + 2
+        sel_bin = np.empty(ndev * compact_k, np.int64)
         sel_snr = np.empty(ndev * compact_k, np.float32)
-        counts = np.empty((ndev * ndm_local, namax, nlevels), np.int32)
+        counts = np.empty((ndev * ndm_local, namax, nlevels), np.int64)
         nvalid = np.empty(ndev, np.int64)
         for sidx in range(ndev):
             blk = packed[sidx * blk_len : (sidx + 1) * blk_len]
             sel_bin[sidx * compact_k : (sidx + 1) * compact_k] = (
-                blk[:compact_k].astype(np.int32)
+                blk[:compact_k].astype(np.int64) * 65536
+                + blk[compact_k : 2 * compact_k].astype(np.int64)
             )
             sel_snr[sidx * compact_k : (sidx + 1) * compact_k] = (
-                blk[compact_k : 2 * compact_k]
+                blk[2 * compact_k : 3 * compact_k]
             )
+            c0 = 3 * compact_k
             counts[sidx * ndm_local : (sidx + 1) * ndm_local] = (
-                blk[2 * compact_k : 2 * compact_k + nspec_local]
-                .astype(np.int32)
-                .reshape(ndm_local, namax, nlevels)
-            )
+                blk[c0 : c0 + nspec_local].astype(np.int64) * 65536
+                + blk[c0 + nspec_local : c0 + 2 * nspec_local]
+                .astype(np.int64)
+            ).reshape(ndm_local, namax, nlevels)
             nvalid[sidx] = int(blk[-2]) * 65536 + int(blk[-1])
 
         # reconstruct each entry's (dm_local, accel, level) tag from
@@ -1247,6 +1324,16 @@ class MeshPulsarSearch(PulsarSearch):
         # per-spectrum top_k (its cost scales with k on v5e); overflow
         # stays impossible — clipped rows are re-searched with escalated
         # capacity like any other overflow
+        from ..search.tuning import load_tuning, round_up, save_tuning
+
+        if cfg.tune_file and getattr(self, "_cap_hint", None) is None:
+            # cross-RUN seeding of the same hints (search/tuning.py)
+            tune = load_tuning(cfg.tune_file, self._tune_scoped_key("fused"))
+            if tune is not None:
+                self._cap_hint = round_up(tune["cap_hw"] + 32, 64, 64,
+                                          cfg.peak_capacity)
+                self._ck_hint = round_up(int(tune["ck_hw"] * 1.1), 8192,
+                                         8192, cfg.compact_capacity)
         cap = min(cfg.peak_capacity,
                   getattr(self, "_cap_hint", cfg.peak_capacity))
         # clamp to the shard's total slot count (small configs); a
@@ -1329,12 +1416,10 @@ class MeshPulsarSearch(PulsarSearch):
         # back to the usual re-search/escalation paths)
         # multiple-of-64, not power-of-two: top_k/approx_max_k accept
         # any k and their cost scales with it, so the tightest safe
-        # capacity wins (the +32 margin keeps same-data reruns from
-        # clipping; different data re-searches as usual)
-        hint = max(64, -(-(mx_count + 32) // 64) * 64)
-        hint = min(hint, cfg.peak_capacity)
-        ck_hint = min(cfg.compact_capacity,
-                      max(8192, -(-int(mx_valid * 1.1) // 8192) * 8192))
+        # capacity wins (same arithmetic as the tune-file seeding above)
+        hint = round_up(mx_count + 32, 64, 64, cfg.peak_capacity)
+        ck_hint = round_up(int(mx_valid * 1.1), 8192, 8192,
+                           cfg.compact_capacity)
         retune = (hint != getattr(self, "_cap_hint", None)
                   or ck_hint < getattr(self, "_ck_hint", 1 << 62))
         warm_shapes = None
@@ -1344,6 +1429,12 @@ class MeshPulsarSearch(PulsarSearch):
             new_ck = min(ck_hint, ndm_local * namax * nlevels * hint)
             if hint < cap0 or new_ck < compact_k:
                 warm_shapes = (hint, new_ck)
+        if cfg.tune_file:
+            # true per-shard totals (see _run_chunked's hw_valid note)
+            save_tuning(
+                cfg.tune_file, self._tune_scoped_key("fused"), mx_count,
+                int(counts_arr.reshape(self.ndev, -1).sum(axis=1).max()),
+            )
         timers["dedispersion"] = 0.0  # fused into the search program
         if cfg.measure_stages:
             # one real timed dedisp-only dispatch (the fused program

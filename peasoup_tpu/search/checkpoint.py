@@ -36,7 +36,7 @@ _FORMAT_VERSION = 3
 # can alter the mesh driver's candidate set via buffer truncation)
 _NON_IDENTITY_FIELDS = {
     "verbose", "progress_bar", "checkpoint_file", "checkpoint_interval",
-    "outdir", "accel_chunk", "dump_dir", "measure_stages",
+    "outdir", "accel_chunk", "dump_dir", "measure_stages", "tune_file",
 }
 
 
@@ -142,6 +142,12 @@ class SearchCheckpoint:
         try:
             with open(self.path) as f:
                 lines = f.readlines()
+            # same torn-tail rule as row lines: a crash that flushed
+            # the header JSON without its newline would merge row 1
+            # onto the header on the next append, so a newline-less
+            # header means "no usable checkpoint" (overwritable)
+            if lines and not lines[0].endswith("\n"):
+                raise ValueError("unterminated header line")
             header = json.loads(lines[0]) if lines else None
             if not isinstance(header, dict):
                 raise ValueError("missing header line")

@@ -82,6 +82,22 @@ class SearchConfig:
     # per-stage number otherwise does not exist); costs one extra
     # dedisp execution — the CLI turns it on, benchmarks leave it off
     measure_stages: bool = False
+    # persistent buffer auto-tuning (search/tuning.py): a successful
+    # run records its peak-count high-waters here so the next run of
+    # the SAME search sizes its device buffers right the first time
+    # (no clipped-row re-search, minimal compacted transfer)
+    tune_file: str = ""
+    # two-stage sub-band dedispersion (ops.dedisperse.subband_plan —
+    # the algorithm class of the external dedisp library the reference
+    # links, `dedisperser.hpp:104-112`): "auto" uses it when the DM
+    # grid is dense enough that total adds compress >= 2x (sub-sample
+    # smearing bounded by eps+1 samples, exactly like dedisp itself);
+    # "always" forces it.  Default "never": the direct sweep is EXACT,
+    # an accuracy improvement over the reference's dedisp (same class
+    # of documented deviation as keeping f32 trials instead of u8),
+    # and results stay identical across drivers.  Opt in for dense
+    # tolerance-stepped grids, where the tree wins several-fold.
+    subband_dedisp: str = "never"
 
 
 class AccelerationPlan:

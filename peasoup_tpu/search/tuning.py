@@ -1,0 +1,68 @@
+"""Persistent per-search buffer auto-tuning (no reference equivalent).
+
+The reference sizes its peak buffer once at 100000 entries
+(`include/transforms/peakfinder.hpp:17,61`) and silently truncates
+beyond it.  This build instead uses small fixed-capacity buffers inside
+the jitted programs and re-searches any DM row whose true count
+exceeded them — no silent loss, but the re-run costs real time (per-row
+dispatches plus fresh XLA compiles at the escalated capacity).
+
+This module closes the loop across *runs*: a successful search records
+its observed high-water marks (max per-spectrum above-threshold count,
+max per-shard valid-peak total) in a tiny JSON sidecar keyed by the
+same search identity the checkpoint uses.  The next run of the same
+search sizes its buffers from the record, so
+
+* no row clips -> the re-search phase disappears entirely, and
+* the compacted transfer buffer shrinks from worst-case to observed
+  size (+margin) -> less data over the (slow) device->host link.
+
+A key mismatch (different input/config) ignores the record; results
+are identical either way — buffer sizes only affect *when* work
+happens, never which candidates are produced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+_TUNE_VERSION = 1
+
+
+def load_tuning(path: str, key: str) -> dict | None:
+    """Return {"cap_hw": int, "ck_hw": int} or None if absent/stale."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except Exception as exc:
+        warnings.warn(f"ignoring unreadable tune file {path!r}: {exc}")
+        return None
+    if obj.get("version") != _TUNE_VERSION or obj.get("key") != key:
+        return None
+    try:
+        return {"cap_hw": int(obj["cap_hw"]), "ck_hw": int(obj["ck_hw"])}
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int) -> None:
+    """Atomically record the observed high-water marks."""
+    if not path:
+        return
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"version": _TUNE_VERSION, "key": key,
+                       "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        warnings.warn(f"could not write tune file {path!r}: {exc}")
+
+
+def round_up(value: int, quantum: int, lo: int, hi: int) -> int:
+    """Round ``value`` up to a multiple of ``quantum``, clamped."""
+    return int(min(hi, max(lo, -(-value // quantum) * quantum)))

@@ -7,8 +7,9 @@ itself lives in each driver's ``timers`` dict)."""
 from .progress import ProgressBar
 from .tracing import trace_range, start_trace, stop_trace
 from .hostfetch import fetch_to_host
+from .compilecache import enable_compile_cache
 
 __all__ = [
     "ProgressBar", "trace_range", "start_trace", "stop_trace",
-    "fetch_to_host",
+    "fetch_to_host", "enable_compile_cache",
 ]

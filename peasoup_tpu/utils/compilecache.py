@@ -1,0 +1,52 @@
+"""Opt-in persistent XLA compilation cache.
+
+Remote-attached TPU compiles are expensive (the production chunk
+program costs ~44 s, an escalated re-search shape ~1-2 min), and the
+reference pays nothing comparable (nvcc compiles ahead of time).  JAX's
+persistent compilation cache serialises compiled executables to disk
+keyed by HLO hash, so every program shape is compiled at most once
+*ever* per machine — across processes and runs.
+
+Enabled by the CLI and the benchmarks (not on import: library users
+may manage their own cache policy).  Harmless if the backend cannot
+serialise executables — jax falls back to compiling as usual.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Point jax at a persistent on-disk compilation cache.
+
+    ``cache_dir`` defaults to ``$PEASOUP_XLA_CACHE`` or
+    ``~/.cache/peasoup_tpu/xla``.  Returns the directory used, or None
+    if the cache could not be enabled.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get("PEASOUP_XLA_CACHE") or os.path.join(
+            os.path.expanduser("~"), ".cache", "peasoup_tpu", "xla"
+        )
+    try:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            # CPU AOT cache entries are machine-feature-pinned (XLA
+            # warns about SIGILL on mismatch) and CPU compiles are
+            # fast anyway — only accelerator executables are worth
+            # persisting
+            return None
+        os.makedirs(cache_dir, exist_ok=True)
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything that took a measurable compile: the default
+        # 1 GB / 1 s floors would skip the many small-but-remote
+        # programs whose round-trip latency is the actual cost
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        return cache_dir
+    except Exception as exc:  # unwritable dir, unknown config, ...
+        warnings.warn(f"persistent compile cache disabled: {exc}")
+        return None
