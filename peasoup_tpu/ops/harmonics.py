@@ -49,6 +49,8 @@ from functools import lru_cache
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _SCALES = [
     0.7071067811865476,  # 1/sqrt(2)
@@ -119,12 +121,30 @@ def harmonic_sums(spectrum: jnp.ndarray, nharms: int) -> list[jnp.ndarray]:
 
     ``spectrum`` is the (normalised, interbinned) power spectrum; output
     level k sums 2^k harmonics and is scaled by 1/sqrt(2^k).
+
+    Three size/backend regimes, all bit-exact vs the numpy reference:
+    gathers below 2^19 bins, the fused Pallas kernel on TPU (nharms <=
+    4; see :func:`_harmonic_sums_pallas`), the einsum path otherwise.
     """
     if not 1 <= nharms <= 5:
         raise ValueError("nharms must be in 1..5")
     size = spectrum.shape[0]
     if size <= _GATHER_MAX_SIZE:
         return _harmonic_sums_gather(spectrum, nharms)
+    if nharms <= 4 and _on_tpu():
+        return list(_pallas_hsum_fn(nharms)(spectrum))
+    return _harmonic_sums_einsum(spectrum, nharms)
+
+
+@lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def _harmonic_sums_einsum(spectrum: jnp.ndarray,
+                          nharms: int) -> list[jnp.ndarray]:
+    """Lane-aligned einsum path (any backend; see module docstring)."""
+    size = spectrum.shape[0]
     P_max = 1 << nharms
     nrows = -(-size // (_L * P_max)) * P_max
     # row windows reach at most nrows*m/2^k + m + 1 < nrows + P_max + 1
@@ -159,3 +179,247 @@ def _harmonic_sums_gather(spectrum: jnp.ndarray,
             val = val + spectrum[jnp.clip(idx, 0, size - 1)]
         out.append((val * jnp.float32(_SCALES[k - 1])).astype(jnp.float32))
     return out
+
+
+# --------------------------------------------------------------------------
+# fused Pallas kernel (TPU hot path)
+# --------------------------------------------------------------------------
+#
+# One kernel computes ALL levels: per output row-tile [R0, R0+TR) it
+# DMAs each stretch's source window (rows [m*R0/P, m*(R0+TR)/P + m+2),
+# ~7.5*TR rows total across the 15 stretches of nharms=4) into VMEM and
+# applies the lane-aligned decomposition entirely on-chip:
+#
+#   out[t*P + rho, l] = W[t*m + q_rho, o_rho + c_l]
+#     P = 2^k, S = 128*m/P, q_rho = rho*S // 128, o_rho = rho*S % 128
+#
+# * the strided row slice W[q::m] becomes a free sublane reshape
+#   (TR/P, m, 256) + static middle index;
+# * the per-rho lane permutation becomes pltpu.roll by -o_rho + ONE
+#   shared (128,128) 0/1 selection matrix per stretch on the MXU
+#   (c_l <= 127*m/2^k < 128, so post-roll lanes fit one register row);
+# * exact f32 via a manual 3-limb bf16 decomposition: a = hi+mid+lo
+#   with every partial sum representable, so the three f32-accumulated
+#   selection dots reconstruct the f32 value bit-for-bit (tested).
+#
+# vs the einsum path this cuts HBM traffic ~4x (no materialised Wb
+# stacks) and MXU work 2x (128- not 256-contraction): measured on v5e
+# at 10^7 bins: 1.62 ms vs 3.9 ms (2.4x), bit-exact.  The ~1 ms floor
+# is the 2x window DMA (see the v2 note below).  nharms=5 falls back
+# to the einsum path: level 5 alone is 512 unrolled dots per tile.
+_TR = 1024  # output rows per grid step (TR=2048 overflows 16M VMEM)
+
+
+def _hsum_stretch_meta(nharms: int):
+    metas = []
+    for k in range(1, nharms + 1):
+        P = 1 << k
+        for m in range(1, 1 << k, 2):
+            S = (_L * m) >> k
+            q = tuple((rho * S) // _L for rho in range(P))
+            o = tuple((rho * S) % _L for rho in range(P))
+            metas.append((k, m, P, q, o))
+    return metas
+
+
+@lru_cache(maxsize=None)
+def _hsum_sel_matrices(nharms: int) -> np.ndarray:
+    """(n_stretch, 128, 128) bf16 selection: M[s][c, l] = (c == c_l)."""
+    half_cl = []
+    for k in range(1, nharms + 1):
+        half = 1 << (k - 1)
+        for m in range(1, 1 << k, 2):
+            half_cl.append((np.arange(_L) * m + half) >> k)
+    M = np.zeros((len(half_cl), _L, _L), np.float32)
+    for s, c_l in enumerate(half_cl):
+        M[s, c_l, np.arange(_L)] = 1.0
+    return M.astype(jnp.bfloat16)
+
+
+def _limbs3(x: jnp.ndarray):
+    """Exact 3-term bf16 decomposition of f32 (hi+mid+lo == x)."""
+    hi = x.astype(jnp.bfloat16)
+    r1 = x - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, mid, lo
+
+
+def _make_hsum_kernel(nharms: int, TR: int, n_tiles: int, pad_rows: int):
+    metas = _hsum_stretch_meta(nharms)
+    wins = [(m * (TR // P) + m + 2, (TR // P) * m)
+            for (_k, m, P, _q, _o) in metas]
+
+    def kernel(x_any, m_ref, *rest):
+        out_refs, (v_ref, v2_ref, sem, sem2, sem_i) = rest[:-5], rest[-5:]
+        # the batch is FLATTENED into the row axis (grid (B*n_tiles,),
+        # 2-D blocks): a (B, rows, 128) layout with (1, TR, 128) blocks
+        # measured ~1.2 ms slower at 10^7 bins on v5e
+        idx = pl.program_id(0)
+        b = idx // n_tiles
+        i = idx % n_tiles
+        base = b * pad_rows
+        n_str = len(wins)
+
+        def dmas(si, slot):
+            WIN, mult = wins[si]
+            start = base + i * mult
+            # ONE HBM window of WIN+1 rows; the one-row-shifted copy the
+            # lane-pair concat needs is derived VMEM->VMEM (shift_copy):
+            # the concat needs both operands at sublane offset 0 (Mosaic
+            # rejects concat of an offset-1 view, and a same-buffer roll
+            # carries the offset in its layout too), and a second HBM
+            # window would double the kernel's HBM read traffic
+            return pltpu.make_async_copy(
+                x_any.at[pl.ds(start, WIN + 1)],
+                v_ref.at[slot, pl.ds(0, WIN + 1)], sem.at[slot])
+
+        def shift_copy(si, slot):
+            WIN, _ = wins[si]
+            return pltpu.make_async_copy(
+                v_ref.at[slot, pl.ds(1, WIN)],
+                v2_ref.at[slot, pl.ds(0, WIN)], sem2.at[slot])
+
+        # init tile (the accumulator starts as the spectrum itself) and
+        # the first stretch window are in flight together; subsequent
+        # stretch windows are double-buffered two slots deep
+        dma_i = pltpu.make_async_copy(
+            x_any.at[pl.ds(base + i * TR, TR)], v_ref.at[2, pl.ds(0, TR)],
+            sem_i)
+        dma_i.start()
+        dmas(0, 0).start()
+        dma_i.wait()
+        acc = v_ref[2, pl.ds(0, TR)]
+        si = 0
+        for k in range(1, nharms + 1):
+            P = 1 << k
+            T = TR // P
+            for m in range(1, 1 << k, 2):
+                _, _, _, qs, os_ = metas[si]
+                WIN, _ = wins[si]
+                slot = si % 2
+                if si + 1 < n_str:
+                    # next stretch's HBM window overlaps this compute
+                    dmas(si + 1, (si + 1) % 2).start()
+                dmas(si, slot).wait()
+                # the derived shifted copy is the only exposed wait
+                # (VMEM->VMEM, ~0.5 MB)
+                sc = shift_copy(si, slot)
+                sc.start()
+                sc.wait()
+                Vp = jnp.concatenate(
+                    [v_ref[slot, pl.ds(0, WIN)],
+                     v2_ref[slot, pl.ds(0, WIN)]], axis=1)
+                Vpr = Vp[: m * T].reshape(T, m, 2 * _L)
+                Msel = m_ref[si]
+                # per-rho small dots, post-dot interleave: measured
+                # FASTER (2.6 vs 3.5 ms at 10^7, same session) than one
+                # big pre-interleaved (TR,128) dot per limb — the
+                # (T,P,128) stack relayout costs more than 3*P extra
+                # dot issues save
+                adds = []
+                for rho in range(P):
+                    A = Vpr[:, qs[rho], :]  # (T, 256) f32
+                    A = pltpu.roll(A, (2 * _L - os_[rho]) % (2 * _L),
+                                   axis=1)[:, :_L]
+                    parts = [
+                        jax.lax.dot_general(
+                            limb, Msel, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+                        for limb in _limbs3(A)
+                    ]
+                    adds.append(parts[0] + parts[1] + parts[2])
+                acc = acc + jnp.stack(adds, axis=1).reshape(TR, _L)
+                si += 1
+            out_refs[k - 1][:] = acc * jnp.float32(_SCALES[k - 1])
+
+    return kernel
+
+
+def _hsum_pallas_batched(specs: jnp.ndarray, nharms: int,
+                         interpret: bool = False) -> tuple[jnp.ndarray, ...]:
+    """(B, size) f32 -> nharms arrays (B, size) f32, bit-exact."""
+    from jax._src.config import enable_x64
+
+    # trace under x64=False: the package-global jax_enable_x64 would
+    # make the DMA slice indices i64, which tpu.memref_slice rejects
+    # (same guard as ops/dedisperse_pallas.py)
+    with enable_x64(False):
+        return _hsum_pallas_batched_x32(specs, nharms, interpret)
+
+
+def _hsum_pallas_batched_x32(specs, nharms, interpret):
+    B, size = specs.shape
+    TR = _TR
+    nrows = -(-size // (_L * TR)) * TR
+    n_tiles = nrows // TR
+    # windows reach at most (15/16)*nrows + m + 3 rows.  ZERO padding:
+    # every stretch read for an output bin < size stays < size (the
+    # index map (i*m + half) >> k has slope m/2^k < 1), so pad values
+    # only feed output rows that are sliced off below — and jnp.pad
+    # mode="edge" costs 0.6 ms at 10^7 under jax_enable_x64 (gather
+    # lowering) vs 0.014 ms for constant
+    pad_rows = nrows + 40
+    sp = jnp.pad(specs, ((0, 0), (0, pad_rows * _L - size)))
+    X = sp.reshape(B * pad_rows, _L)
+    M = jnp.asarray(_hsum_sel_matrices(nharms))
+    kernel = _make_hsum_kernel(nharms, TR, n_tiles, pad_rows)
+    WIN_MAX = max(max(m * (TR // (1 << k)) + m + 3
+                      for k in range(1, nharms + 1)
+                      for m in range(1, 1 << k, 2)), TR)
+    outs = pl.pallas_call(
+        kernel,
+        grid=(B * n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=tuple(
+            pl.BlockSpec((TR, _L), lambda idx: (idx, 0))
+            for _ in range(nharms)),
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((B * nrows, _L), jnp.float32)
+            for _ in range(nharms)),
+        scratch_shapes=[
+            pltpu.VMEM((3, WIN_MAX + 1, _L), jnp.float32),
+            pltpu.VMEM((2, WIN_MAX + 1, _L), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(X, M)
+    return tuple(o.reshape(B, -1)[:, :size] for o in outs)
+
+
+@lru_cache(maxsize=None)
+def _pallas_hsum_fn(nharms: int, interpret: bool = False):
+    """custom_vmap wrappers: the hot paths vmap ``harmonic_sums`` over
+    accel-trial batches; the rules map any vmap nesting depth onto the
+    kernel's batch grid axis instead of failing pallas_call's default
+    batching (which would shift the kernel's program_id axes)."""
+    from jax.custom_batching import custom_vmap
+
+    @custom_vmap
+    def f_b(specs):  # (B, size) -> tuple of (B, size)
+        return _hsum_pallas_batched(specs, nharms, interpret)
+
+    @f_b.def_vmap
+    def _rule_b(axis_size, in_batched, specs):  # noqa: ANN001
+        del axis_size, in_batched
+        lead = specs.shape[:-1]
+        outs = f_b(specs.reshape(-1, specs.shape[-1]))
+        return (tuple(o.reshape(*lead, -1) for o in outs),
+                tuple(True for _ in outs))
+
+    @custom_vmap
+    def f(spec):
+        return tuple(o[0] for o in f_b(spec[None]))
+
+    @f.def_vmap
+    def _rule(axis_size, in_batched, spec):  # noqa: ANN001
+        del axis_size, in_batched
+        outs = f_b(spec)
+        return outs, tuple(True for _ in outs)
+
+    return f
