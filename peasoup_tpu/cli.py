@@ -83,6 +83,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "dedisp itself): auto = use when the DM grid "
                         "is dense enough to compress >= 2x; default "
                         "never = exact direct sweep")
+    p.add_argument("--subband_eps", default=0.5, type=float,
+                   help="sub-band stage-2 residual smearing bound in "
+                        "samples (0 = bit-identical to the direct "
+                        "sweep; larger = more anchor compression)")
     p.add_argument("--no_compile_cache", action="store_true",
                    help="disable the persistent XLA compilation cache "
                         "(default cache dir: $PEASOUP_XLA_CACHE or "
@@ -149,11 +153,6 @@ def main(argv=None) -> int:
         from .utils import enable_compile_cache
 
         enable_compile_cache()
-    if cfg.subband_dedisp != "never" and not args.single_device:
-        print("warning: --subband currently applies only to the "
-              "--single_device driver; the mesh drivers fuse the exact "
-              "direct sweep into their search programs", file=sys.stderr)
-
     import time as _time
 
     t_total = _time.time()

@@ -174,6 +174,7 @@ def dedisperse_flat(
     delays: jax.Array,
     nsamps: int,
     out_nsamps: int,
+    chan_range: tuple[int, int] | None = None,
 ) -> jax.Array:
     """`dedisperse` over FLAT channel-major array parts.
 
@@ -191,10 +192,15 @@ def dedisperse_flat(
     int32 arithmetic would wrap, silently dedispersing garbage.
     Killmask handling is the caller's (the chunked driver pre-applies
     it host-side, matching `dedisperser.hpp:64-95`).
+
+    ``chan_range``: optional static (lo, hi) — sum only channels
+    [lo, hi) of the parts (sub-band stage-1 partials; ``delays`` stays
+    full-width and is indexed by GLOBAL channel).
     """
     if not isinstance(parts, (list, tuple)):
         parts = [parts]
     ndm, nchans = delays.shape
+    lo, hi = chan_range if chan_range is not None else (0, nchans)
 
     # static python loop over DM rows, NOT vmap: a vmap of
     # dynamic_slice lowers to a batched gather with arbitrary start
@@ -231,13 +237,17 @@ def dedisperse_flat(
     c_base = 0
     for flat_part in parts:
         nloc = flat_part.shape[0] // nsamps
-        # unroll=8: XLA fuses the unrolled bodies' adds, touching the
-        # (ndm, out_nsamps) f32 accumulator once per 8 channels instead
-        # of every channel (2.4x at 1024 chans x 2^21 on v5e)
-        acc, _ = lax.scan(
-            chan_step(flat_part, jnp.int32(c_base)), acc,
-            jnp.arange(nloc, dtype=jnp.int32),
-            unroll=8 if loop_rows else 1)
+        # this part's overlap with the requested channel range, in
+        # part-local channel indices
+        l_lo, l_hi = max(lo - c_base, 0), min(hi - c_base, nloc)
+        if l_lo < l_hi:
+            # unroll=8: XLA fuses the unrolled bodies' adds, touching
+            # the (ndm, out_nsamps) f32 accumulator once per 8 channels
+            # instead of every channel (2.4x at 1024 chans x 2^21)
+            acc, _ = lax.scan(
+                chan_step(flat_part, jnp.int32(c_base)), acc,
+                jnp.arange(l_lo, l_hi, dtype=jnp.int32),
+                unroll=8 if loop_rows else 1)
         c_base += nloc
     return acc
 
@@ -369,6 +379,254 @@ def dedisperse_subband(
             acc = acc + jax.vmap(
                 lambda o: lax.dynamic_slice(flat, (o,), (out_nsamps,))
             )(jnp.asarray(offs, jnp.int32))
+    return acc
+
+
+def subband_chunk_plan(
+    dm_list: np.ndarray,
+    delays: np.ndarray,
+    table: np.ndarray,
+    chunks,
+    chan_align: int = 32,
+    eps: float = 0.5,
+    step_frac: float = 0.25,
+) -> dict | None:
+    """Per-chunk sub-band plan for the chunked mesh driver.
+
+    The chunked driver dispatches ``dm_chunk`` adjacent fine rows per
+    (chunk, shard) cell; anchors are chosen greedily WITHIN each cell
+    (sharing never crosses a dispatch, so no partials are recomputed
+    or carried between dispatches).  All cells are padded to one
+    ``n_anchor_p`` so every dispatch compiles to the same program.
+
+    Args:
+        dm_list: (ndm_padded,) fine DM values (padded rows repeat the
+            last real value).
+        delays: (ndm_padded, nchans) int sample delays.
+        chunks: iterable of row-index arrays, one per (chunk, shard)
+            cell.
+        chan_align: channel alignment of sub-band bounds — csub is
+            ``~sqrt(nchans)`` rounded up to a multiple (the Pallas
+            kernel's pairwise chan-group DMA needs 2*chan_group-aligned
+            ranges).
+        eps: stage-2 residual smearing floor in samples (see
+            :func:`subband_plan`).  ``eps=0`` selects the exact mode:
+            anchors compress only across identical-DM rows.
+        step_frac: with ``eps > 0``, the per-row threshold is
+            ``max(eps, step_frac * local_dm_step * full_band_spread)``
+            — the residual sub-band smearing stays below
+            ``step_frac`` of the smearing the DM grid's own step
+            already accepts (a trial midway between adjacent grid DMs
+            smears by half the step's full-band delay), which is how
+            the reference's dedisp budgets its internal sub-band error
+            against the grid tolerance.  This makes the
+            trials-per-anchor compression roughly uniform
+            (~``step_frac * nsub``) across the dense and sparse grid
+            regions instead of collapsing to 1 at high DM.
+
+    Returns None when infeasible (non-ascending DM list, or nchans not
+    ``chan_align``-aligned); else a dict with static config (bounds,
+    L1 shift_max, n_anchor_p, nsub, max_err, cost ratio) and per-cell
+    arrays (anchor_rows, assign, shifts).
+    """
+    dm_list = np.asarray(dm_list, np.float64)
+    delays = np.asarray(delays)
+    nchans = delays.shape[1]
+    if nchans % chan_align or np.any(np.diff(dm_list) < 0):
+        return None
+    # csub ~ sqrt(nchans), constrained to a chan_align multiple that
+    # DIVIDES nchans (the one-launch stage-1 kernel needs uniform
+    # sub-bands); chan_align itself always qualifies here
+    target = np.sqrt(nchans)
+    csub = min(
+        (c for c in range(chan_align, nchans + 1, chan_align)
+         if nchans % c == 0),
+        key=lambda c: abs(c - target),
+    )
+    bounds = tuple(
+        (lo, lo + csub) for lo in range(0, nchans, csub)
+    )
+    nsub = len(bounds)
+    spread = max(float(table[hi - 1] - table[lo]) for lo, hi in bounds)
+    spread_full = float(np.max(table) - np.min(table))
+    ref = np.asarray([lo for lo, _hi in bounds])
+    cells = []
+    n_anchor_p = 1
+    shift_max = 0
+    max_err = 0
+    total_anchors = 0
+    total_rows = 0
+    for rows in chunks:
+        rows = np.asarray(rows)
+        anchors: list[int] = []
+        assign = np.empty(len(rows), np.int64)
+        for j, r in enumerate(rows):
+            thr = eps
+            if eps > 0 and j > 0:
+                step = dm_list[r] - dm_list[rows[j - 1]]
+                thr = max(eps, step_frac * step * spread_full)
+            if (not anchors
+                    or (dm_list[r] - dm_list[anchors[-1]]) * spread > thr):
+                anchors.append(int(r))
+            assign[j] = len(anchors) - 1
+        anchors_a = np.asarray(anchors, np.int64)
+        shifts = (delays[rows][:, ref]
+                  - delays[anchors_a][assign][:, ref]).astype(np.int32)
+        if shifts.min(initial=0) < 0:
+            return None  # defensive: rounding made a shift negative
+        sub_of_chan = np.repeat(
+            np.arange(nsub), [hi - lo for lo, hi in bounds])
+        eff = delays[anchors_a][assign] + shifts[:, sub_of_chan]
+        max_err = max(max_err,
+                      int(np.abs(eff - delays[rows]).max(initial=0)))
+        shift_max = max(shift_max, int(shifts.max(initial=0)))
+        n_anchor_p = max(n_anchor_p, len(anchors))
+        total_anchors += len(anchors)
+        total_rows += len(rows)
+        cells.append((anchors_a, assign.astype(np.int32), shifts))
+    # pad every cell's anchor set to n_anchor_p (repeat last: wasted
+    # stage-1 rows, never wrong)
+    per_cell = []
+    for anchors_a, assign, shifts in cells:
+        pad = np.pad(anchors_a, (0, n_anchor_p - len(anchors_a)),
+                     mode="edge").astype(np.int32)
+        per_cell.append((pad, assign, shifts))
+    # stage-1 channel sweeps + stage-2 window adds vs the direct sweep
+    cost_ratio = (
+        (total_anchors * nchans + total_rows * nsub)
+        / max(total_rows * nchans, 1)
+    )
+    return dict(
+        bounds=bounds, nsub=nsub, shift_max=shift_max,
+        n_anchor_p=n_anchor_p, max_err=max_err, cost_ratio=cost_ratio,
+        per_cell=per_cell,
+    )
+
+
+def subband_stage2_layout(per_cell, L1: int, dm_tile2: int = 8):
+    """Anchor-aligned padded row layout for the stage-2-as-dedispersion
+    trick.
+
+    Stage 2 (each fine row = nsub shifted windows from its anchor's
+    partials) IS a dedispersion over a synthetic nsub-channel
+    "filterbank" (the flat (n_anchor, nsub, L1) partials) with delays
+    ``assign * nsub * L1 + shift`` — so the battle-tested direct
+    Pallas kernel runs it in ONE launch instead of ndm*nsub XLA
+    dynamic slices (measured ~0.19 s/chunk, the dominant sub-band
+    cost).  The kernel's window machinery shares one DMA window per
+    (dm_tile, chan_group) block, so rows are PADDED per anchor to
+    ``dm_tile2`` multiples: no tile straddles two anchors and the
+    static window slack stays at the (small) shift spread instead of
+    the (huge) anchor stride.
+
+    Args: ``per_cell`` from :func:`subband_chunk_plan`; ``L1`` the
+    (padded) stage-1 row length the synthetic delays stride over.
+
+    Returns (R2, cells2) where cells2[i] = (delays2 (R2, nsub) int32,
+    unpad (len(rows),) int32): the synthetic per-row delay table and
+    the padded-slot index of each original row.
+    """
+    lens = []
+    for _anchor_rows, assign, _shifts in per_cell:
+        n_anchor = int(assign.max()) + 1 if len(assign) else 1
+        lens.append(sum(
+            -(-int((assign == a).sum()) // dm_tile2) * dm_tile2
+            for a in range(n_anchor)
+        ))
+    R2 = max(lens)
+    cells2 = []
+    for _anchor_rows, assign, shifts in per_cell:
+        nsub = shifts.shape[1]
+        n_anchor = int(assign.max()) + 1 if len(assign) else 1
+        assign2 = np.zeros(R2, np.int32)
+        shifts2 = np.zeros((R2, nsub), np.int32)
+        unpad = np.zeros(len(assign), np.int32)
+        pos = 0
+        for a in range(n_anchor):
+            idx = np.flatnonzero(assign == a)
+            na = len(idx)
+            pad_a = -(-na // dm_tile2) * dm_tile2
+            assign2[pos : pos + pad_a] = a
+            # padded slots repeat the segment's first row (never wrong)
+            src = np.concatenate([idx, np.repeat(idx[:1], pad_a - na)])
+            shifts2[pos : pos + pad_a] = shifts[src]
+            unpad[idx] = pos + np.arange(na)
+            pos += pad_a
+        # tail slots: repeat the last anchor (whole tiles, same anchor)
+        if pos < R2:
+            assign2[pos:] = assign2[pos - 1]
+            shifts2[pos:] = shifts2[pos - 1]
+        delays2 = (assign2[:, None].astype(np.int64) * (nsub * L1)
+                   + shifts2).astype(np.int32)
+        cells2.append((delays2, unpad))
+    return R2, cells2
+
+
+def dedisperse_subband_flat(
+    anchor_delays: jax.Array,
+    assign: jax.Array,
+    shifts: jax.Array,
+    out_nsamps: int,
+    *,
+    bounds: tuple,
+    L1: int,
+    stage1,
+) -> jax.Array:
+    """Two-stage sub-band dedispersion over FLAT parts (hot path).
+
+    The chunked mesh driver's sub-band mode: stage 1 dedisperses the
+    chunk's ``n_anchor_p`` anchor rows per sub-band (``stage1`` is a
+    caller-supplied closure ``(chan_range, anchor_delays) -> partials
+    (n_anchor_p, L1)`` selecting the Pallas kernel or the XLA scan over
+    the resident flat parts), and stage 2 assembles each fine trial
+    from one shifted window per sub-band.  Sub-bands are processed
+    sequentially so at most ONE partial is live alongside the
+    accumulator (peak extra HBM = n_anchor_p * L1 * 4 bytes).
+
+    ``stage1`` is either a one-shot callable ``(anchor_delays) ->
+    (n_anchor_p, nsub, L1)`` computing EVERY sub-band's partials in a
+    single kernel launch (the Pallas ``subband_slots`` mode — a launch
+    per sub-band costs ~0.15 s of fixed overhead per chunk, more than
+    the stage-1 sweep itself), or a per-band ``((lo, hi),
+    anchor_delays) -> (n_anchor_p, L1)`` callable (the CPU scan
+    fallback, where launch overhead is irrelevant); the two are told
+    apart by parameter count.
+
+    Args:
+        anchor_delays: (n_anchor_p, nchans) int32 (full-width).
+        assign: (ndm_c,) int32 — local anchor slot per fine row.
+        shifts: (ndm_c, nsub) int32 stage-2 shifts, all in
+            [0, L1 - out_nsamps] (host-validated).
+        bounds: static per-sub-band (lo, hi) channel ranges.
+        L1: static stage-1 length = out_nsamps + shift_max.
+    """
+    import inspect
+
+    ndm_c = assign.shape[0]
+    nsub = len(bounds)
+    acc = jnp.zeros((ndm_c, out_nsamps), jnp.float32)
+    one_shot = len(inspect.signature(stage1).parameters) == 1
+
+    def add_band(acc, s, flat):
+        offs = assign * jnp.int32(L1) + shifts[:, s]
+        if ndm_c <= 64:
+            rows = [
+                lax.dynamic_slice(flat, (offs[i],), (out_nsamps,))
+                for i in range(ndm_c)
+            ]
+            return acc + jnp.stack(rows)
+        return acc + jax.vmap(
+            lambda o: lax.dynamic_slice(flat, (o,), (out_nsamps,))
+        )(offs)
+
+    if one_shot:
+        partials = stage1(anchor_delays)  # (n_anchor_p, nsub, L1)
+        for s in range(nsub):
+            acc = add_band(acc, s, partials[:, s].reshape(-1))
+    else:
+        for s, (lo, hi) in enumerate(bounds):
+            part = stage1((lo, hi), anchor_delays)  # (n_anchor_p, L1)
+            acc = add_band(acc, s, part.reshape(-1))
     return acc
 
 

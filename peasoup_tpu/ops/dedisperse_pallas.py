@@ -91,8 +91,9 @@ def dedisperse_window_slack(
 
 
 def _dedisperse_flat_kernel(
-    gmins_ref, delays_ref, *refs, dm_tile, time_tile, chan_group, slack,
-    part_chans, nsamps, delays_blocked, align,
+    gmins_ref, delays_ref, *refs, dm_tile, time_tile,
+    chan_group, slack, part_chans, nsamps, delays_blocked, align,
+    group_range=None,
 ):
     """Flat-input variant: the filterbank arrives as 1-D u8/f32 part
     refs (whole channels each), so no 2-D entry-parameter layout exists
@@ -181,36 +182,44 @@ def _dedisperse_flat_kernel(
     # python loop over parts (a traced channel index cannot select
     # among refs); groups inside a part run PAIRWISE so the
     # double-buffer parity stays static — the wrapper guarantees every
-    # part's group count is even
+    # part's group count is even.  ``group_range`` (static, global
+    # group units) restricts the sweep to a sub-band's groups; the
+    # wrapper guarantees its bounds are pair-aligned within every part
+    glo, ghi = group_range if group_range is not None else (
+        0, sum(part_chans) // G)
     g_base = 0
     for pi, part_ref in enumerate(part_refs):
         ngroups_p = part_chans[pi] // G
-        npairs = ngroups_p // 2
+        s_lo = max(glo - g_base, 0)
+        s_hi = min(ghi - g_base, ngroups_p)
+        if s_lo < s_hi:
+            npairs = (s_hi - s_lo) // 2
 
-        for cp in group_dmas(part_ref, 0, g_base, 0):
-            cp.start()
-
-        def pair_body(k, _, part_ref=part_ref, g_base=g_base,
-                      npairs=npairs):
-            ge, go = 2 * k, 2 * k + 1  # even/odd local group ids
-            for cp in group_dmas(part_ref, 1, g_base + go, go):
+            for cp in group_dmas(part_ref, 0, g_base + s_lo, s_lo):
                 cp.start()
-            for cp in group_dmas(part_ref, 0, g_base + ge, ge):
-                cp.wait()
-            process_group(0, g_base + ge, group_astart(g_base + ge))
 
-            @pl.when(k + 1 < npairs)
-            def _():
-                for cp in group_dmas(part_ref, 0, g_base + go + 1,
-                                     go + 1):
+            def pair_body(k, _, part_ref=part_ref, g_base=g_base,
+                          npairs=npairs, s_lo=s_lo):
+                ge, go = s_lo + 2 * k, s_lo + 2 * k + 1  # local group ids
+                for cp in group_dmas(part_ref, 1, g_base + go, go):
                     cp.start()
+                for cp in group_dmas(part_ref, 0, g_base + ge, ge):
+                    cp.wait()
+                process_group(0, g_base + ge, group_astart(g_base + ge))
 
-            for cp in group_dmas(part_ref, 1, g_base + go, go):
-                cp.wait()
-            process_group(1, g_base + go, group_astart(g_base + go))
-            return 0
+                @pl.when(k + 1 < npairs)
+                def _():
+                    for cp in group_dmas(part_ref, 0, g_base + go + 1,
+                                         go + 1):
+                        cp.start()
 
-        jax.lax.fori_loop(jnp.int32(0), jnp.int32(npairs), pair_body, 0)
+                for cp in group_dmas(part_ref, 1, g_base + go, go):
+                    cp.wait()
+                process_group(1, g_base + go, group_astart(g_base + go))
+                return 0
+
+            jax.lax.fori_loop(jnp.int32(0), jnp.int32(npairs),
+                              pair_body, 0)
         g_base += ngroups_p
 
 
@@ -306,6 +315,257 @@ def _dedisperse_kernel(
     jax.lax.fori_loop(jnp.int32(0), jnp.int32(ngroups), group_body, 0)
 
 
+def _dedisperse_flat_sb_kernel(
+    gmins_ref, delays_ref, *refs, dm_tile, time_tile, k_tiles,
+    chan_group, slack, part_chans, nsamps, align, csub, njk,
+    delays_blocked,
+):
+    """Sub-band stage-1 kernel: grid (dm tiles, nsub, time) where each
+    step sweeps ONE sub-band's channels over K consecutive time tiles.
+
+    vs computing sub-bands inside the direct kernel (a per-group output
+    slot in a (dm, nsub, T) VMEM block): the out block here is
+    (dm_tile, 1, K, 8, TQ) — nsub lives in the GRID — so dm_tile and
+    the per-DMA window length K*T stay large.  The direct kernel is
+    DMA-ISSUE-bound at small windows (one DMA per channel per tile;
+    measured flat ~0.2 s/chunk at 1024 chans regardless of row count),
+    so cutting the DMA count by K and keeping full tiles is where the
+    sub-band speedup actually comes from.
+
+    Window DMAs are double-buffered across the step's channel GROUPS
+    (parity = group index, STATIC — a traced slot cannot select among
+    python-level window refs): group gg+1 streams in while gg
+    computes.  csub >= 2*chan_group guarantees >= 2 groups per step,
+    so only the first group's DMA latency is exposed per grid step
+    (~15 us of a ~100 us step).
+    """
+    G = chan_group
+    CS = csub
+    nparts = len(refs) - 3 - 2 * G
+    part_refs = refs[:nparts]
+    out_ref = refs[nparts]
+    win_refs = refs[nparts + 1 : nparts + 1 + 2 * G]  # (parity, chan)
+    winf_ref, sem_ref = refs[nparts + 1 + 2 * G :]
+    T, S, A, K = time_tile, slack, align, k_tiles
+    TQ = T // 8
+    RW = TQ + 128
+    WQ = TQ + S + A
+    # per-kk slice length must be A-aligned (u8 1-D VMEM tiling), and
+    # the window must cover the last kk's rounded slice
+    WL = -(-(T + S + A) // A) * A
+    W1 = -(-((K - 1) * T + WL) // A) * A
+    i_tile = pl.program_id(0)
+    s = pl.program_id(1)
+    jk = pl.program_id(2)
+    gps = CS // G  # channel groups per sub-band (>= 2)
+
+    def astart_of(g):
+        start = jk * (K * T) + gmins_ref[i_tile, g]
+        return pl.multiple_of((start // A) * A, A)
+
+    def group_dmas(gg, slot):
+        """One K*T-long window DMA per channel of sub-band group gg."""
+        g_base = 0
+        for pi, part_ref in enumerate(part_refs):
+            ngroups_p = part_chans[pi] // G
+            nsub_p = ngroups_p // gps  # sub-bands in this part
+            s_lo = g_base // gps
+
+            @pl.when(jnp.logical_and(s >= s_lo, s < s_lo + nsub_p))
+            def _(part_ref=part_ref, s_lo=s_lo):
+                gl = (s - s_lo) * gps + gg  # part-local group
+                astart = astart_of(s * gps + gg)
+                for c in range(G):
+                    pltpu.make_async_copy(
+                        part_ref.at[pl.ds(
+                            (gl * G + c) * nsamps + astart, W1)],
+                        win_refs[slot * G + c],
+                        sem_ref.at[slot, c],
+                    ).start()
+
+            g_base += ngroups_p
+
+    def wait_group(slot):
+        for c in range(G):
+            pltpu.make_async_copy(
+                win_refs[slot * G + c], win_refs[slot * G + c],
+                sem_ref.at[slot, c],
+            ).wait()
+
+    group_dmas(0, 0)
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    for gg in range(gps):
+        slot = gg % 2
+        if gg + 1 < gps:
+            group_dmas(gg + 1, (gg + 1) % 2)
+        wait_group(slot)
+        astart = astart_of(s * gps + gg)
+        # repack per (kk): winf holds ONE time tile's 8 sublane chunks
+        # for the group's G channels (a K-wide winf would not fit
+        # VMEM); only the kk-relevant WL-slice is loaded/converted so
+        # the u8->f32 conversion volume stays ~1x the window
+        for kk in range(K):
+            for c in range(G):
+                w = win_refs[slot * G + c][pl.ds(kk * T, WL)]
+                if w.dtype == jnp.uint8:
+                    w = w.astype(jnp.int32)
+                wf = w.astype(jnp.float32)
+                for s8 in range(8):
+                    winf_ref[c, s8, :] = wf[s8 * TQ : s8 * TQ + WQ]
+
+            def d_body(d, _):
+                dd = d if delays_blocked else i_tile * dm_tile + d
+
+                def chan(c, acc):
+                    off = (jk * (K * T)
+                           + delays_ref[dd, (s * gps + gg) * G + c]
+                           - astart)
+                    coarse = pl.multiple_of((off // 128) * 128, 128)
+                    fine = off - coarse
+                    v = winf_ref[c, :, pl.ds(coarse, RW)]
+                    return acc + pltpu.roll(v, -fine, 1)[:, :TQ]
+
+                acc = chan(0, jnp.zeros((8, TQ), jnp.float32))
+                for c in range(1, G):
+                    acc = chan(c, acc)
+                out_ref[pl.ds(d, 1), 0, kk] += acc[None]
+                return 0
+
+            jax.lax.fori_loop(jnp.int32(0), jnp.int32(dm_tile), d_body, 0)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "nsamps", "out_nsamps", "window_slack", "dm_tile", "time_tile",
+        "k_tiles", "chan_group", "interpret", "max_delay", "csub",
+    ),
+)
+def dedisperse_pallas_flat_subband(
+    parts,
+    delays: jax.Array,
+    nsamps: int,
+    out_nsamps: int,
+    *,
+    csub: int,
+    window_slack: int,
+    max_delay: int,
+    dm_tile: int = 8,
+    time_tile: int = 15360,
+    k_tiles: int = 4,
+    chan_group: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Stage-1 sub-band partials over flat parts, one kernel launch.
+
+    Returns (ndm, nsub, out_nsamps) f32 where sub-band ``s`` sums
+    channels [s*csub, (s+1)*csub).  ``csub`` must be a multiple of
+    ``2*chan_group`` and divide every part's channel count.  ``delays``
+    is full-width (the ANCHOR rows' delays).  See
+    :func:`_dedisperse_flat_sb_kernel` for why this exists.
+    """
+    with enable_x64(False):
+        ndm, nchans = delays.shape
+        if not isinstance(parts, (list, tuple)):
+            parts = [parts]
+        T, S, K = time_tile, window_slack, k_tiles
+        TQ = _flat_checks(T, S)
+        if csub % (2 * chan_group) or nchans % csub:
+            raise ValueError(
+                f"csub={csub} must be a multiple of 2*chan_group="
+                f"{2 * chan_group} and divide nchans={nchans}"
+            )
+        nsub = nchans // csub
+        dtype = parts[0].dtype
+        align = 1024 if dtype == jnp.uint8 else 256
+        if nsamps % align:
+            raise ValueError(
+                f"flat-part channel stride {nsamps} must be a multiple "
+                f"of {align} (pad the tail)"
+            )
+        part_chans = []
+        for p in parts:
+            cp, rem = divmod(p.shape[0], nsamps)
+            if rem or cp % csub:
+                raise ValueError(
+                    f"part length {p.shape[0]} must hold whole "
+                    f"sub-bands (csub={csub}, stride {nsamps})"
+                )
+            part_chans.append(cp)
+        if sum(part_chans) != nchans:
+            raise ValueError("parts do not match delays' channel count")
+        if out_nsamps < T:
+            raise ValueError(
+                f"input too short for the kernel window ({out_nsamps=} "
+                f"< {T})")
+        delays = delays.astype(jnp.int32)
+        ndm_p = -(-ndm // dm_tile) * dm_tile
+        TK = K * T
+        out_p = -(-out_nsamps // TK) * TK
+        njk = out_p // TK
+        # mirror the kernel's window size: the last kk's A-aligned
+        # per-tile slice rounds the window up past TK + S + A
+        WL = -(-(T + S + align) // align) * align
+        W1 = -(-((K - 1) * T + WL) // align) * align
+        need = out_p - TK + max_delay + W1
+        if nsamps < need:
+            raise ValueError(
+                f"flat parts hold {nsamps} samples per channel but the "
+                f"sub-band kernel windows need {need}; pre-pad the data"
+            )
+        if ndm_p != ndm:
+            delays = jnp.pad(delays, ((0, ndm_p - ndm), (0, 0)),
+                             mode="edge")
+        ntiles, ngroups = ndm_p // dm_tile, nchans // chan_group
+        gmins = (
+            delays.reshape(ntiles, dm_tile, ngroups, chan_group)
+            .min(axis=(1, 3))
+            .astype(jnp.int32)
+        )
+        WQ = TQ + S + align
+        delays_blocked = dm_tile % 8 == 0 or ntiles == 1
+        delays_spec = (
+            pl.BlockSpec(
+                (dm_tile, nchans), lambda i, s, j: (i, 0),
+                memory_space=pltpu.SMEM,
+            )
+            if delays_blocked
+            else pl.BlockSpec(memory_space=pltpu.SMEM)
+        )
+        out = pl.pallas_call(
+            partial(
+                _dedisperse_flat_sb_kernel,
+                dm_tile=dm_tile, time_tile=T, k_tiles=K,
+                chan_group=chan_group, slack=S,
+                part_chans=tuple(part_chans), nsamps=nsamps,
+                align=align, csub=csub, njk=njk,
+                delays_blocked=delays_blocked,
+            ),
+            grid=(ntiles, nsub, njk),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # gmins
+                delays_spec,
+            ] + [pl.BlockSpec(memory_space=pl.ANY)] * len(parts),
+            out_specs=pl.BlockSpec(
+                (dm_tile, 1, K, 8, TQ), lambda i, s, j: (i, s, j, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            out_shape=jax.ShapeDtypeStruct(
+                (ndm_p, nsub, njk * K, 8, TQ), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((W1,), dtype)
+                for _ in range(2 * chan_group)
+            ] + [
+                pltpu.VMEM((chan_group, 8, WQ), jnp.float32),
+                pltpu.SemaphoreType.DMA((2, chan_group)),
+            ],
+            interpret=interpret,
+        )(gmins, delays, *parts)
+        return (out.reshape(ndm_p, nsub, out_p)
+                [:ndm, :, :out_nsamps])
+
+
 def dedisperse_flat_pad_to(out_nsamps: int, max_delay: int,
                            window_slack: int, time_tile: int,
                            uint8: bool = True) -> int:
@@ -339,7 +599,8 @@ def _flat_checks(time_tile, window_slack):
     jax.jit,
     static_argnames=(
         "nsamps", "out_nsamps", "window_slack", "dm_tile", "time_tile",
-        "chan_group", "interpret", "max_delay",
+        "chan_group", "interpret", "max_delay", "chan_range",
+        "data_tail_ok",
     ),
 )
 def dedisperse_pallas_flat(
@@ -354,6 +615,8 @@ def dedisperse_pallas_flat(
     time_tile: int = 15360,
     chan_group: int = 16,
     interpret: bool = False,
+    chan_range: tuple[int, int] | None = None,
+    data_tail_ok: bool = False,
 ) -> jax.Array:
     """Dedisperse FLAT channel-major part arrays with the tiled kernel.
 
@@ -370,6 +633,14 @@ def dedisperse_pallas_flat(
     ``ceil(out_nsamps/T)*T + max_delay + slack + 128`` valid samples
     (the caller pre-pads; in-program padding of flat parts would
     relayout-copy them).
+
+    ``chan_range``: optional static (lo, hi) channel bounds — sum only
+    those channels.  Both bounds must be multiples of
+    ``2 * chan_group`` (pairwise double buffering); ``delays`` stays
+    full-width, indexed by global channel.  (Sub-band stage 1 uses the
+    dedicated :func:`dedisperse_pallas_flat_subband` kernel instead —
+    one launch per sub-band through this entry costs ~0.15 s of fixed
+    overhead per chunk.)
     """
     with enable_x64(False):
         ndm, nchans = delays.shape
@@ -387,6 +658,7 @@ def dedisperse_pallas_flat(
                 f"of {align} (pad the tail) for tile-aligned window DMAs"
             )
         part_chans = []
+        used = 0
         for p in parts:
             cp, rem = divmod(p.shape[0], nsamps)
             if rem:
@@ -394,18 +666,25 @@ def dedisperse_pallas_flat(
                     f"part length {p.shape[0]} is not a multiple of the "
                     f"channel stride {nsamps}"
                 )
-            if cp % (2 * chan_group):
+            # data_tail_ok: the part may hold EXTRA trailing strides
+            # that only the delay table reaches into (the sub-band
+            # stage-2-as-dedispersion call sweeps nsub "channels" of a
+            # flat (n_anchor, nsub, L1) partials buffer whose anchor
+            # offset rides in the delays); the sweep itself covers
+            # exactly nchans channels either way
+            take = min(cp, nchans - used) if data_tail_ok else cp
+            if take % (2 * chan_group):
                 raise ValueError(
-                    f"part channel count {cp} not a multiple of "
+                    f"part channel count {take} not a multiple of "
                     f"2*{chan_group=} (pairwise static double "
                     f"buffering); use split_flat_channels(..., "
                     f"align={2 * chan_group})"
                 )
-            part_chans.append(cp)
-        if sum(part_chans) != nchans:
+            part_chans.append(take)
+            used += take
+        if used != nchans:
             raise ValueError(
-                f"parts hold {sum(part_chans)} channels, delays expect "
-                f"{nchans}"
+                f"parts hold {used} channels, delays expect {nchans}"
             )
         if out_nsamps < T:
             raise ValueError(
@@ -428,6 +707,16 @@ def dedisperse_pallas_flat(
             delays = jnp.pad(delays, ((0, ndm_p - ndm), (0, 0)),
                              mode="edge")
         ntiles, ngroups = ndm_p // dm_tile, nchans // chan_group
+        group_range = None
+        if chan_range is not None:
+            c_lo, c_hi = chan_range
+            if (c_lo % (2 * chan_group) or c_hi % (2 * chan_group)
+                    or not 0 <= c_lo < c_hi <= nchans):
+                raise ValueError(
+                    f"chan_range {chan_range} must be 2*chan_group"
+                    f"(={2 * chan_group})-aligned within [0, {nchans})"
+                )
+            group_range = (c_lo // chan_group, c_hi // chan_group)
         gmins = (
             delays.reshape(ntiles, dm_tile, ngroups, chan_group)
             .min(axis=(1, 3))
@@ -449,6 +738,7 @@ def dedisperse_pallas_flat(
                 dm_tile=dm_tile, time_tile=T, chan_group=chan_group,
                 slack=S, part_chans=tuple(part_chans), nsamps=nsamps,
                 delays_blocked=delays_blocked, align=align,
+                group_range=group_range,
             ),
             grid=(ntiles, nj),
             in_specs=[

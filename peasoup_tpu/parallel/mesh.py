@@ -26,6 +26,7 @@ candidate distillation remains a (cheap) host-side pass.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -280,6 +281,7 @@ def build_chunked_search(
     max_delay_samples: int = 0,
     block: int | None = None,
     n_parts: int = 1,
+    subband: tuple | None = None,
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -313,14 +315,29 @@ def build_chunked_search(
     over ``dm`` and ``ndm_local = n_chunks * dm_chunk`` rows per shard.
     The table args are always required; with ``block=None`` they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
+
+    ``subband``: optional static (bounds, L1, n_anchor_p, slack,
+    slots, t_sub) —
+    two-stage sub-band dedispersion (``_plan_subband_chunks``): three
+    extra leading inputs follow the data parts, all dm-sharded —
+    anchor_delays (n_anchor_p, nchans), assign (dm_chunk,), shifts
+    (dm_chunk, nsub) — and the per-chunk direct sweep is replaced by
+    ``dedisperse_subband_flat`` (anchor sweeps + shifted-window
+    assembly).  Requires the driver's one-chunk-per-dispatch shape.
     """
-    from ..ops.dedisperse_pallas import dedisperse_pallas_flat
+    from ..ops.dedisperse_pallas import (
+        dedisperse_pallas_flat,
+        dedisperse_pallas_flat_subband,
+    )
+    from ..ops.dedisperse import dedisperse_subband_flat
 
     nlevels = nharms + 1
     n_chunks = ndm_local // dm_chunk
     n_ablocks = namax // accel_block
     assert ndm_local == n_chunks * dm_chunk
     assert namax == n_ablocks * accel_block
+    assert subband is None or n_chunks == 1, \
+        "sub-band mode needs one chunk per dispatch (the driver's shape)"
     use_tables = block is not None
 
     def shard_fn(*args):
@@ -329,9 +346,34 @@ def build_chunked_search(
         # full-size relayout copy under shard_map, 8 GB at production
         # scale (see ops.dedisperse.dedisperse_flat)
         parts = list(args[:n_parts])
+        if subband is not None:
+            (anchor_delays, sb_assign, sb_shifts) = args[n_parts:n_parts + 3]
+            rest = args[n_parts + 3:]
+        else:
+            rest = args[n_parts:]
         (delays, accs, uidx, d0_u, pos_u, step_u, birdies,
-         widths) = args[n_parts:]
+         widths) = rest
         nsamps_dev = sum(p.shape[0] for p in parts) // nchans
+
+        if subband is not None:
+            (sb_bounds, sb_L1, sb_nanch, sb_slack, sb_csub,
+             sb_T, sb_K, sb_dm_tile) = subband
+            if dedisp_method == "pallas":
+                # one-launch stage 1 (grid over sub-bands, K-tile
+                # windows — see _dedisperse_flat_sb_kernel)
+                def stage1(ad):
+                    return dedisperse_pallas_flat_subband(
+                        parts, ad, nsamps_dev, sb_L1, csub=sb_csub,
+                        window_slack=sb_slack, dm_tile=sb_dm_tile,
+                        time_tile=sb_T, k_tiles=sb_K,
+                        chan_group=chan_group,
+                        max_delay=max_delay_samples,
+                    )
+            else:
+                def stage1(cr, ad):
+                    return dedisperse_flat(parts, ad, nsamps_dev, sb_L1,
+                                           chan_range=cr)
+
         def chunk_body(_, ci):
             z = jnp.int32(0)  # literal 0 is weak-i64 under x64
             delays_c = lax.dynamic_slice(
@@ -343,7 +385,12 @@ def build_chunked_search(
             uidx_c = lax.dynamic_slice(
                 uidx, (ci * dm_chunk, z), (dm_chunk, namax)
             )
-            if dedisp_method == "pallas":
+            if subband is not None:
+                trials = dedisperse_subband_flat(
+                    anchor_delays, sb_assign, sb_shifts, out_nsamps,
+                    bounds=sb_bounds, L1=sb_L1, stage1=stage1,
+                )
+            elif dedisp_method == "pallas":
                 trials = dedisperse_pallas_flat(
                     parts, delays_c, nsamps_dev, out_nsamps,
                     window_slack=window_slack, dm_tile=dm_tile,
@@ -420,10 +467,12 @@ def build_chunked_search(
         counts = counts.reshape(ndm_local, namax, nlevels)
         return _compact_peaks(idxs, snrs, counts, compact_k)
 
+    sb_specs = (P("dm", None), P("dm"), P("dm", None)) \
+        if subband is not None else ()
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(),) * n_parts + (
+        in_specs=(P(),) * n_parts + sb_specs + (
             P("dm", None), P("dm", None), P("dm", None),
             P(), P(), P(), P(), P()),
         out_specs=P("dm"),
@@ -689,6 +738,118 @@ class MeshPulsarSearch(PulsarSearch):
             )
         return plan
 
+    def _plan_subband_chunks(self, plan) -> dict | None:
+        """Sub-band (two-stage) dedispersion plan for the chunked
+        driver, honouring ``config.subband_dedisp`` (never/auto/always).
+
+        Anchors are chosen per (chunk, shard) cell so partial sums
+        never cross a dispatch; "auto" engages only when the total
+        adds compress at least 2x.  The fold/re-search paths keep the
+        EXACT direct sweep for their few rows regardless (their trials
+        come from ``_dedisperse_rows_device``), so folded SNRs are
+        never affected by the bounded stage-2 smearing."""
+        cfg = self.config
+        mode = cfg.subband_dedisp
+        if mode == "never":
+            return None
+        if mode not in ("auto", "always"):
+            raise ValueError(
+                f"subband_dedisp={mode!r}: use auto, always or never")
+        from ..ops.dedisperse import subband_chunk_plan
+        from ..ops.dedisperse_pallas import (
+            dedisperse_flat_pad_to,
+            dedisperse_window_slack,
+        )
+
+        ndm = len(self.dm_list)
+        ndev = self.ndev
+        ndm_local_p = plan["ndm_local_p"]
+        dm_chunk = plan["dm_chunk"]
+        ndm_pp = ndm_local_p * ndev
+        nchans = self.fil.nchans
+        dm_pad = np.concatenate([
+            np.asarray(self.dm_list, np.float64),
+            np.repeat(float(self.dm_list[-1]), ndm_pp - ndm),
+        ])
+        delays_p = np.empty((ndm_pp, nchans), np.int32)
+        delays_p[:ndm] = self.delays
+        delays_p[ndm:] = self.delays[-1]
+        n_chunks = ndm_local_p // dm_chunk
+        cells = [
+            np.arange(d * ndm_local_p + ci * dm_chunk,
+                      d * ndm_local_p + ci * dm_chunk + dm_chunk)
+            for ci in range(n_chunks)
+            for d in range(ndev)
+        ]
+        use_pallas = plan["dedisp_method"] == "pallas"
+        chan_align = 2 * plan["chan_group"] if use_pallas else 1
+        sbp = subband_chunk_plan(
+            dm_pad, delays_p, self.delay_tab, cells,
+            chan_align=chan_align, eps=cfg.subband_eps,
+        )
+        if sbp is None:
+            return None
+        if mode == "auto" and sbp["cost_ratio"] > 0.5:
+            return None
+        L1 = self.out_nsamps + sbp["shift_max"]
+        n_anchor_p = sbp["n_anchor_p"]
+        csub = sbp["bounds"][0][1] - sbp["bounds"][0][0]
+        t_sub = k_sub = dm_tile_sub = None
+        if use_pallas:
+            # stage-1 kernel geometry (dedisperse_pallas_flat_subband):
+            # K time tiles per window DMA, bounded by the
+            # double-buffered per-channel window scratch (~4.5 MB)
+            G = plan["chan_group"]
+            t_sub = plan["time_tile"]
+            if L1 < t_sub:
+                return None
+            itemsize = 1 if self.fil.header.nbits <= 8 else 4
+            k_sub = int(max(1, min(
+                4, (9 << 20) // (2 * csub * itemsize * t_sub))))
+            dm_tile_sub = n_anchor_p
+            anchor_tables = np.concatenate([
+                delays_p[pad_rows] for pad_rows, _a, _s in sbp["per_cell"]
+            ])
+            slack = dedisperse_window_slack(
+                anchor_tables, dm_tile_sub, G)
+            # slack + align: the sb kernel's per-kk aligned slices
+            # round its window one alignment unit past the K*T formula
+            pad_sub = dedisperse_flat_pad_to(
+                L1, self.max_delay,
+                slack + (1024 if self.fil.header.nbits <= 8 else 256),
+                k_sub * t_sub,
+                uint8=self.fil.header.nbits <= 8,
+            )
+            # every flat part must hold whole sub-bands
+            plan["part_align"] = max(2 * G, csub)
+        else:
+            slack = 0
+            pad_sub = self.out_nsamps + self.max_delay + sbp["shift_max"]
+        plan["pad_to"] = max(plan["pad_to"], pad_sub)
+        # per-ci transport arrays (cells are ci-major, shard-minor)
+        per_ci = []
+        for ci in range(n_chunks):
+            cell = sbp["per_cell"][ci * ndev : (ci + 1) * ndev]
+            per_ci.append((
+                np.concatenate([c[0] for c in cell]),          # anchor rows
+                np.concatenate([c[1] for c in cell]),          # assign
+                np.concatenate([c[2] for c in cell], axis=0),  # shifts
+            ))
+        if self.config.verbose:
+            print(
+                f"sub-band dedispersion: nsub={sbp['nsub']} "
+                f"anchors<={n_anchor_p}/cell cost_ratio="
+                f"{sbp['cost_ratio']:.2f} max_err={sbp['max_err']} "
+                f"samples"
+            )
+        return dict(
+            bounds=sbp["bounds"], L1=L1, n_anchor_p=n_anchor_p,
+            slack=int(slack), per_ci=per_ci, max_err=sbp["max_err"],
+            cost_ratio=sbp["cost_ratio"], nsub=sbp["nsub"],
+            csub=csub, t_sub=t_sub, k_sub=k_sub,
+            dm_tile_sub=dm_tile_sub,
+        )
+
     def _device_inputs_chunked(self, plan, acc_lists):
         """Upload-once device state for the per-chunk dispatches.
 
@@ -715,14 +876,28 @@ class MeshPulsarSearch(PulsarSearch):
         nchans, nsamps = self.fil.nchans, self.fil.nsamps
         # single allocation: transpose-copy + killmask + tail pad in
         # place (three sequential full copies would transiently need
-        # ~3x the multi-GB input on the host)
+        # ~3x the multi-GB input on the host).  The transpose itself is
+        # threaded over channel blocks: a byte-granular (nsamps, nchans)
+        # -> (nchans, nsamps) strided assignment is the single largest
+        # host cost of the production prep (numpy releases the GIL in
+        # the strided copy, so threads scale)
         data = np.zeros(
             (nchans, max(plan["pad_to"], nsamps)),
             np.uint8 if nbits <= 8 else np.float32,
         )
-        data[:, :nsamps] = self.fil.data.T
-        if self.killmask is not None:
-            data[:, :nsamps] *= self.killmask[:, None].astype(data.dtype)
+        src = self.fil.data
+        km = self.killmask
+
+        def _tblock(c0):
+            c1 = min(c0 + 64, nchans)
+            data[c0:c1, :nsamps] = src[:, c0:c1].T
+            if km is not None:
+                data[c0:c1, :nsamps] *= km[c0:c1, None].astype(data.dtype)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(min(16, os.cpu_count() or 8)) as ex:
+            list(ex.map(_tblock, range(0, nchans, 64)))
         rep = NamedSharding(self.mesh, P())
         uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
         self._host_chunk_arrays = (delays, accs, uidx)
@@ -730,8 +905,12 @@ class MeshPulsarSearch(PulsarSearch):
             put_global(p, rep)
             for p in split_flat_channels(
                 data,
-                align=(2 * plan["chan_group"]
-                       if plan["dedisp_method"] == "pallas" else 1),
+                # part_align: sub-band stage 1 needs every part to
+                # hold whole sub-bands (set by _plan_subband_chunks)
+                align=plan.get(
+                    "part_align",
+                    2 * plan["chan_group"]
+                    if plan["dedisp_method"] == "pallas" else 1),
             )
         )
         self._dev_chunk_static = (
@@ -858,6 +1037,10 @@ class MeshPulsarSearch(PulsarSearch):
         from ..utils import trace_range
 
         t0 = time.time()
+        # sub-band (two-stage) dedispersion plan — must precede the
+        # data upload: stage-1 windows may need extra tail padding
+        # (plan["pad_to"] is updated in place)
+        sb = self._plan_subband_chunks(plan)
         self._device_inputs_chunked(plan, acc_lists)
         data_parts, d0_u, pos_u, step_u, birdies_d, widths_d = (
             self._dev_chunk_static
@@ -865,6 +1048,7 @@ class MeshPulsarSearch(PulsarSearch):
         delays_h, accs_h, uidx_h = self._host_chunk_arrays
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
+        shard1 = NamedSharding(self.mesh, P("dm"))
 
         def build(cap_, ck_):
             return build_chunked_search(
@@ -895,19 +1079,30 @@ class MeshPulsarSearch(PulsarSearch):
                 max_delay_samples=self.max_delay,
                 block=self.resample_block,
                 n_parts=len(data_parts),
+                subband=(
+                    (sb["bounds"], sb["L1"], sb["n_anchor_p"],
+                     sb["slack"], sb["csub"], sb["t_sub"],
+                     sb["k_sub"], sb["dm_tile_sub"])
+                    if sb is not None else None
+                ),
             )
 
         n_chunks = ndm_local_p // dm_chunk
         dm_cands = CandidateCollection()
         all_clipped: dict[int, int] = {}  # global row -> max count
         # per-phase breakdown across all chunks (VERDICT r2 item 2:
-        # the wall/device-model gap must be attributable)
-        phases = {"upload": 0.0, "compile": 0.0, "dispatch": 0.0,
-                  "fetch": 0.0, "decode": 0.0, "distill": 0.0,
-                  "checkpoint": 0.0}
+        # the wall/device-model gap must be attributable).  "prep" is
+        # the host-side setup before the first dispatch — sub-band
+        # planning, the threaded transpose into flat parts, resample
+        # tables, upload initiation — previously unattributed (~200 s
+        # of searching_device at production scale, VERDICT r3)
+        phases = {"prep": 0.0, "upload": 0.0, "compile": 0.0,
+                  "dispatch": 0.0, "fetch": 0.0, "decode": 0.0,
+                  "distill": 0.0, "checkpoint": 0.0}
         self._chunk_phases = phases
 
         tc = time.time()
+        phases["prep"] = tc - t0
         # untuned, the compacted buffer is the FULL slot count (~7 MB
         # at dm_chunk=8 x 21 accels x 5 levels x 1024): truncation is
         # impossible, so no escalation/recompile path exists here
@@ -932,9 +1127,18 @@ class MeshPulsarSearch(PulsarSearch):
             todo.append((ci, rows))
 
         def dispatch(ci, rows):
+            sb_args = ()
+            if sb is not None:
+                anchor_rows, assign, shifts = sb["per_ci"][ci]
+                sb_args = (
+                    put_global(delays_h[anchor_rows], shard),
+                    put_global(assign, shard1),
+                    put_global(shifts, shard),
+                )
             with trace_range(f"Chunked-Search-{ci}"):
                 return program(
                     *data_parts,
+                    *sb_args,
                     put_global(delays_h[rows], shard),
                     put_global(accs_h[rows], shard),
                     put_global(uidx_h[rows], shard),
@@ -1317,6 +1521,16 @@ class MeshPulsarSearch(PulsarSearch):
                 )
             return self._run_chunked(
                 plan, acc_lists, namax, timers, t_total, ckpt, ckpt_done
+            )
+        if cfg.subband_dedisp != "never":
+            import warnings
+
+            warnings.warn(
+                "subband_dedisp is ignored on the fused (small-input) "
+                "mesh path: its one-dispatch program keeps the exact "
+                "direct sweep, which is already cheap at this scale; "
+                "the chunked production driver and --single_device "
+                "honour it"
             )
         nlevels = cfg.nharmonics + 1
         # capacity auto-tune: a previous run on this object observed the

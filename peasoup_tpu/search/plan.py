@@ -98,6 +98,10 @@ class SearchConfig:
     # and results stay identical across drivers.  Opt in for dense
     # tolerance-stepped grids, where the tree wins several-fold.
     subband_dedisp: str = "never"
+    # stage-2 residual smearing bound in samples (0 = anchors compress
+    # only across identical-delay trials, making sub-band output
+    # bit-identical to the direct sweep)
+    subband_eps: float = 0.5
 
 
 class AccelerationPlan:

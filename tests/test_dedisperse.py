@@ -315,3 +315,177 @@ def test_dedisperse_pallas_flat_parity(dtype, nparts):
         # vector register before touching the output, a different
         # (last-ulp) rounding order than numpy's sequential channel sum
         np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sub-band on the flat/chunked hot path (chan_range + two-stage assembly)
+# ---------------------------------------------------------------------------
+
+def test_dedisperse_flat_chan_range_partials():
+    """chan_range partials must sum to the full sweep (integer data, so
+    f32 add order cannot matter)."""
+    from peasoup_tpu.ops.dedisperse import dedisperse_flat
+
+    rng = np.random.default_rng(11)
+    nchans, nsamps, ndm = 32, 2048, 9
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    dm_list = np.linspace(0.0, 120.0, ndm).astype(np.float32)
+    delays = delays_in_samples(dm_list, tab)
+    out_nsamps = nsamps - max_delay(dm_list, tab)
+    data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
+    flat = jnp.asarray(data.reshape(-1))
+    dj = jnp.asarray(delays)
+    full = np.asarray(dedisperse_flat([flat], dj, nsamps, out_nsamps))
+    pieces = sum(
+        np.asarray(dedisperse_flat([flat], dj, nsamps, out_nsamps,
+                                   chan_range=(lo, lo + 8)))
+        for lo in range(0, nchans, 8)
+    )
+    np.testing.assert_array_equal(full, pieces)
+
+
+def test_dedisperse_pallas_flat_chan_range():
+    """Pallas flat kernel with chan_range == numpy over that channel
+    slice only (sub-band stage 1)."""
+    from peasoup_tpu.ops.dedisperse import split_flat_channels
+    from peasoup_tpu.ops.dedisperse_pallas import (
+        dedisperse_flat_pad_to,
+        dedisperse_pallas_flat,
+    )
+
+    rng = np.random.default_rng(12)
+    nchans, ndm = 64, 6
+    T, G, dm_tile = 7168, 8, 6
+    out_nsamps = T + 100
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    dm_list = np.linspace(0.0, 150.0, ndm).astype(np.float32)
+    delays = delays_in_samples(dm_list, tab)
+    md = max_delay(dm_list, tab)
+    slack = dedisperse_window_slack(delays, dm_tile, G)
+    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, T, uint8=True)
+    data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
+    parts = [jnp.asarray(p) for p in split_flat_channels(data, align=2 * G)]
+    for lo, hi in ((0, 16), (16, 48), (48, 64)):
+        got = np.asarray(dedisperse_pallas_flat(
+            parts, jnp.asarray(delays), nsamps, out_nsamps,
+            window_slack=slack, max_delay=md, dm_tile=dm_tile,
+            time_tile=T, chan_group=G, interpret=True,
+            chan_range=(lo, hi),
+        ))
+        mask = np.zeros(nchans, np.float32)
+        mask[lo:hi] = 1.0
+        want = dedisperse_numpy(data.astype(np.float32), delays,
+                                out_nsamps, killmask=mask)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_subband_chunk_plan_and_flat_assembly_exact():
+    """eps=0 chunked sub-band plan: anchors compress across
+    duplicate-DM trials and the two-stage flat assembly is
+    bit-identical to the direct sweep."""
+    from peasoup_tpu.ops.dedisperse import (
+        dedisperse_flat,
+        dedisperse_subband_flat,
+        subband_chunk_plan,
+    )
+
+    rng = np.random.default_rng(13)
+    nchans, nsamps = 32, 4096
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    # pairs of identical DMs: anchors must halve with zero error
+    base = np.repeat(np.linspace(0.0, 120.0, 4), 2)
+    delays = delays_in_samples(base.astype(np.float32), tab)
+    out_nsamps = nsamps - int(delays.max())
+    data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
+    cells = [np.arange(0, 4), np.arange(4, 8)]
+    plan = subband_chunk_plan(base, delays, tab, cells, chan_align=1,
+                              eps=0.0)
+    assert plan is not None
+    assert plan["max_err"] == 0
+    assert plan["n_anchor_p"] == 2  # 2 distinct DMs per 4-row cell
+    flat = jnp.asarray(data.reshape(-1))
+
+    def stage1_factory(anchor_rows):
+        ad = jnp.asarray(delays[anchor_rows])
+        return lambda cr, ad_in: dedisperse_flat(
+            [flat], ad_in, nsamps, out_nsamps + plan["shift_max"],
+            chan_range=cr)
+
+    direct = np.asarray(dedisperse_flat(
+        [flat], jnp.asarray(delays), nsamps, out_nsamps))
+    for ci, rows in enumerate(cells):
+        anchor_rows, assign, shifts = plan["per_cell"][ci]
+        got = np.asarray(dedisperse_subband_flat(
+            jnp.asarray(delays[anchor_rows]), jnp.asarray(assign),
+            jnp.asarray(shifts), out_nsamps,
+            bounds=plan["bounds"],
+            L1=out_nsamps + plan["shift_max"],
+            stage1=stage1_factory(anchor_rows),
+        ))
+        np.testing.assert_array_equal(got, direct[rows])
+
+
+def test_chunked_subband_e2e_matches_direct(tutorial_fil):
+    """Chunked mesh driver with subband_dedisp='always', eps=0 must
+    reproduce the direct chunked driver's candidates exactly (2-bit
+    integer data: all sums exact in f32)."""
+    from peasoup_tpu.io.sigproc import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    # paired DMs so eps=0 still compresses anchors (n_anchor < rows)
+    dms = np.repeat(np.linspace(0.0, 60.0, 6), 2).astype(np.float32)
+    base = dict(
+        dm_list=dms, acc_start=-5.0, acc_end=5.0,
+        acc_pulse_width=64000.0, nharmonics=4, npdmp=2, limit=50,
+        dm_chunk=4, accel_block=2,
+    )
+    direct = MeshPulsarSearch(fil, SearchConfig(**base)).run()
+    sub = MeshPulsarSearch(
+        fil, SearchConfig(**base, subband_dedisp="always",
+                          subband_eps=0.0)
+    ).run()
+    assert len(direct.candidates) == len(sub.candidates)
+    for a, b in zip(direct.candidates, sub.candidates):
+        assert a.freq == b.freq
+        assert a.snr == pytest.approx(b.snr, rel=1e-6)
+        assert a.dm == b.dm and a.acc == b.acc
+
+
+def test_dedisperse_pallas_flat_subband_kernel():
+    """One-launch sub-band stage 1 (grid over sub-bands, K-tile
+    windows, cross-step double buffering): every sub-band's partials
+    must equal numpy over that channel slice (integer data => exact)."""
+    from peasoup_tpu.ops.dedisperse import split_flat_channels
+    from peasoup_tpu.ops.dedisperse_pallas import (
+        dedisperse_flat_pad_to,
+        dedisperse_pallas_flat_subband,
+    )
+
+    rng = np.random.default_rng(14)
+    nchans, ndm = 64, 4
+    T, G, dm_tile, K, csub = 1024, 8, 4, 2, 16
+    out_nsamps = K * T * 2 + 100  # > one K-window: exercises njk > 1
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    dm_list = np.linspace(0.0, 150.0, ndm).astype(np.float32)
+    delays = delays_in_samples(dm_list, tab)
+    md = max_delay(dm_list, tab)
+    slack = dedisperse_window_slack(delays, dm_tile, G)
+    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, K * T,
+                                    uint8=True)
+    data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
+    parts = [jnp.asarray(p) for p in split_flat_channels(data, align=csub)]
+    got = np.asarray(dedisperse_pallas_flat_subband(
+        parts, jnp.asarray(delays), nsamps, out_nsamps, csub=csub,
+        window_slack=slack, max_delay=md, dm_tile=dm_tile,
+        time_tile=T, k_tiles=K, chan_group=G, interpret=True,
+    ))
+    assert got.shape == (ndm, nchans // csub, out_nsamps)
+    for s in range(nchans // csub):
+        mask = np.zeros(nchans, np.float32)
+        mask[s * csub : (s + 1) * csub] = 1.0
+        want = dedisperse_numpy(data.astype(np.float32), delays,
+                                out_nsamps, killmask=mask)
+        np.testing.assert_array_equal(got[:, s], want,
+                                      err_msg=f"sub-band {s}")
