@@ -87,6 +87,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sub-band stage-2 residual smearing bound in "
                         "samples (0 = bit-identical to the direct "
                         "sweep; larger = more anchor compression)")
+    p.add_argument("--measure_stages",
+                   action=argparse.BooleanOptionalAction, default=False,
+                   help="clock a dedicated dedispersion dispatch so "
+                        "overview.xml <execution_times> carries real "
+                        "per-stage numbers (the mesh programs fuse "
+                        "dedispersion into the search dispatch); off by "
+                        "default — it costs one extra dispatch")
     p.add_argument("--no_compile_cache", action="store_true",
                    help="disable the persistent XLA compilation cache "
                         "(default cache dir: $PEASOUP_XLA_CACHE or "
@@ -145,10 +152,6 @@ def main(argv=None) -> int:
         return accmap_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
-    # real per-stage numbers in overview.xml <execution_times> (the
-    # mesh programs fuse dedispersion into the search dispatch; this
-    # clocks a dedicated dedisp dispatch like the reference reports)
-    cfg.measure_stages = True
     if not args.no_compile_cache:
         from .utils import enable_compile_cache
 

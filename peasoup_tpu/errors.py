@@ -1,0 +1,48 @@
+"""Typed exception layer (the reference's ErrorChecker, pythonised).
+
+The reference centralises failure detection in a static checker class
+(`/root/reference/include/utils/exceptions.hpp:13-153`) that turns
+dedisp/CUDA/cuFFT status codes and bad file streams into
+`std::runtime_error`s with context.  On TPU there are no status codes
+to poll — XLA raises on its own — so the equivalent surface is a small
+hierarchy of typed exceptions raised at the framework's guard sites,
+so callers can catch a *class* of failure (bad config vs bad input
+file vs HBM budget vs numeric-domain limit) instead of string-matching
+``ValueError``s.
+
+Every class also subclasses the builtin its guard historically raised
+(``ValueError`` / ``OSError``), so existing ``except ValueError``
+callers and tests keep working.
+"""
+
+
+class PeasoupError(Exception):
+    """Base class for all peasoup_tpu errors."""
+
+
+class ConfigError(PeasoupError, ValueError):
+    """Invalid or inconsistent :class:`SearchConfig` / CLI options
+    (empty DM list, bad subband mode, negative acc_step, ...)."""
+
+
+class InputFileError(PeasoupError, OSError, ValueError):
+    """Malformed or unreadable input file (SIGPROC header, zap/kill
+    lists, candidate binaries) — the reference's check_file_error.
+    Subclasses both ``OSError`` (its natural category) and
+    ``ValueError`` (what the sigproc guards historically raised)."""
+
+
+class HBMBudgetError(PeasoupError, ValueError):
+    """The requested search cannot fit the configured
+    ``hbm_budget_gb`` even after chunking (reference analogue: cudaMalloc
+    failure surfaced by check_cuda_error)."""
+
+
+class DomainError(PeasoupError, ValueError):
+    """Numerically out-of-domain request: the algorithm's validity
+    conditions do not hold for these parameters (e.g. the staircase
+    resampler's ``4*max_shift < n`` bound, f32-exact packing limits)."""
+
+
+class CheckpointError(PeasoupError, ValueError):
+    """Corrupt or torn checkpoint/resume state."""

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field, asdict
 
 import numpy as np
 
+from ..errors import InputFileError
 from .unpack import unpack_bits, pack_bits
 
 # SIGPROC header keys -> struct format. Matches the reference parser's
@@ -98,11 +99,11 @@ def read_sigproc_header(f) -> SigprocHeader:
     s = _read_string(f)
     if s != "HEADER_START":
         f.seek(start)
-        raise ValueError("not a SIGPROC file (missing HEADER_START)")
+        raise InputFileError("not a SIGPROC file (missing HEADER_START)")
     while True:
         key = _read_string(f)
         if key is None:
-            raise ValueError("unexpected EOF inside SIGPROC header")
+            raise InputFileError("unexpected EOF inside SIGPROC header")
         if key == "HEADER_END":
             break
         if key in _INT_KEYS:

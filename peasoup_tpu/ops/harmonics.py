@@ -124,7 +124,7 @@ def harmonic_sums(spectrum: jnp.ndarray, nharms: int) -> list[jnp.ndarray]:
 
     Three size/backend regimes, all bit-exact vs the numpy reference:
     gathers below 2^19 bins, the fused Pallas kernel on TPU (nharms <=
-    4; see :func:`_harmonic_sums_pallas`), the einsum path otherwise.
+    4; see :func:`_hsum_pallas_batched`), the einsum path otherwise.
     """
     if not 1 <= nharms <= 5:
         raise ValueError("nharms must be in 1..5")

@@ -25,6 +25,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..errors import DomainError
+
 SPEED_OF_LIGHT = 299792458.0
 
 
@@ -130,7 +132,7 @@ def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
         # only while |af|*n < 1 (i.e. 4*max_shift < n); beyond that
         # (extreme accel or tiny n) the tables would be silently wrong
         # without tripping the k1/step-density checks
-        raise ValueError(
+        raise DomainError(
             f"max_shift={max_shift} too large for n={n} "
             f"(needs 4*max_shift < n): the staircase bisection is only "
             f"valid for |af|*n < 1 — use the on-device resampler or a "
@@ -164,7 +166,7 @@ def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
     if int(k1.max(initial=0)) > max_shift:
         # enumerating only k = 1..max_shift would silently drop the
         # deeper steps AND under-pad the device slice starts
-        raise ValueError(
+        raise DomainError(
             f"true peak shift {int(k1.max())} exceeds max_shift="
             f"{max_shift}; pass a bound from resample2_max_shift() for "
             f"the largest |accel| in the batch"

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..errors import ConfigError, HBMBudgetError
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
@@ -316,8 +317,8 @@ def build_chunked_search(
     The table args are always required; with ``block=None`` they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
 
-    ``subband``: optional static (bounds, L1, n_anchor_p, slack,
-    slots, t_sub) —
+    ``subband``: optional static 8-tuple (bounds, L1, n_anchor_p,
+    slack, csub, t_sub, k_sub, dm_tile) —
     two-stage sub-band dedispersion (``_plan_subband_chunks``): three
     extra leading inputs follow the data parts, all dm-sharded —
     anchor_delays (n_anchor_p, nchans), assign (dm_chunk,), shifts
@@ -657,7 +658,7 @@ class MeshPulsarSearch(PulsarSearch):
 
         avail = budget - self._data_bytes()
         if avail <= 0:
-            raise ValueError(
+            raise HBMBudgetError(
                 f"filterbank alone ({self._data_bytes()/1e9:.1f} GB) "
                 f"exceeds hbm_budget_gb={cfg.hbm_budget_gb}"
             )
@@ -753,7 +754,7 @@ class MeshPulsarSearch(PulsarSearch):
         if mode == "never":
             return None
         if mode not in ("auto", "always"):
-            raise ValueError(
+            raise ConfigError(
                 f"subband_dedisp={mode!r}: use auto, always or never")
         from ..ops.dedisperse import subband_chunk_plan
         from ..ops.dedisperse_pallas import (
@@ -787,8 +788,22 @@ class MeshPulsarSearch(PulsarSearch):
             dm_pad, delays_p, self.delay_tab, cells,
             chan_align=chan_align, eps=cfg.subband_eps,
         )
-        if sbp is None:
+
+        def infeasible(reason):
+            # an explicitly requested mode must not silently degrade to
+            # the direct sweep; auto simply declines
+            if mode == "always":
+                raise ConfigError(
+                    f"subband_dedisp=always, but the two-stage plan is "
+                    f"infeasible for this search: {reason}")
+            if cfg.verbose:
+                print(f"sub-band dedispersion declined: {reason}")
             return None
+
+        if sbp is None:
+            return infeasible(
+                "no valid anchor decomposition (nchans not aligned, "
+                "non-ascending DM list, or negative stage-2 shifts)")
         if mode == "auto" and sbp["cost_ratio"] > 0.5:
             return None
         L1 = self.out_nsamps + sbp["shift_max"]
@@ -796,29 +811,60 @@ class MeshPulsarSearch(PulsarSearch):
         csub = sbp["bounds"][0][1] - sbp["bounds"][0][0]
         t_sub = k_sub = dm_tile_sub = None
         if use_pallas:
-            # stage-1 kernel geometry (dedisperse_pallas_flat_subband):
-            # K time tiles per window DMA, bounded by the
-            # double-buffered per-channel window scratch (~4.5 MB)
+            # stage-1 kernel geometry (dedisperse_pallas_flat_subband).
+            # Its VMEM footprint has three parts: the double-buffered
+            # (D, 1, K, 8, TQ) f32 out blocks (2*D*K*T*4 bytes — the
+            # dominant term once anchors pile up), the 2*chan_group
+            # window buffers of W1 ~ K*T samples each, and the
+            # (chan_group, 8, WQ) f32 accumulator.  Search (D, K)
+            # largest-first under a 14 MB budget so a large anchor
+            # count can never hit a Mosaic VMEM compile error (the
+            # direct kernel caps dm_tile at 32 for the same reason).
             G = plan["chan_group"]
             t_sub = plan["time_tile"]
             if L1 < t_sub:
-                return None
+                return infeasible(
+                    f"output too short for the stage-1 kernel window "
+                    f"({L1} < time_tile={t_sub})")
             itemsize = 1 if self.fil.header.nbits <= 8 else 4
-            k_sub = int(max(1, min(
-                4, (9 << 20) // (2 * csub * itemsize * t_sub))))
-            dm_tile_sub = n_anchor_p
-            anchor_tables = np.concatenate([
+            align = 1024 if itemsize == 1 else 256
+            # each device runs the kernel on ITS cell's n_anchor_p rows
+            # (blocked from row 0 at stride D), so the slack bound must
+            # be the max over per-cell tables — blocking one big
+            # concatenated table would misalign when D does not divide
+            # n_anchor_p and underestimate the window
+            cell_tables = [
                 delays_p[pad_rows] for pad_rows, _a, _s in sbp["per_cell"]
-            ])
-            slack = dedisperse_window_slack(
-                anchor_tables, dm_tile_sub, G)
+            ]
+            # dm tiles the kernel can keep SMEM-blocked: the whole
+            # anchor block (ntiles == 1) or sublane multiples of 8
+            for D in [n_anchor_p] + [
+                    d for d in (32, 24, 16, 8) if d < n_anchor_p]:
+                slack_d = max(
+                    int(dedisperse_window_slack(t, D, G))
+                    for t in cell_tables
+                )
+                WL = -(-(t_sub + slack_d + align) // align) * align
+                acc_b = G * 8 * (t_sub // 8 + slack_d + align) * 4
+                for K in (4, 3, 2, 1):
+                    W1 = -(-((K - 1) * t_sub + WL) // align) * align
+                    vmem = (2 * D * K * t_sub * 4
+                            + 2 * G * W1 * itemsize + acc_b)
+                    if vmem <= (14 << 20):
+                        dm_tile_sub, k_sub, slack = D, K, slack_d
+                        break
+                if k_sub is not None:
+                    break
+            if k_sub is None:
+                return infeasible(
+                    f"stage-1 kernel cannot fit VMEM even at "
+                    f"dm_tile=8, k_tiles=1 (chan_group={G}, "
+                    f"time_tile={t_sub}, slack={slack_d})")
             # slack + align: the sb kernel's per-kk aligned slices
             # round its window one alignment unit past the K*T formula
             pad_sub = dedisperse_flat_pad_to(
-                L1, self.max_delay,
-                slack + (1024 if self.fil.header.nbits <= 8 else 256),
-                k_sub * t_sub,
-                uint8=self.fil.header.nbits <= 8,
+                L1, self.max_delay, slack + align, k_sub * t_sub,
+                uint8=itemsize == 1,
             )
             # every flat part must hold whole sub-bands
             plan["part_align"] = max(2 * G, csub)

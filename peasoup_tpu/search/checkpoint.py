@@ -22,6 +22,7 @@ from dataclasses import asdict
 import numpy as np
 
 from ..data.candidates import Candidate
+from ..errors import CheckpointError
 
 # v3: append-only JSONL — header line then one line per completed DM
 # row, so each save is O(rows added) not O(all rows accumulated)
@@ -147,10 +148,10 @@ class SearchCheckpoint:
             # onto the header on the next append, so a newline-less
             # header means "no usable checkpoint" (overwritable)
             if lines and not lines[0].endswith("\n"):
-                raise ValueError("unterminated header line")
+                raise CheckpointError("unterminated header line")
             header = json.loads(lines[0]) if lines else None
             if not isinstance(header, dict):
-                raise ValueError("missing header line")
+                raise CheckpointError("missing header line")
         except Exception as exc:
             warnings.warn(
                 f"ignoring unreadable checkpoint {self.path!r}: {exc}"
@@ -180,7 +181,7 @@ class SearchCheckpoint:
                     # next append would merge two rows onto one line,
                     # so a missing terminator is torn regardless of
                     # parseability
-                    raise ValueError("unterminated final line")
+                    raise CheckpointError("unterminated final line")
                 row = json.loads(line)
                 out[int(row["dm_idx"])] = [
                     _cand_from_obj(o) for o in row["cands"]

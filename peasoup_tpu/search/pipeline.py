@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data.candidates import Candidate, CandidateCollection
+from ..errors import ConfigError
 from ..io.sigproc import Filterbank
 from ..ops import (
     dedisperse,
@@ -235,7 +236,7 @@ class PulsarSearch:
                 config.dm_tol,
             )
         if len(self.dm_list) == 0:
-            raise ValueError("empty DM trial list")
+            raise ConfigError("empty DM trial list")
         self.delay_tab = delay_table(fil.nchans, hdr.tsamp, hdr.fch1, hdr.foff)
         self.delays = delays_in_samples(self.dm_list, self.delay_tab)
         self.max_delay = max_delay(self.dm_list, self.delay_tab)
@@ -244,7 +245,7 @@ class PulsarSearch:
         self.tobs = self.size * hdr.tsamp
         self.bin_width = 1.0 / self.tobs
         if config.acc_step < 0:
-            raise ValueError(
+            raise ConfigError(
                 f"acc_step={config.acc_step} must be positive (the "
                 f"serial driver's grid steps upward from acc_start)"
             )
@@ -306,7 +307,7 @@ class PulsarSearch:
         if mode == "never":
             return None
         if mode not in ("auto", "always"):
-            raise ValueError(
+            raise ConfigError(
                 f"subband_dedisp={mode!r}: use auto, always or never")
         from ..ops.dedisperse import subband_plan
 

@@ -1,0 +1,87 @@
+"""Typed-exception layer (`peasoup_tpu/errors.py`) — one test per
+class, raised by the real guard sites (the reference's ErrorChecker
+pattern, `include/utils/exceptions.hpp:13-153`)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.errors import (
+    CheckpointError,
+    ConfigError,
+    DomainError,
+    HBMBudgetError,
+    InputFileError,
+    PeasoupError,
+)
+
+
+def test_config_error_on_empty_dm_list(tutorial_fil):
+    from peasoup_tpu.io.sigproc import read_filterbank
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(dm_list=np.zeros((0,), np.float32))
+    with pytest.raises(ConfigError):
+        PulsarSearch(fil, cfg)
+
+
+def test_input_file_error_on_non_sigproc_bytes():
+    from peasoup_tpu.io.sigproc import read_sigproc_header
+
+    with pytest.raises(InputFileError):
+        read_sigproc_header(io.BytesIO(b"this is not a sigproc header"))
+
+
+def test_hbm_budget_error_when_filterbank_exceeds_budget(tutorial_fil):
+    from peasoup_tpu.io.sigproc import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(
+        dm_list=np.array([0.0, 10.0], np.float32), hbm_budget_gb=1e-9,
+    )
+    search = MeshPulsarSearch(fil, cfg)
+    with pytest.raises(HBMBudgetError):
+        search._plan_chunking(search.acc_plan.max_trials(search.dm_list))
+
+
+def test_domain_error_on_out_of_domain_resample_shift():
+    from peasoup_tpu.ops.resample import resample2_tables
+
+    # 4*max_shift >= n: the staircase bisection's validity bound
+    with pytest.raises(DomainError):
+        resample2_tables(
+            np.array([500.0], np.float64), tsamp=6.4e-5, n=1024,
+            max_shift=512, block=128,
+        )
+
+
+def test_checkpoint_error_classified_as_torn(tmp_path):
+    """A newline-less header is torn: load() must treat the file as
+    unusable (warn + None) — the torn classification is the typed
+    CheckpointError raised internally."""
+    from peasoup_tpu.search.checkpoint import SearchCheckpoint
+
+    path = os.path.join(tmp_path, "ckpt.jsonl")
+    with open(path, "w") as f:
+        f.write('{"version": 3, "key": "k"}')  # no trailing newline
+    ck = SearchCheckpoint(path, key="k")
+    with pytest.warns(UserWarning, match="unterminated header"):
+        assert ck.load() is None
+
+
+def test_hierarchy_and_builtin_compat():
+    # every class is catchable as PeasoupError AND as the builtin its
+    # guard historically raised
+    assert issubclass(ConfigError, (PeasoupError, ValueError))
+    assert issubclass(DomainError, (PeasoupError, ValueError))
+    assert issubclass(HBMBudgetError, (PeasoupError, ValueError))
+    assert issubclass(CheckpointError, (PeasoupError, ValueError))
+    assert issubclass(InputFileError, PeasoupError)
+    assert issubclass(InputFileError, OSError)
+    assert issubclass(InputFileError, ValueError)
