@@ -74,17 +74,20 @@ def bench_hsum(iters):
     spec = jax.device_put(
         np.random.default_rng(0).normal(size=n).astype(np.float32)
     )
-    def step(s):
-        h = harmonic_sums(s, 4)
-        return s + 1e-12 * (h[0] + h[1] + h[2] + h[3])
-    t = time_op(step, spec, iters=iters)
-    # 4 levels read the spectrum at stretched indices + write each sum
-    traffic = 9 * n * 4
-    return [{"metric": "harmonic_sum_1e7_4levels",
-             "value": round(t * 1e3, 3), "unit": "ms",
-             "GBps": round(_gbps(traffic, t), 1),
-             "hbm_util_pct": round(100 * _gbps(traffic, t) / V5E_HBM_GBPS,
-                                   1)}]
+    out = []
+    for nh in (4, 5):
+        def step(s, nh=nh):
+            h = harmonic_sums(s, nh)
+            return s + 1e-12 * sum(h)
+        t = time_op(step, spec, iters=iters)
+        # nh levels read the spectrum at stretched indices + write sums
+        traffic = (2 * nh + 1) * n * 4
+        out.append({"metric": f"harmonic_sum_1e7_{nh}levels",
+                    "value": round(t * 1e3, 3), "unit": "ms",
+                    "GBps": round(_gbps(traffic, t), 1),
+                    "hbm_util_pct": round(
+                        100 * _gbps(traffic, t) / V5E_HBM_GBPS, 1)})
+    return out
 
 
 def bench_resample(iters):

@@ -123,15 +123,16 @@ def harmonic_sums(spectrum: jnp.ndarray, nharms: int) -> list[jnp.ndarray]:
     level k sums 2^k harmonics and is scaled by 1/sqrt(2^k).
 
     Three size/backend regimes, all bit-exact vs the numpy reference:
-    gathers below 2^19 bins, the fused Pallas kernel on TPU (nharms <=
-    4; see :func:`_hsum_pallas_batched`), the einsum path otherwise.
+    gathers below 2^19 bins, the fused Pallas kernel on TPU (all 5
+    levels; see :func:`_hsum_pallas_batched`), the einsum path
+    otherwise.
     """
     if not 1 <= nharms <= 5:
         raise ValueError("nharms must be in 1..5")
     size = spectrum.shape[0]
     if size <= _GATHER_MAX_SIZE:
         return _harmonic_sums_gather(spectrum, nharms)
-    if nharms <= 4 and _on_tpu():
+    if _on_tpu():
         return list(_pallas_hsum_fn(nharms)(spectrum))
     return _harmonic_sums_einsum(spectrum, nharms)
 
@@ -204,9 +205,17 @@ def _harmonic_sums_gather(spectrum: jnp.ndarray,
 #
 # vs the einsum path this cuts HBM traffic ~4x (no materialised Wb
 # stacks) and MXU work 2x (128- not 256-contraction): measured on v5e
-# at 10^7 bins: 1.62 ms vs 3.9 ms (2.4x), bit-exact.  The ~1 ms floor
-# is the 2x window DMA (see the v2 note below).  nharms=5 falls back
-# to the einsum path: level 5 alone is 512 unrolled dots per tile.
+# at 10^7 bins (r5 session, benchmarks/micro_results.json): 3.55 ms vs
+# 6.44 ms einsum (1.8x) at nharms=4; 5.45 ms vs 13.4 ms (2.45x) at
+# nharms=5, bit-exact at every level.  (An earlier 1.62 ms claim here
+# did not reproduce on re-measurement and is superseded by the
+# committed artifact.)  Two re-formulations measured SLOWER the same
+# session: concatenating the 3 bf16 limbs into one (3T,128) dot per
+# rho (3.89 ms — the concat relayout beats the saved dot issues) and
+# TR=2048 (VMEM overflow, Mosaic compile failure).  The remaining gap
+# to the ~0.6 ms HBM roofline is the serialised per-stretch
+# wait(window DMA) -> VMEM shift copy -> compute chain; the window
+# DMAs themselves are double-buffered.
 _TR = 1024  # output rows per grid step (TR=2048 overflows 16M VMEM)
 
 

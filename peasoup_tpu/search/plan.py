@@ -80,7 +80,7 @@ class SearchConfig:
     # overview.xml's <execution_times> is non-degenerate (the mesh
     # programs fuse dedispersion into the search dispatch, so the
     # per-stage number otherwise does not exist); costs one extra
-    # dedisp execution — the CLI turns it on, benchmarks leave it off
+    # dedisp execution — opt in via the CLI's --measure_stages flag
     measure_stages: bool = False
     # persistent buffer auto-tuning (search/tuning.py): a successful
     # run records its peak-count high-waters here so the next run of

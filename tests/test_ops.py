@@ -615,3 +615,23 @@ def test_harmonic_sums_pallas_exact_interpret():
         np.testing.assert_array_equal(
             np.asarray(batched[k]), want,
             err_msg=f"level {k+1}: vmapped pallas mismatch")
+
+
+def test_harmonic_sums_pallas_nharms5_exact_interpret():
+    """nharms=5 on the kernel path (level 5's 16 odd stretches share
+    the level-4 accumulator, 32 residue classes per stretch) must be
+    bit-identical with the gather formulation."""
+    from peasoup_tpu.ops.harmonics import (
+        _harmonic_sums_gather,
+        _pallas_hsum_fn,
+    )
+
+    n = (1 << 19) + 1017
+    spec = rng.normal(size=n).astype(np.float32)
+    ours = _pallas_hsum_fn(5, interpret=True)(jnp.asarray(spec))
+    golden = _harmonic_sums_gather(jnp.asarray(spec), 5)
+    assert len(ours) == 5
+    for k, (a, b) in enumerate(zip(ours, golden), 1):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"level {k}: pallas vs gather mismatch")
