@@ -47,11 +47,29 @@ def phase_bins(nsamps: int, period, tsamp, nbins: int) -> jnp.ndarray:
 def fold_time_series_core(
     tim: jnp.ndarray, period, tsamp, nbins: int = 64, nints: int = 16
 ) -> jnp.ndarray:
-    """Fold a time series into an (nints, nbins) sub-integration profile."""
+    """Fold a time series into an (nints, nbins) sub-integration profile.
+
+    On TPU the scatter-add is reformulated as a one-hot matmul: each
+    sub-integration is a CONTIGUOUS block of ``nper`` samples, so the
+    (used -> nints*nbins) scatter is block-diagonal and becomes a
+    batched (nints, nper) x (nints, nper, nbins) contraction — MXU
+    work instead of a serialised scatter (measured on v5e at 2^17
+    samples x 10 candidates with per-candidate periods: 0.17 ms vs
+    23.3 ms for the vmapped segment_sum, the whole fold stage's
+    dominant device cost).  The 0/1 one-hot is exact in one bf16 limb
+    (DEFAULT precision); the data operand uses the 3-limb HIGHEST
+    decomposition, so each product is exact and only the f32
+    accumulation order differs from the sequential scatter (the
+    reference's atomicAdd order is arbitrary too, `src/kernels.cu:
+    597-651`)."""
+    from .harmonics import _on_tpu
+
     nsamps = tim.shape[0]
     nper = nsamps // nints
     used = nper * nints
     binidx = phase_bins(used, period, tsamp, nbins)
+    if _on_tpu():
+        return _fold_onehot(tim[:used], binidx, nbins, nints)
     subint = (jnp.arange(used, dtype=jnp.int32) // nper).astype(jnp.int32)
     flat = subint * nbins + binidx
     sums = jax.ops.segment_sum(tim[:used], flat, num_segments=nints * nbins)
@@ -60,6 +78,28 @@ def fold_time_series_core(
     )
     prof = sums / (counts + 1.0)  # reference counter starts at 1
     return prof.reshape(nints, nbins).astype(jnp.float32)
+
+
+def _fold_onehot(tim, binidx, nbins: int, nints: int) -> jnp.ndarray:
+    """One-hot matmul fold (the TPU branch of
+    :func:`fold_time_series_core`); works on any backend."""
+    nper = tim.shape[0] // nints
+    bi = binidx.reshape(nints, nper)
+    onehot = (
+        bi[:, :, None] == jnp.arange(nbins, dtype=jnp.int32)
+    ).astype(jnp.bfloat16)
+    xm = tim.reshape(nints, nper).astype(jnp.float32)
+    sel_prec = (jax.lax.Precision.HIGHEST, jax.lax.Precision.DEFAULT)
+    sums = jnp.einsum(
+        "ip,ipb->ib", xm, onehot, precision=sel_prec,
+        preferred_element_type=jnp.float32,
+    )
+    counts = jnp.einsum(
+        "ip,ipb->ib", jnp.ones_like(xm), onehot, precision=sel_prec,
+        preferred_element_type=jnp.float32,
+    )
+    prof = sums / (counts + 1.0)  # reference counter starts at 1
+    return prof.astype(jnp.float32)
 
 
 fold_time_series = jax.jit(

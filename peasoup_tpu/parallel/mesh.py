@@ -145,6 +145,7 @@ def build_fused_search(
     compact_k: int,
     max_shift: int | None = None,
     block: int | None = None,
+    dedisp_pallas: tuple | None = None,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -178,6 +179,13 @@ def build_fused_search(
     birdies, widths)``.  The table args are always required; when
     ``block`` is None (legacy on-device resampler path) they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
+
+    ``dedisp_pallas``: optional static (dm_tile, time_tile, slack,
+    pad_to, max_delay) from ``_plan_fused_pallas_dedisp`` — replaces
+    the XLA channel-scan sweep with the flat Pallas kernel on the
+    uint8 data (measured 2.1 ms vs 46 ms at tutorial scale on v5e;
+    the vmapped dynamic_slice lowers to a batched gather).  Requires
+    per-shard DM rows divisible by dm_tile and nbits <= 8.
     """
     from ..ops.unpack import unpack_bits_device
 
@@ -187,14 +195,33 @@ def build_fused_search(
     def shard_fn(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
                  birdies, widths):
         vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
-        data = vals.reshape(nsamps, nchans).T.astype(jnp.float32)
-        if use_killmask:
-            data = data * killmask[:, None]
         # full-width trials are returned for the folding phase (which
         # must see prev_power_of_two(out_nsamps) real samples exactly
         # like the single-device path, `folder.hpp:352-406`); the
         # search itself runs on the fft-size-truncated/padded view
-        trials = dedisperse(data, delays, out_nsamps)
+        if dedisp_pallas is not None:
+            from ..ops.dedisperse_pallas import dedisperse_pallas_flat
+
+            dd_tile, dd_T, dd_slack, dd_pad, dd_maxdelay = dedisp_pallas
+            # true uint8 (unpack yields int32): the kernel's flat
+            # buffer needs the u8 1024-element tiling
+            data8 = vals.astype(jnp.uint8).reshape(nsamps, nchans).T
+            if use_killmask:
+                data8 = jnp.where(
+                    killmask[:, None] > 0, data8,
+                    jnp.zeros((), data8.dtype))
+            flat = jnp.pad(
+                data8, ((0, 0), (0, dd_pad - nsamps))).reshape(-1)
+            trials = dedisperse_pallas_flat(
+                [flat], delays, dd_pad, out_nsamps,
+                window_slack=dd_slack, dm_tile=dd_tile,
+                time_tile=dd_T, chan_group=16, max_delay=dd_maxdelay,
+            )
+        else:
+            data = vals.reshape(nsamps, nchans).T.astype(jnp.float32)
+            if use_killmask:
+                data = data * killmask[:, None]
+            trials = dedisperse(data, delays, out_nsamps)
         if out_nsamps >= size:
             trials_sz = trials[:, :size]
         else:
@@ -248,6 +275,9 @@ def build_fused_search(
             P(), P(), P(), P(), P(),
         ),
         out_specs=(P("dm"), P("dm", None)),
+        # pallas_call out_shapes carry no varying-mesh-axes annotation
+        # (same waiver as build_chunked_search)
+        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -497,6 +527,52 @@ class MeshPulsarSearch(PulsarSearch):
         ndm = len(self.dm_list)
         return int(np.ceil(ndm / self.ndev)) * self.ndev
 
+    def _plan_fused_pallas_dedisp(self) -> dict | None:
+        """Flat Pallas-kernel dedispersion for the FUSED path.
+
+        The XLA channel-scan sweep (``ops.dedisperse.dedisperse``)
+        lowers its vmapped dynamic_slice to a batched gather: 46 ms at
+        tutorial scale (59 rows x 64 chans x 2^17) on v5e where the
+        flat kernel runs 2.1 ms, bit-exact.  Only for <=8-bit inputs
+        (the kernel's in-program flat buffer needs the uint8 1024-
+        element tiling; an f32 reshape gets a mismatched layout) and
+        TPU.  Returns {ndm_p, params} or None; ndm_p is widened so
+        every shard's rows divide dm_tile.
+        """
+        if self.mesh.devices.flat[0].platform != "tpu":
+            return None
+        if self.fil.header.nbits > 8 or self.fil.nchans % 32:
+            return None
+        if self.killmask is not None and not np.isin(
+                self.killmask, (0.0, 1.0)).all():
+            # the uint8 branch gates channels with where(mask > 0);
+            # only a strict 0/1 mask matches the f32 multiply semantics
+            return None
+        T = 15360
+        if self.out_nsamps < T:
+            return None
+        dm_tile, G = 8, 16
+        from ..ops.dedisperse_pallas import (
+            dedisperse_flat_pad_to,
+            dedisperse_window_slack,
+        )
+
+        ndm = len(self.dm_list)
+        step = self.ndev * dm_tile
+        ndm_p = -(-ndm // step) * step
+        # edge-pad (matches _device_inputs): zero-delay pad rows next
+        # to max-delay rows would explode the slack bound
+        delays_p = np.empty((ndm_p, self.fil.nchans), np.int32)
+        delays_p[:ndm] = self.delays
+        delays_p[ndm:] = self.delays[-1]
+        slack = int(dedisperse_window_slack(delays_p, dm_tile, G))
+        pad_to = dedisperse_flat_pad_to(
+            self.out_nsamps, self.max_delay, slack, T, uint8=True)
+        return dict(
+            ndm_p=ndm_p,
+            params=(dm_tile, T, slack, pad_to, self.max_delay),
+        )
+
     def _tune_scoped_key(self, driver: str) -> str:
         """Tune-sidecar key including mesh geometry: the recorded
         high-waters are per-SHARD quantities (and fused/chunked count
@@ -579,8 +655,13 @@ class MeshPulsarSearch(PulsarSearch):
         accs = np.full((ndm_p, namax), np.nan, np.float32)
         for i, a in enumerate(acc_lists):
             accs[i, : len(a)] = a
-        delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
+        # edge-pad the DM rows (their accel slots are NaN, so they
+        # emit nothing): zero-delay pad rows would sit next to
+        # max-delay rows in the Pallas kernel's last dm_tile block and
+        # explode its window-slack bound
+        delays = np.empty((ndm_p, self.fil.nchans), np.int32)
         delays[:ndm] = self.delays
+        delays[ndm:] = self.delays[-1] if ndm else 0
         killmask = (
             self.killmask
             if self.killmask is not None
@@ -645,6 +726,11 @@ class MeshPulsarSearch(PulsarSearch):
         budget = int(cfg.hbm_budget_gb * 1e9)
         ndm = len(self.dm_list)
         ndm_local = int(np.ceil(ndm / self.ndev))
+        if self._plan_fused_pallas_dedisp() is not None:
+            # the fused path widens the per-shard rows to a dm_tile
+            # multiple (Pallas dedispersion); budget the rows it will
+            # actually run, not the narrower pre-widening count
+            ndm_local = -(-ndm_local // 8) * 8
         est_full = (
             self._SPECTRUM_BYTES * ndm_local * namax * self.size
             + 8 * ndm_local * self.out_nsamps
@@ -1579,6 +1665,13 @@ class MeshPulsarSearch(PulsarSearch):
                 "honour it"
             )
         nlevels = cfg.nharmonics + 1
+        # Pallas-kernel dedispersion inside the fused program: needs DM
+        # rows divisible by dm_tile per shard, so the row padding
+        # widens before the device inputs are built
+        dd_pallas = self._plan_fused_pallas_dedisp()
+        if dd_pallas is not None:
+            ndm_p = dd_pallas["ndm_p"]
+            ndm_local = ndm_p // ndev
         # capacity auto-tune: a previous run on this object observed the
         # true per-spectrum high-water count, so later runs shrink the
         # per-spectrum top_k (its cost scales with k on v5e); overflow
@@ -1632,6 +1725,9 @@ class MeshPulsarSearch(PulsarSearch):
                 compact_k=ck,
                 max_shift=self.max_shift,
                 block=self.resample_block,
+                dedisp_pallas=(
+                    dd_pallas["params"] if dd_pallas is not None else None
+                ),
             )
 
         while True:

@@ -930,12 +930,19 @@ def fold_candidates(
     # 2^22 fold samples measures ~72 B/samp marginal per candidate
     # (0.30 GB each); 96 B/samp adds margin.  (The earlier 10-wide OOM
     # at production scale was the chunk executables' retained arenas —
-    # now freed before folding — plus this chain.)  At tutorial scale
-    # this folds every candidate in ONE dispatch — each extra dispatch
-    # costs a ~0.11 s host round-trip on the remote-attached TPU.
+    # now freed before folding — plus this chain.)  On TPU the one-hot
+    # matmul fold adds a live (nints, nper, nbins) bf16 operand —
+    # 2*nbins B/samp per candidate — on top of that chain.  At tutorial
+    # scale this still folds every candidate in ONE dispatch — each
+    # extra dispatch costs a ~0.11 s host round-trip on the
+    # remote-attached TPU.
+    from ..ops.harmonics import _on_tpu
+
     n = len(fold_ids)
+    bytes_per_samp = 96 + (2 * nbins + 32 if _on_tpu() else 0)
     if hbm_free_bytes is not None:
-        batch = int(max(1, min(n, hbm_free_bytes // (96 * nsamps))))
+        batch = int(max(1, min(
+            n, hbm_free_bytes // (bytes_per_samp * nsamps))))
     else:
         batch = 4  # conservative when the caller gives no HBM figure
     argmaxes = np.empty(n, np.int64)
