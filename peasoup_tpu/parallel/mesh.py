@@ -39,6 +39,7 @@ from ..errors import ConfigError, HBMBudgetError
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
+    quantise_trials_u8,
     split_flat_channels,
 )
 from ..search.pipeline import (
@@ -146,6 +147,7 @@ def build_fused_search(
     max_shift: int | None = None,
     block: int | None = None,
     dedisp_pallas: tuple | None = None,
+    quantise: bool = False,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -222,6 +224,8 @@ def build_fused_search(
             if use_killmask:
                 data = data * killmask[:, None]
             trials = dedisperse(data, delays, out_nsamps)
+        if quantise:  # trial_nbits=8: dedisp's uint8 lattice
+            trials = quantise_trials_u8(trials, nbits, nchans)
         if out_nsamps >= size:
             trials_sz = trials[:, :size]
         else:
@@ -313,6 +317,7 @@ def build_chunked_search(
     block: int | None = None,
     n_parts: int = 1,
     subband: tuple | None = None,
+    quantise_nbits: int = 0,
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -431,6 +436,9 @@ def build_chunked_search(
             else:
                 trials = dedisperse_flat(
                     parts, delays_c, nsamps_dev, out_nsamps)
+            if quantise_nbits:  # trial_nbits=8: dedisp's u8 lattice
+                trials = quantise_trials_u8(
+                    trials, quantise_nbits, nchans)
             if out_nsamps >= size:
                 trials_sz = trials[:, :size]
             else:
@@ -537,8 +545,16 @@ class MeshPulsarSearch(PulsarSearch):
         (the kernel's in-program flat buffer needs the uint8 1024-
         element tiling; an f32 reshape gets a mismatched layout) and
         TPU.  Returns {ndm_p, params} or None; ndm_p is widened so
-        every shard's rows divide dm_tile.
+        every shard's rows divide dm_tile.  Cached on the search
+        object (the slack scan is O(ndm_p x nchans) host work and the
+        inputs are fixed per search).
         """
+        if "_dd_pallas_plan" in self.__dict__:
+            return self._dd_pallas_plan
+        self._dd_pallas_plan = self._plan_fused_pallas_dedisp_uncached()
+        return self._dd_pallas_plan
+
+    def _plan_fused_pallas_dedisp_uncached(self) -> dict | None:
         if self.mesh.devices.flat[0].platform != "tpu":
             return None
         if self.fil.header.nbits > 8 or self.fil.nchans % 32:
@@ -726,11 +742,12 @@ class MeshPulsarSearch(PulsarSearch):
         budget = int(cfg.hbm_budget_gb * 1e9)
         ndm = len(self.dm_list)
         ndm_local = int(np.ceil(ndm / self.ndev))
-        if self._plan_fused_pallas_dedisp() is not None:
+        dd = self._plan_fused_pallas_dedisp()
+        if dd is not None:
             # the fused path widens the per-shard rows to a dm_tile
             # multiple (Pallas dedispersion); budget the rows it will
             # actually run, not the narrower pre-widening count
-            ndm_local = -(-ndm_local // 8) * 8
+            ndm_local = dd["ndm_p"] // self.ndev
         est_full = (
             self._SPECTRUM_BYTES * ndm_local * namax * self.size
             + 8 * ndm_local * self.out_nsamps
@@ -1092,7 +1109,8 @@ class MeshPulsarSearch(PulsarSearch):
                         list(fs), d, nsamps_dev, self.out_nsamps)
                 )
             cache[dm_tile] = fn
-        return fn(jnp.asarray(delays_rows), *data_parts)
+        return self._maybe_quantise(
+            fn(jnp.asarray(delays_rows), *data_parts))
 
     def _fold_trials_provider(self, dm_idxs):
         """Re-dedisperse just the candidate DM rows for folding (the
@@ -1216,6 +1234,10 @@ class MeshPulsarSearch(PulsarSearch):
                      sb["slack"], sb["csub"], sb["t_sub"],
                      sb["k_sub"], sb["dm_tile_sub"])
                     if sb is not None else None
+                ),
+                quantise_nbits=(
+                    self.fil.header.nbits
+                    if cfg.trial_nbits == 8 else 0
                 ),
             )
 
@@ -1630,7 +1652,8 @@ class MeshPulsarSearch(PulsarSearch):
                 )
             else:
                 trials = (
-                    self.dedisperse_sharded() if cfg.npdmp > 0 else None
+                    self._maybe_quantise(self.dedisperse_sharded())
+                    if cfg.npdmp > 0 else None
                 )
                 result = self._finalise(dm_cands, trials, timers, t_total)
             ckpt.remove()
@@ -1728,6 +1751,7 @@ class MeshPulsarSearch(PulsarSearch):
                 dedisp_pallas=(
                     dd_pallas["params"] if dd_pallas is not None else None
                 ),
+                quantise=cfg.trial_nbits == 8,
             )
 
         while True:

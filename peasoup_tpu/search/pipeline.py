@@ -244,6 +244,15 @@ class PulsarSearch:
         self.size = config.size or prev_power_of_two(fil.nsamps)
         self.tobs = self.size * hdr.tsamp
         self.bin_width = 1.0 / self.tobs
+        if config.trial_nbits not in (8, 32):
+            raise ConfigError(
+                f"trial_nbits={config.trial_nbits}: use 32 (f32 sums, "
+                f"default) or 8 (dedisp's uint8 lattice)")
+        if config.trial_nbits == 8 and hdr.nbits > 8:
+            raise ConfigError(
+                "trial_nbits=8 needs an integer (<=8-bit) input "
+                "filterbank: dedisp's scale uses the input dynamic "
+                "range (dedisperser.hpp:104-112)")
         if config.acc_step < 0:
             raise ConfigError(
                 f"acc_step={config.acc_step} must be positive (the "
@@ -330,12 +339,24 @@ class PulsarSearch:
 
             if km is not None:
                 data = data * km[:, None]
-            return dedisperse_subband(
+            trials = dedisperse_subband(
                 data, jnp.asarray(self.delays), plan, self.out_nsamps)
-        trials = dedisperse(
-            data, jnp.asarray(self.delays), self.out_nsamps, km
-        )
-        return trials
+        else:
+            trials = dedisperse(
+                data, jnp.asarray(self.delays), self.out_nsamps, km
+            )
+        return self._maybe_quantise(trials)
+
+    def _maybe_quantise(self, trials: jax.Array) -> jax.Array:
+        """Opt-in uint8 trial lattice (``trial_nbits=8``), exactly as
+        dedisp_execute's out_nbits=8 quantises (`dedisperser.hpp:
+        104-112`)."""
+        if self.config.trial_nbits != 8:
+            return trials
+        from ..ops.dedisperse import quantise_trials_u8
+
+        return quantise_trials_u8(
+            trials, self.fil.header.nbits, self.fil.nchans)
 
     def _trial_tim(self, trials: jax.Array, idx: int) -> jax.Array:
         if self.out_nsamps >= self.size:

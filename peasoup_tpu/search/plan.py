@@ -59,6 +59,14 @@ class SearchConfig:
     # overrides the generated dm_start/dm_end/dm_tol grid.
     dm_list: object = None
     dm_file: str = ""
+    # dedispersed-trial sample format.  The reference's dedisp call
+    # quantises every trial to uint8 (`dedisperser.hpp:104-112`,
+    # out_nbits=8); this build keeps f32 sums by default (strictly
+    # more information — documented deviation, ops/dedisperse.py).
+    # trial_nbits=8 opts in to a dedisp-style uint8 lattice
+    # (ops.dedisperse.quantise_trials_u8) for sensitivity studies —
+    # NOT tighter golden parity; see the NOTE on quantise_trials_u8.
+    trial_nbits: int = 32
     # TPU-build extras (no reference equivalent)
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step

@@ -489,3 +489,51 @@ def test_dedisperse_pallas_flat_subband_kernel():
                                 out_nsamps, killmask=mask)
         np.testing.assert_array_equal(got[:, s], want,
                                       err_msg=f"sub-band {s}")
+
+
+def test_quantise_trials_u8_lattice():
+    """dedisp out_nbits=8 reconstruction: scale to the output range,
+    clip, truncate (`dedisperser.hpp:104-112`)."""
+    from peasoup_tpu.ops.dedisperse import quantise_trials_u8
+
+    trials = jnp.asarray([[0.0, 96.0, 192.0, 500.0]], jnp.float32)
+    got = np.asarray(quantise_trials_u8(trials, 2, 64))
+    # scale = 255 / (3 * 64): 96 -> 127.5 -> 127; 192 -> 255; clip 500
+    np.testing.assert_array_equal(got, [[0.0, 127.0, 255.0, 255.0]])
+    assert got.dtype == np.float32
+
+
+def test_trial_nbits8_e2e_recovers_fundamental(tutorial_fil):
+    """The opt-in uint8 trial lattice must still recover the strongest
+    golden family (quantisation legitimately perturbs near-tie DM
+    associations, so only the physics is asserted, not the exact
+    golden rows — see ops/dedisperse.py)."""
+    from peasoup_tpu.io.sigproc import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(
+        dm_start=0.0, dm_end=250.0, acc_start=-5.0, acc_end=5.0,
+        acc_pulse_width=64000.0, nharmonics=4, npdmp=2, limit=50,
+        trial_nbits=8,
+    )
+    r = MeshPulsarSearch(fil, cfg).run()
+    top = r.candidates[0]
+    assert 1.0 / top.freq == pytest.approx(0.24994, rel=1e-3)
+    assert top.snr == pytest.approx(86.96, rel=0.02)
+    assert top.folded_snr > 30
+
+
+def test_trial_nbits8_requires_integer_input(tutorial_fil):
+    from peasoup_tpu.errors import ConfigError
+    from peasoup_tpu.io.sigproc import read_filterbank
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    fil.header.nbits = 32
+    with pytest.raises(ConfigError):
+        PulsarSearch(fil, SearchConfig(trial_nbits=8))
+    with pytest.raises(ConfigError):
+        PulsarSearch(fil, SearchConfig(trial_nbits=16))

@@ -88,9 +88,11 @@ def test_folded_snr_parity(result):
         )
         assert c is not None
         assert c.opt_period == pytest.approx(opt_period, rel=1e-4)
-        # folded S/N is more sensitive to the uint8-vs-float32 trial
-        # difference; 3% tolerance
-        assert c.folded_snr == pytest.approx(fsnr, rel=0.03)
+        # measured agreement with f32 trials is <= 0.5% on every golden
+        # candidate (r5 session) — the historical 3% bar blamed the
+        # reference's uint8 trial quantisation, but the f32 pipeline
+        # matches its folded S/N to well under 1%, so 1% it is
+        assert c.folded_snr == pytest.approx(fsnr, rel=0.01)
 
 
 def test_scoring_flags(result):
