@@ -222,8 +222,8 @@ def main(argv=None):
             _, ckq = getattr(
                 search, "_chunk_buffer_shapes",
                 (cfg.peak_capacity, nspec * cfg.peak_capacity))
-            # packed layout: 3*compact_k + 2*nspec + 2 f32 per shard
-            transfer_s = n_chunks * ((3 * ckq + 2 * nspec) * 4) / 35e6
+            # packed layout: 3*compact_k + 4*nspec + 2 f32 per shard
+            transfer_s = n_chunks * ((3 * ckq + 4 * nspec) * 4) / 35e6
         model = {
             "n_accel_trials": n_trials,
             "per_accel_trial_ms": round(per_accel, 2),

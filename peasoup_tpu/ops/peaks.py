@@ -128,15 +128,18 @@ def extract_top_peaks(
         masked = body
     count = jnp.sum(masked > thresh, dtype=jnp.int32)
     C = _TWO_STAGE_ROW_WIDTH
-    if stop_idx > max(_TWO_STAGE_MIN_SIZE, k_eff * C):
+    R = -(-stop_idx // C)
+    if stop_idx > _TWO_STAGE_MIN_SIZE and k_eff < R:
         # two-stage by value: top-k_eff rows by row-max provably
         # contain the k_eff largest values (see docstring)
-        R = -(-stop_idx // C)
         m2 = jnp.pad(masked, (0, R * C - stop_idx),
                      constant_values=neg).reshape(R, C)
         _, rows = jax.lax.top_k(jnp.max(m2, axis=1), k_eff)
         top, ti_local = jax.lax.top_k(m2[rows].reshape(-1), k_eff)
         ti = rows[ti_local // C] * C + ti_local % C
+    elif stop_idx > _TWO_STAGE_MIN_SIZE:
+        # k_eff >= R: row selection cannot help; exact single top_k
+        top, ti = jax.lax.top_k(masked, k_eff)
     else:
         top, ti = jax.lax.approx_max_k(masked, k_eff, recall_target=1.0)
     hit = top > thresh

@@ -74,7 +74,20 @@ from functools import lru_cache
 def _compact_peaks(idxs, snrs, counts, compact_k):
     """Shared device-side tail of both fused programs: compact all
     (dm, accel, level) peak buffers of a shard into one packed f32
-    buffer (layout documented in :func:`build_fused_search`)."""
+    buffer (layout documented in :func:`build_fused_search`).
+
+    Ships BOTH the true above-threshold ``counts`` (escalation sizing)
+    and the per-spectrum DELIVERED slot counts (= how many valid
+    entries each spectrum actually contributed to the stream).  The
+    host segments the stream by ``delivered``, so a device-side
+    extraction anomaly (a backend bug under-filling a top-k buffer)
+    can never desynchronise the (dm, accel, level) attribution of
+    later spectra — it surfaces as ``delivered < min(count, cap)`` on
+    the affected spectrum, which the drivers re-search like any
+    clipped row."""
+    ns = counts.reshape(-1).shape[0]
+    delivered = jnp.sum(
+        (idxs >= 0).reshape(ns, -1), axis=1, dtype=jnp.int32)
     flat_bin = idxs.reshape(-1)
     flat_snr = snrs.reshape(-1)
     n = flat_bin.shape[0]
@@ -119,6 +132,8 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
         sel_snr,
         (counts_f // 65536).astype(jnp.float32),
         (counts_f % 65536).astype(jnp.float32),
+        (delivered // 65536).astype(jnp.float32),
+        (delivered % 65536).astype(jnp.float32),
         (nvalid // 65536).astype(jnp.float32),
         (nvalid % 65536).astype(jnp.float32),
     ])
@@ -169,8 +184,11 @@ def build_fused_search(
     * ``[0:k]`` / ``[k:2k]``      bin index hi / lo halves
     * ``[2k:3k]``                 SNR values (f32)
     * ``[3k:3k+ns]`` / ``+2ns``   per-spectrum above-threshold count
-      hi / lo halves (overflow check + the key to reconstructing each
-      entry's (dm, accel, level) tag)
+      hi / lo halves (overflow check / escalation sizing)
+    * ``+2ns:+4ns``               per-spectrum DELIVERED slot count
+      hi / lo halves — the key to reconstructing each entry's
+      (dm, accel, level) tag; derived from the same buffers the
+      compaction scatters, so host segmentation can never desync
     * ``[-2]`` / ``[-1]``         true total valid count hi / lo
 
     plus ``trials`` (ndm_local, out_nsamps) f32 — full-width, staying
@@ -1466,13 +1484,14 @@ class MeshPulsarSearch(PulsarSearch):
         ndev = self.ndev
         nspec_local = ndm_local * namax * nlevels
         # layout: bin_hi | bin_lo | sel_snr | counts_hi | counts_lo |
-        # nvalid_hi | nvalid_lo — every int travels as two 16-bit
-        # halves in plain f32 (exact at any int32 spectrum length), see
-        # _compact_peaks
-        blk_len = 3 * compact_k + 2 * nspec_local + 2
+        # delivered_hi | delivered_lo | nvalid_hi | nvalid_lo — every
+        # int travels as two 16-bit halves in plain f32 (exact at any
+        # int32 spectrum length), see _compact_peaks
+        blk_len = 3 * compact_k + 4 * nspec_local + 2
         sel_bin = np.empty(ndev * compact_k, np.int64)
         sel_snr = np.empty(ndev * compact_k, np.float32)
         counts = np.empty((ndev * ndm_local, namax, nlevels), np.int64)
+        delivered = np.empty(ndev * nspec_local, np.int64)
         nvalid = np.empty(ndev, np.int64)
         for sidx in range(ndev):
             blk = packed[sidx * blk_len : (sidx + 1) * blk_len]
@@ -1489,34 +1508,59 @@ class MeshPulsarSearch(PulsarSearch):
                 + blk[c0 + nspec_local : c0 + 2 * nspec_local]
                 .astype(np.int64)
             ).reshape(ndm_local, namax, nlevels)
+            c1 = c0 + 2 * nspec_local
+            delivered[sidx * nspec_local : (sidx + 1) * nspec_local] = (
+                blk[c1 : c1 + nspec_local].astype(np.int64) * 65536
+                + blk[c1 + nspec_local : c1 + 2 * nspec_local]
+                .astype(np.int64)
+            )
             nvalid[sidx] = int(blk[-2]) * 65536 + int(blk[-1])
 
         # reconstruct each entry's (dm_local, accel, level) tag from
-        # counts (the device compaction keeps valid slots in flat
-        # spectrum order), then run the unique-peak merge over ALL
-        # spectra in one native segmented call per shard
+        # the per-spectrum DELIVERED counts (the device compaction
+        # keeps valid slots in flat spectrum order, and delivered is
+        # derived from the same buffers the scatter read — so the
+        # segmentation can never desynchronise even if a device-side
+        # extraction anomaly under-fills a buffer), then run the
+        # unique-peak merge over ALL spectra in one native segmented
+        # call per shard
         factors = np.array([b[2] for b in self.bounds])
         per_dm_groups: dict[int, tuple] = {}
         clipped_rows: set[int] = set()
         truncated_rows: set[int] = set()
         for s in range(ndev):
             shard_counts = counts[s * ndm_local : (s + 1) * ndm_local]
-            k = np.minimum(shard_counts, cap).reshape(-1)
+            expect = np.minimum(shard_counts, cap).reshape(-1)
+            k = delivered[s * nspec_local : (s + 1) * nspec_local]
             seg_bounds = np.minimum(
                 np.concatenate([[0], np.cumsum(k)]), compact_k
             )
             # rows whose slots ran past the compacted buffer (dropped
-            # tail) or whose per-spectrum buffers clipped: re-searched
-            # by the caller on the small host path.  The two causes
-            # are tracked separately: only TRUNCATION is fixable by
-            # regrowing compact_k (see `_escalated`)
+            # tail), whose per-spectrum buffers clipped, or whose
+            # extraction under-delivered: re-searched by the caller on
+            # the small host path.  The causes are tracked separately:
+            # only TRUNCATION is fixable by regrowing compact_k (see
+            # `_escalated`)
             truncated = np.cumsum(k) > compact_k
             over = (shard_counts > cap).any(axis=(1, 2))
+            under = k < expect
+            if under.any():
+                import warnings
+
+                warnings.warn(
+                    f"device peak extraction under-delivered on "
+                    f"{int(under.sum())} spectra (shard {s}): got "
+                    f"{int(k[under].sum())} of "
+                    f"{int(expect[under].sum())} expected slots — "
+                    f"re-searching the affected DM rows on the host "
+                    f"path (this indicates a backend top-k anomaly "
+                    f"worth reporting)"
+                )
             for d in range(ndm_local):
                 sl = slice(d * namax * nlevels, (d + 1) * namax * nlevels)
                 if truncated[sl].any():
                     truncated_rows.add(s * ndm_local + d)
-                if truncated[sl].any() or over[d]:
+                if truncated[sl].any() or over[d] or under[sl].any():
                     clipped_rows.add(s * ndm_local + d)
             total = int(seg_bounds[-1])
             blk = slice(s * compact_k, s * compact_k + total)

@@ -620,6 +620,20 @@ class PulsarSearch:
                 )
             bi = np.asarray(idxs[level][:take])
             bs = np.asarray(snrs[level][:take])
+            if (bi < 0).any():
+                # defensive: a -1 sentinel inside the claimed-valid
+                # prefix means the device extraction under-delivered
+                # (backend top-k anomaly); drop the sentinels rather
+                # than fabricate freq<0 / snr=0 candidates
+                import warnings
+
+                warnings.warn(
+                    f"peak extraction under-delivered "
+                    f"{int((bi < 0).sum())} of {take} slots "
+                    f"(dm={dm}, acc={acc}, nh={level})"
+                )
+                keep = bi >= 0
+                bi, bs = bi[keep], bs[keep]
             # device buffers are SNR-ordered (extract_top_peaks); the
             # merge walk needs ascending bin order
             order = np.argsort(bi, kind="stable")

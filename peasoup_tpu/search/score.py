@@ -53,7 +53,12 @@ class CandidateScorer:
                 inside_count += 1
                 inside_snr += a.snr
         cand.ddm_count_ratio = inside_count / total_count
-        cand.ddm_snr_ratio = inside_snr / total_snr
+        # C float semantics (`scorer.hpp:62`): 0/0 is a quiet NaN, not
+        # a crash — an all-zero-snr family scores nan like the
+        # reference would
+        cand.ddm_snr_ratio = (
+            inside_snr / total_snr if total_snr != 0.0 else float("nan")
+        )
 
     def score(self, cand: Candidate) -> None:
         cand.is_physical = self._has_physical_period(cand)
