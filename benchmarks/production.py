@@ -199,11 +199,12 @@ def main(argv=None):
                      for d in search.dm_list]
         n_trials = sum(len(a) for a in acc_lists)
         # hsum/peaks at the SIZE the search actually runs them (2^22
-        # spectrum bins for a 2^23-sample series), measured r3 on v5e:
-        # harmonic sum 2.26 ms (mixed-precision selection einsums),
-        # by-value peak extraction 2.22 ms across the 5 levels
+        # spectrum bins for a 2^23-sample series), re-measured r5 on
+        # v5e: fused Pallas harmonic sum 1.52 ms, by-value exact
+        # two-stage peak extraction 2.71 ms across the 5 levels at
+        # cap=1024 (1.07 ms at cap=320)
         per_accel = (micro.get("resample2_tables_2e23_accel500", 0)
-                     + micro.get("fft_r2c_2e23", 0) + 2.26 + 2.22)
+                     + micro.get("fft_r2c_2e23", 0) + 1.52 + 2.71)
         per_dm = micro.get("fft_r2c_c2r_2e23_roundtrip", 0) + 2.0
         # whole-pipeline terms the per-trial sums omit: the Pallas
         # dedispersion sweep (VPU-bound, ~0.7 s per 9-row chunk at

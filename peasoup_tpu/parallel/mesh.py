@@ -1183,11 +1183,23 @@ class MeshPulsarSearch(PulsarSearch):
         tune = (load_tuning(cfg.tune_file, self._tune_scoped_key("chunked"))
                 if cfg.tune_file else None)
         if tune is not None:
+            from ..search.tuning import pick_row_capacity
+
             # bound the capacity so the stacked per-chunk peak buffers
             # (dm_chunk x namax x nlevels x cap, idx+snr) stay <= 1 GB
             cap_ceil = max(64, (1 << 30) // (dm_chunk * namax_p
                                              * nlevels * 8))
-            cap = round_up(tune["cap_hw"] + 32, 64, 64, cap_ceil)
+            if tune.get("row_hw"):
+                # per-row counts known: cover the BULK of rows and
+                # leave pathological ones to the cheap re-search (a
+                # 13k-count pulsar row must not make every spectrum's
+                # top_k 13x bigger — measured +330 s at full scale)
+                n_tr = sum(len(a) for a in acc_lists)
+                cap = round_up(
+                    pick_row_capacity(tune["row_hw"], n_tr),
+                    64, 64, cap_ceil)
+            else:
+                cap = round_up(tune["cap_hw"] + 32, 64, 64, cap_ceil)
         else:
             cap = cfg.peak_capacity
         # per-SHARD slot count: compact_k and nvalid are per-shard
@@ -1340,6 +1352,7 @@ class MeshPulsarSearch(PulsarSearch):
         pending = out if todo else None
         hw_count = 0  # observed high-waters for the tune sidecar
         hw_valid = 0
+        row_hw = np.zeros(ndm, np.int64)  # per-DM-row max counts
         for k, (ci, rows) in enumerate(todo):
             # double-buffer: the NEXT chunk is dispatched before this
             # chunk's results are fetched/decoded, so host decode,
@@ -1364,6 +1377,11 @@ class MeshPulsarSearch(PulsarSearch):
             hw_valid = max(hw_valid, int(
                 counts_l.reshape(self.ndev, -1).sum(axis=1).max()
             ))
+            row_max_l = counts_l.max(axis=(1, 2))
+            for key in range(len(rows)):
+                ii = int(rows[key])
+                if ii < ndm:
+                    row_hw[ii] = max(row_hw[ii], int(row_max_l[key]))
             phases["decode"] += time.time() - tp
             for key in clipped_l:
                 ii = int(rows[key])
@@ -1438,7 +1456,7 @@ class MeshPulsarSearch(PulsarSearch):
             # observed this run (a checkpoint resume sees a subset and
             # would understate them)
             save_tuning(cfg.tune_file, self._tune_scoped_key("chunked"),
-                        hw_count, hw_valid)
+                        hw_count, hw_valid, row_hw=row_hw)
         # dedispersion is fused into the chunk dispatches; when stage
         # measurement is on, time one real dedisp-only dispatch and
         # scale by the number of chunks executed
@@ -1615,15 +1633,25 @@ class MeshPulsarSearch(PulsarSearch):
         # (130-240 s, dominated by 1-2 search_accel_chunk compiles
         # shared across rows with equal escalated capacity).
         trials_sel, row_map = trials_provider(rows)
-        out = {}
-        for ii in rows:
+
+        def row_max_of(ii):
             # ``counts`` maps row -> max above-threshold count (or an
             # array indexable by row on the fused path)
             row_max = counts[ii]
             if not np.isscalar(row_max) and not isinstance(row_max, int):
                 row_max = int(np.asarray(row_max).max())
-            cap2 = 1 << int(np.ceil(np.log2(max(
-                int(row_max), self.config.peak_capacity) + 1)))
+            return int(row_max)
+
+        # ONE shared escalated capacity across every clipped row: each
+        # distinct capacity is a fresh search_accel_chunk compile
+        # (~15-25 s through the remote compiler) while the extra top_k
+        # slots cost milliseconds — per-row capacities measured 170 s
+        # for 10 rows at production scale, mostly compiles
+        cap2 = 1 << int(np.ceil(np.log2(max(
+            max(row_max_of(ii) for ii in rows),
+            self.config.peak_capacity) + 1)))
+        out = {}
+        for ii in rows:
             tim = self._trial_tim(trials_sel, row_map[ii])
             # narrow accel batches: at production scale the replicated
             # filterbank already occupies most of HBM, and escalated

@@ -32,7 +32,8 @@ _TUNE_VERSION = 1
 
 
 def load_tuning(path: str, key: str) -> dict | None:
-    """Return {"cap_hw": int, "ck_hw": int} or None if absent/stale."""
+    """Return {"cap_hw": int, "ck_hw": int, "row_hw": list|None} or
+    None if absent/stale."""
     if not path or not os.path.exists(path):
         return None
     try:
@@ -44,23 +45,62 @@ def load_tuning(path: str, key: str) -> dict | None:
     if obj.get("version") != _TUNE_VERSION or obj.get("key") != key:
         return None
     try:
-        return {"cap_hw": int(obj["cap_hw"]), "ck_hw": int(obj["ck_hw"])}
+        row_hw = obj.get("row_hw")
+        return {"cap_hw": int(obj["cap_hw"]), "ck_hw": int(obj["ck_hw"]),
+                "row_hw": ([int(v) for v in row_hw]
+                           if row_hw is not None else None)}
     except (KeyError, TypeError, ValueError):
         return None
 
 
-def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int) -> None:
-    """Atomically record the observed high-water marks."""
+def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
+                row_hw=None) -> None:
+    """Atomically record the observed high-water marks.
+
+    ``row_hw``: optional per-DM-row max above-threshold counts — lets
+    the next run choose a capacity that covers the BULK of rows and
+    leaves pathological ones (a blazing pulsar/RFI row whose count is
+    10x everyone else's) to the cheap re-search path, instead of
+    paying the loudest row's top_k capacity on every spectrum."""
     if not path:
         return
     tmp = path + ".tmp"
     try:
+        obj = {"version": _TUNE_VERSION, "key": key,
+               "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}
+        if row_hw is not None:
+            obj["row_hw"] = [int(v) for v in row_hw]
         with open(tmp, "w") as f:
-            json.dump({"version": _TUNE_VERSION, "key": key,
-                       "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}, f)
+            json.dump(obj, f)
         os.replace(tmp, path)
     except OSError as exc:
         warnings.warn(f"could not write tune file {path!r}: {exc}")
+
+
+def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,
+                      lo: int = 64, hi: int = 1 << 20) -> int:
+    """Capacity minimising (modelled) run cost from per-row counts.
+
+    Raising the per-spectrum capacity makes EVERY accel trial's top_k
+    bigger (measured on v5e at 2^22 bins: the 5-level extraction goes
+    3.0 ms at cap 1024 -> 26 ms at cap 13184, ~1.9 us per slot per
+    trial), while every row whose count exceeds the capacity costs one
+    host-path re-search (~2 s with the shared-capacity compile).  A
+    single pathological row must therefore NOT set the global
+    capacity; this picks argmin over the distinct candidate caps.
+    """
+    import numpy as np
+
+    m = np.asarray(row_hw, np.int64)
+    slot_s = 1.9e-6 * max(n_accel_trials, 1)
+    best_c, best_cost = None, None
+    cands = sorted({int(-(-(v + 32) // quantum) * quantum) for v in m})
+    for c in cands:
+        n_re = int((m > c).sum())
+        cost = slot_s * c + 2.0 * n_re + (20.0 if n_re else 0.0)
+        if best_cost is None or cost < best_cost:
+            best_c, best_cost = c, cost
+    return int(min(hi, max(lo, best_c if best_c is not None else lo)))
 
 
 def round_up(value: int, quantum: int, lo: int, hi: int) -> int:

@@ -264,3 +264,17 @@ def test_two_process_distributed_search(tutorial_fil, mode):
         assert got[1] == pytest.approx(want[1], rel=1e-5)  # snr
         assert got[2:] == want[2:]                         # dm, acc, assoc
     assert len(sigs[0]) == len(ref_sig)
+
+
+def test_pick_row_capacity_ignores_pathological_rows():
+    """A single blazing row (10x everyone's count) must not set the
+    global capacity; bulk rows pick the capacity, loud rows re-search."""
+    from peasoup_tpu.search.tuning import pick_row_capacity
+
+    row_hw = [100] * 490 + [900] * 9 + [13143]
+    cap = pick_row_capacity(row_hw, n_accel_trials=10500)
+    assert 900 < cap < 2048  # covers the 900s, not the 13k row
+    # with many rows near the top the big capacity wins
+    row_hw2 = [1300] * 400 + [100] * 100
+    cap2 = pick_row_capacity(row_hw2, n_accel_trials=2688)
+    assert cap2 >= 1332
