@@ -108,7 +108,7 @@ def bench_case(nchans, nsamps, dm_chunk=32):
     slack2 = max(dedisperse_window_slack(c[0], dm_tile2, G2)
                  for c in cells2p)
     need2 = (-(-out_nsamps // T) * T - T + plan["shift_max"]
-             + (-(-(T + slack2 + 256) // 256) * 256))
+             + (-(-(T + slack2 + 1024) // 1024) * 1024))
     L1 = -(-max(out_nsamps + plan["shift_max"], need2) // KT) * KT
     R2, cells2 = subband_stage2_layout(plan["per_cell"], L1, dm_tile2)
     assert (n_anchor_p - 1) * nsub * L1 + plan["shift_max"] < 2**31

@@ -478,7 +478,7 @@ def dedisperse_pallas_flat_subband(
             )
         nsub = nchans // csub
         dtype = parts[0].dtype
-        align = 1024 if dtype == jnp.uint8 else 256
+        align = 1024  # see the alignment note in dedisperse_pallas_flat
         if nsamps % align:
             raise ValueError(
                 f"flat-part channel stride {nsamps} must be a multiple "
@@ -570,8 +570,12 @@ def dedisperse_flat_pad_to(out_nsamps: int, max_delay: int,
                            window_slack: int, time_tile: int,
                            uint8: bool = True) -> int:
     """Per-channel stride (samples, incl. padding) the flat kernel
-    needs: every window DMA must stay in bounds and tile-aligned."""
-    align = 1024 if uint8 else 256
+    needs: every window DMA must stay in bounds and tile-aligned.
+    (``uint8`` is kept for API compatibility; the alignment is 1024
+    for every dtype — see the note in :func:`dedisperse_pallas_flat`.)
+    """
+    del uint8
+    align = 1024
     T, S = time_tile, window_slack
     out_p = -(-out_nsamps // T) * T
     W1 = -(-(T + S + align) // align) * align
@@ -649,9 +653,14 @@ def dedisperse_pallas_flat(
         T, S = time_tile, window_slack
         TQ = _flat_checks(T, S)
         dtype = parts[0].dtype
-        # 1-D HBM memrefs are tiled in 1024-byte units: u8 -> (1024,),
-        # f32 -> (256,); DMA slice starts and lengths must be multiples
-        align = 1024 if dtype == jnp.uint8 else 256
+        # DMA slice starts and lengths must be multiples of the 1-D
+        # HBM memref tiling.  u8 memrefs tile at (1024,); f32 USED to
+        # tile at (256,) but the current Mosaic assigns (1024,) to
+        # in-program f32 flat buffers (observed r5: the sub-band
+        # stage-2 partials failed to compile with 256-aligned
+        # windows), so 1024 everywhere — a stricter alignment is
+        # always safe
+        align = 1024
         if nsamps % align:
             raise ValueError(
                 f"flat-part channel stride {nsamps} must be a multiple "

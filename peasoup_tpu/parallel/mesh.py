@@ -948,7 +948,7 @@ class MeshPulsarSearch(PulsarSearch):
                     f"output too short for the stage-1 kernel window "
                     f"({L1} < time_tile={t_sub})")
             itemsize = 1 if self.fil.header.nbits <= 8 else 4
-            align = 1024 if itemsize == 1 else 256
+            align = 1024  # flat-kernel DMA alignment, any dtype
             # each device runs the kernel on ITS cell's n_anchor_p rows
             # (blocked from row 0 at stride D), so the slack bound must
             # be the max over per-cell tables — blocking one big
@@ -1174,7 +1174,8 @@ class MeshPulsarSearch(PulsarSearch):
         nlevels = cfg.nharmonics + 1
         # persistent buffer tuning: a prior run of the SAME search
         # recorded its true high-water counts, so this run can size the
-        # per-spectrum capacity to never clip (no re-search phase) and
+        # per-spectrum capacity for the bulk of rows (pathological
+        # ones stay on the re-search path by design) and
         # the compacted transfer buffer to the observed total (+margin)
         # instead of the worst case.  Results are identical either way;
         # see search/tuning.py.

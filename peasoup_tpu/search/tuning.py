@@ -13,7 +13,10 @@ max per-shard valid-peak total) in a tiny JSON sidecar keyed by the
 same search identity the checkpoint uses.  The next run of the same
 search sizes its buffers from the record, so
 
-* no row clips -> the re-search phase disappears entirely, and
+* the capacity covers the BULK of rows (when per-row counts are
+  recorded, :func:`pick_row_capacity` deliberately leaves rare
+  pathological rows — a blazing pulsar or RFI-loud trial — to the
+  re-search path rather than inflate every spectrum's top_k), and
 * the compacted transfer buffer shrinks from worst-case to observed
   size (+margin) -> less data over the (slow) device->host link.
 
@@ -94,7 +97,7 @@ def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,
     m = np.asarray(row_hw, np.int64)
     slot_s = 1.9e-6 * max(n_accel_trials, 1)
     best_c, best_cost = None, None
-    cands = sorted({int(-(-(v + 32) // quantum) * quantum) for v in m})
+    cands = sorted({round_up(int(v) + 32, quantum, lo, hi) for v in m})
     for c in cands:
         n_re = int((m > c).sum())
         cost = slot_s * c + 2.0 * n_re + (20.0 if n_re else 0.0)
