@@ -370,14 +370,20 @@ def build_chunked_search(
     The table args are always required; with ``block=None`` they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
 
-    ``subband``: optional static 8-tuple (bounds, L1, n_anchor_p,
-    slack, csub, t_sub, k_sub, dm_tile) —
+    ``subband``: optional static 9-tuple (bounds, L1, n_anchor_p,
+    slack, csub, t_sub, k_sub, dm_tile, kernel2) —
     two-stage sub-band dedispersion (``_plan_subband_chunks``): three
-    extra leading inputs follow the data parts, all dm-sharded —
-    anchor_delays (n_anchor_p, nchans), assign (dm_chunk,), shifts
-    (dm_chunk, nsub) — and the per-chunk direct sweep is replaced by
-    ``dedisperse_subband_flat`` (anchor sweeps + shifted-window
-    assembly).  Requires the driver's one-chunk-per-dispatch shape.
+    extra leading inputs follow the data parts, all dm-sharded.  With
+    ``kernel2`` None they are anchor_delays (n_anchor_p, nchans),
+    assign (dm_chunk,), shifts (dm_chunk, nsub) and the per-chunk
+    direct sweep is replaced by ``dedisperse_subband_flat`` (anchor
+    sweeps + shifted-window XLA assembly).  With ``kernel2`` = (R2,
+    slack2, shift_max, chan_group2, dm_tile2, T2) — the Pallas path —
+    they are anchor_delays, delays2 (R2, nsub), unpad (dm_chunk,),
+    and stage 2 runs as ONE direct-kernel launch over the flat f32
+    partials followed by an exact one-hot row selection (see
+    ``subband_trials``).  Requires the driver's one-chunk-per-dispatch
+    shape.
     """
     from ..ops.dedisperse_pallas import (
         dedisperse_pallas_flat,
@@ -401,6 +407,8 @@ def build_chunked_search(
         # scale (see ops.dedisperse.dedisperse_flat)
         parts = list(args[:n_parts])
         if subband is not None:
+            # in kernel2 mode the last two are (delays2, unpad) — see
+            # subband_trials; names kept for the shared unpack
             (anchor_delays, sb_assign, sb_shifts) = args[n_parts:n_parts + 3]
             rest = args[n_parts + 3:]
         else:
@@ -411,7 +419,7 @@ def build_chunked_search(
 
         if subband is not None:
             (sb_bounds, sb_L1, sb_nanch, sb_slack, sb_csub,
-             sb_T, sb_K, sb_dm_tile) = subband
+             sb_T, sb_K, sb_dm_tile, sb_kernel2) = subband
             if dedisp_method == "pallas":
                 # one-launch stage 1 (grid over sub-bands, K-tile
                 # windows — see _dedisperse_flat_sb_kernel)
@@ -428,6 +436,39 @@ def build_chunked_search(
                     return dedisperse_flat(parts, ad, nsamps_dev, sb_L1,
                                            chan_range=cr)
 
+        def subband_trials():
+            if dedisp_method == "pallas" and sb_kernel2 is not None:
+                # stage 2 as ONE direct-kernel launch over the flat
+                # f32 partials (synthetic nsub-channel filterbank,
+                # per-row delays = anchor stride + shift); the padded
+                # rows are then selected back to chunk order with an
+                # exact one-hot matmul — a jnp.take row gather
+                # measured 28 ms for the same selection on v5e
+                (k2_R2, k2_slack, k2_maxd, k2_G, k2_tile, k2_T) = \
+                    sb_kernel2
+                partials = stage1(anchor_delays)
+                out2 = dedisperse_pallas_flat(
+                    [partials.reshape(-1)], sb_assign, sb_L1,
+                    out_nsamps, window_slack=k2_slack,
+                    max_delay=k2_maxd, dm_tile=k2_tile,
+                    time_tile=k2_T, chan_group=k2_G,
+                    data_tail_ok=True,
+                )
+                onehot = (
+                    sb_shifts[:, None]
+                    == jnp.arange(k2_R2, dtype=jnp.int32)[None, :]
+                ).astype(jnp.bfloat16)
+                return jnp.einsum(
+                    "rp,pl->rl", onehot, out2,
+                    precision=(lax.Precision.DEFAULT,
+                               lax.Precision.HIGHEST),
+                    preferred_element_type=jnp.float32,
+                )
+            return dedisperse_subband_flat(
+                anchor_delays, sb_assign, sb_shifts, out_nsamps,
+                bounds=sb_bounds, L1=sb_L1, stage1=stage1,
+            )
+
         def chunk_body(_, ci):
             z = jnp.int32(0)  # literal 0 is weak-i64 under x64
             delays_c = lax.dynamic_slice(
@@ -440,10 +481,7 @@ def build_chunked_search(
                 uidx, (ci * dm_chunk, z), (dm_chunk, namax)
             )
             if subband is not None:
-                trials = dedisperse_subband_flat(
-                    anchor_delays, sb_assign, sb_shifts, out_nsamps,
-                    bounds=sb_bounds, L1=sb_L1, stage1=stage1,
-                )
+                trials = subband_trials()
             elif dedisp_method == "pallas":
                 trials = dedisperse_pallas_flat(
                     parts, delays_c, nsamps_dev, out_nsamps,
@@ -524,8 +562,13 @@ def build_chunked_search(
         counts = counts.reshape(ndm_local, namax, nlevels)
         return _compact_peaks(idxs, snrs, counts, compact_k)
 
-    sb_specs = (P("dm", None), P("dm"), P("dm", None)) \
-        if subband is not None else ()
+    if subband is None:
+        sb_specs = ()
+    elif dedisp_method == "pallas" and subband[8] is not None:
+        # kernel2 transport: delays2 (R2, nsub) + unpad (dm_chunk,)
+        sb_specs = (P("dm", None), P("dm", None), P("dm"))
+    else:
+        sb_specs = (P("dm", None), P("dm"), P("dm", None))
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
@@ -981,6 +1024,42 @@ class MeshPulsarSearch(PulsarSearch):
                     f"stage-1 kernel cannot fit VMEM even at "
                     f"dm_tile=8, k_tiles=1 (chan_group={G}, "
                     f"time_tile={t_sub}, slack={slack_d})")
+            # stage 2 AS a dedispersion: the flat (n_anchor_p, nsub,
+            # L1) f32 partials are a synthetic nsub-channel filterbank
+            # and each fine row's assembly is one direct-kernel row
+            # with delays ``assign*nsub*L1 + shift`` — one launch
+            # replaces ndm*nsub XLA dynamic slices (~0.19 s/chunk on
+            # v5e, more than the stage-1 sweep itself).  Rows are
+            # padded per anchor (subband_stage2_layout) so no tile
+            # straddles two anchors and the slack stays at the shift
+            # spread, not the anchor stride.
+            kernel2 = None
+            nsub = sbp["nsub"]
+            T2 = t_sub
+            # the stage-2 kernel needs nsub % (2*chan_group) == 0
+            G2 = next((g for g in (16, 8, 4, 2, 1)
+                       if nsub % (2 * g) == 0), None)
+            if G2 is not None and self.out_nsamps >= T2:
+                from ..ops.dedisperse import subband_stage2_layout
+
+                dm_tile2 = 8
+                _, cells2p = subband_stage2_layout(
+                    sbp["per_cell"], 0, dm_tile2)
+                slack2 = max(
+                    int(dedisperse_window_slack(c[0], dm_tile2, G2))
+                    for c in cells2p)
+                need2 = dedisperse_flat_pad_to(
+                    self.out_nsamps, sbp["shift_max"], slack2, T2)
+                L1k = -(-max(L1, need2) // align) * align
+                if (n_anchor_p * nsub * L1k < 2**31
+                        and (n_anchor_p - 1) * nsub * L1k
+                        + sbp["shift_max"] < 2**31):
+                    # int32 flat offsets hold: engage the kernel path
+                    L1 = L1k
+                    R2, cells2 = subband_stage2_layout(
+                        sbp["per_cell"], L1, dm_tile2)
+                    kernel2 = (R2, int(slack2), int(sbp["shift_max"]),
+                               G2, dm_tile2, T2)
             # slack + align: the sb kernel's per-kk aligned slices
             # round its window one alignment unit past the K*T formula
             pad_sub = dedisperse_flat_pad_to(
@@ -990,6 +1069,7 @@ class MeshPulsarSearch(PulsarSearch):
             # every flat part must hold whole sub-bands
             plan["part_align"] = max(2 * G, csub)
         else:
+            kernel2 = None
             slack = 0
             pad_sub = self.out_nsamps + self.max_delay + sbp["shift_max"]
         plan["pad_to"] = max(plan["pad_to"], pad_sub)
@@ -997,11 +1077,19 @@ class MeshPulsarSearch(PulsarSearch):
         per_ci = []
         for ci in range(n_chunks):
             cell = sbp["per_cell"][ci * ndev : (ci + 1) * ndev]
-            per_ci.append((
-                np.concatenate([c[0] for c in cell]),          # anchor rows
-                np.concatenate([c[1] for c in cell]),          # assign
-                np.concatenate([c[2] for c in cell], axis=0),  # shifts
-            ))
+            if kernel2 is not None:
+                c2 = cells2[ci * ndev : (ci + 1) * ndev]
+                per_ci.append((
+                    np.concatenate([c[0] for c in cell]),      # anchor rows
+                    np.concatenate([d for d, _u in c2]),       # delays2
+                    np.concatenate([u for _d, u in c2]),       # unpad
+                ))
+            else:
+                per_ci.append((
+                    np.concatenate([c[0] for c in cell]),      # anchor rows
+                    np.concatenate([c[1] for c in cell]),      # assign
+                    np.concatenate([c[2] for c in cell], axis=0),  # shifts
+                ))
         if self.config.verbose:
             print(
                 f"sub-band dedispersion: nsub={sbp['nsub']} "
@@ -1014,7 +1102,7 @@ class MeshPulsarSearch(PulsarSearch):
             slack=int(slack), per_ci=per_ci, max_err=sbp["max_err"],
             cost_ratio=sbp["cost_ratio"], nsub=sbp["nsub"],
             csub=csub, t_sub=t_sub, k_sub=k_sub,
-            dm_tile_sub=dm_tile_sub,
+            dm_tile_sub=dm_tile_sub, kernel2=kernel2,
         )
 
     def _device_inputs_chunked(self, plan, acc_lists):
@@ -1263,7 +1351,7 @@ class MeshPulsarSearch(PulsarSearch):
                 subband=(
                     (sb["bounds"], sb["L1"], sb["n_anchor_p"],
                      sb["slack"], sb["csub"], sb["t_sub"],
-                     sb["k_sub"], sb["dm_tile_sub"])
+                     sb["k_sub"], sb["dm_tile_sub"], sb["kernel2"])
                     if sb is not None else None
                 ),
                 quantise_nbits=(
@@ -1314,12 +1402,21 @@ class MeshPulsarSearch(PulsarSearch):
         def dispatch(ci, rows):
             sb_args = ()
             if sb is not None:
-                anchor_rows, assign, shifts = sb["per_ci"][ci]
-                sb_args = (
-                    put_global(delays_h[anchor_rows], shard),
-                    put_global(assign, shard1),
-                    put_global(shifts, shard),
-                )
+                anchor_rows, a2, a3 = sb["per_ci"][ci]
+                if (plan["dedisp_method"] == "pallas"
+                        and sb["kernel2"] is not None):
+                    # (delays2 (ndev*R2, nsub), unpad (ndev*dm_chunk,))
+                    sb_args = (
+                        put_global(delays_h[anchor_rows], shard),
+                        put_global(a2, shard),
+                        put_global(a3, shard1),
+                    )
+                else:
+                    sb_args = (
+                        put_global(delays_h[anchor_rows], shard),
+                        put_global(a2, shard1),
+                        put_global(a3, shard),
+                    )
             with trace_range(f"Chunked-Search-{ci}"):
                 return program(
                     *data_parts,

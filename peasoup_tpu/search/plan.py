@@ -104,7 +104,13 @@ class SearchConfig:
     # an accuracy improvement over the reference's dedisp (same class
     # of documented deviation as keeping f32 trials instead of u8),
     # and results stay identical across drivers.  Opt in for dense
-    # tolerance-stepped grids, where the tree wins several-fold.
+    # tolerance-stepped grids: measured r5 on v5e (dedisp_bench.json)
+    # the tree wins 2.15x at 1024 chans / 2.79x at 4096.  (The cost
+    # model's 5.3x is unreachable on TPU: anchors pad to the 8-sublane
+    # register granularity — 5 anchors cost 8 rows of sweep — and the
+    # fixed stage-2 assembly adds ~0.01 s/chunk, so the realistic
+    # ceiling is ~3.5x.  Kept opt-in: a ~2x win on one pipeline stage
+    # does not justify giving up exact-by-default trials.)
     subband_dedisp: str = "never"
     # stage-2 residual smearing bound in samples (0 = anchors compress
     # only across identical-delay trials, making sub-band output

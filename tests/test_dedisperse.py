@@ -537,3 +537,70 @@ def test_trial_nbits8_requires_integer_input(tutorial_fil):
         PulsarSearch(fil, SearchConfig(trial_nbits=8))
     with pytest.raises(ConfigError):
         PulsarSearch(fil, SearchConfig(trial_nbits=16))
+
+
+def test_subband_stage2_kernel_assembly_exact():
+    """The Pallas stage-2-as-dedispersion path (flat f32 partials as a
+    synthetic nsub-channel filterbank + one-hot row selection, the
+    chunked driver's kernel2 mode) must be bit-identical to the direct
+    sweep (interpret mode, integer data => exact)."""
+    from peasoup_tpu.ops.dedisperse import (
+        dedisperse_flat,
+        subband_chunk_plan,
+        subband_stage2_layout,
+    )
+    from peasoup_tpu.ops.dedisperse_pallas import (
+        dedisperse_flat_pad_to,
+        dedisperse_pallas_flat,
+        dedisperse_window_slack,
+    )
+
+    rng = np.random.default_rng(17)
+    nchans = 32
+    T = 1024  # small kernel tile for the interpret run
+    tab = delay_table(nchans, 0.00032, 1510.0, -1.09)
+    base = np.repeat(np.linspace(0.0, 120.0, 4), 2)
+    delays = delays_in_samples(base.astype(np.float32), tab)
+    md = int(delays.max())
+    out_nsamps = 2 * T + 100
+    nsamps0 = out_nsamps + md
+    cells = [np.arange(0, 4), np.arange(4, 8)]
+    plan = subband_chunk_plan(base, delays, tab, cells, chan_align=1,
+                              eps=0.0)
+    assert plan is not None
+    nsub = plan["nsub"]
+    dm_tile2 = 8
+    G2 = next(g for g in (16, 8, 4, 2, 1) if nsub % (2 * g) == 0)
+    _, cells2p = subband_stage2_layout(plan["per_cell"], 0, dm_tile2)
+    slack2 = max(int(dedisperse_window_slack(c[0], dm_tile2, G2))
+                 for c in cells2p)
+    L1 = dedisperse_flat_pad_to(out_nsamps, plan["shift_max"], slack2, T)
+    R2, cells2 = subband_stage2_layout(plan["per_cell"], L1, dm_tile2)
+    nsamps0 = L1 + md  # stage-1 windows reach L1 output samples
+    data = rng.integers(0, 4, (nchans, nsamps0)).astype(np.uint8)
+    flat = jnp.asarray(data.reshape(-1))
+    direct = np.asarray(dedisperse_flat(
+        [flat], jnp.asarray(delays), nsamps0, out_nsamps))
+
+    for ci, rows in enumerate(cells):
+        anchor_rows, _assign, _shifts = plan["per_cell"][ci]
+        # stage 1 partials via the XLA path (the stage-1 kernel has
+        # its own exactness test); stage 2 through the REAL flat
+        # kernel in interpret mode
+        parts = []
+        for lo, hi in plan["bounds"]:
+            p = np.asarray(dedisperse_flat(
+                [flat], jnp.asarray(delays[anchor_rows]), nsamps0, L1,
+                chan_range=(lo, hi)))
+            parts.append(p)
+        partials = np.stack(parts, axis=1)  # (n_anchor, nsub, L1)
+        d2, unpad = cells2[ci]
+        out2 = np.asarray(dedisperse_pallas_flat(
+            [jnp.asarray(partials.reshape(-1).astype(np.float32))],
+            jnp.asarray(d2), L1, out_nsamps, window_slack=slack2,
+            max_delay=plan["shift_max"], dm_tile=dm_tile2,
+            time_tile=T, chan_group=G2, data_tail_ok=True,
+            interpret=True))
+        onehot = (unpad[:, None] == np.arange(R2)[None, :])
+        got = np.einsum("rp,pl->rl", onehot.astype(np.float32), out2)
+        np.testing.assert_array_equal(got, direct[rows])
