@@ -21,13 +21,17 @@ import numpy as np
 
 # two-stage extraction kicks in above this searched-prefix length; the
 # row width balances the row-reduction pass against the second top_k.
-# PERF NOTE (r5 trace finding, not yet acted on): below the threshold
-# the batched approx_max_k lowers to full SORTS inside fused programs
-# — ~64 ms of the tutorial search's ~100 ms device time (5 levels,
-# jax.profiler trace).  A narrower-row two-stage (C=64) measured 8.4
-# vs 14.6 ms at stop=36909 x 177 trials standalone, but the C=64
-# variant at stop=65537 CRASHED the v5e worker (kernel fault), so the
-# swap needs a careful shape sweep before it can ship.
+# PERF NOTE (r5 trace finding, measured and NOT shipped): below the
+# threshold the batched approx_max_k lowers to full SORTS inside
+# fused programs — ~64 ms of the tutorial search's ~100 ms device
+# time (5 levels, jax.profiler trace).  A narrower-row two-stage was
+# swept standalone (C in {64,128,256}, stop 9k..131k, cap 64..2048):
+# exact and mostly stable (one C=64 run killed the v5e worker), but
+# at the caps the tuned tutorial actually uses it is SLOWER than
+# approx_max_k (13.5 vs 9.5 ms at stop=65537 x 177 trials, cap=320;
+# it only wins at cap<=64).  The in-program sort cost may still
+# differ from standalone — attributing that gap needs per-op traces
+# of both formulations, left for a future round.
 _TWO_STAGE_MIN_SIZE = 1 << 17
 _TWO_STAGE_ROW_WIDTH = 512
 
