@@ -16,6 +16,7 @@ Layout:
     parallel/  device-mesh sharding of the trial grid
     output/    overview.xml + candidates.peasoup writers/readers
     native/    C++ helpers (bit unpacking) with NumPy fallbacks
+    errors     typed exception hierarchy (the reference's ErrorChecker)
 """
 
 import jax as _jax
