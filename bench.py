@@ -83,13 +83,40 @@ def check_parity(result, golden: list[dict]) -> list[str]:
     return fails
 
 
+def telemetry_delta(before: dict, after: dict) -> dict:
+    """Compile-count + device/host stage split between two registry
+    snapshots (obs/metrics.py) — the attribution BENCH_*.json lacked:
+    how much of the wall-clock was device execution vs host tail, and
+    whether any run paid an (unexpected) recompile."""
+    stages = {}
+    for name, rec in after["timers"].items():
+        prev = before["timers"].get(
+            name, {"count": 0, "host_s": 0.0, "device_s": 0.0})
+        d_host = rec["host_s"] - prev["host_s"]
+        if rec["count"] > prev["count"] or d_host > 1e-9:
+            stages[name] = {
+                "count": rec["count"] - prev["count"],
+                "host_s": round(d_host, 4),
+                "device_s": round(rec["device_s"] - prev["device_s"], 4),
+            }
+    return {
+        "backend_compiles": (
+            after["counters"].get("jit.backend_compiles", 0)
+            - before["counters"].get("jit.backend_compiles", 0)
+        ),
+        "stages": stages,
+    }
+
+
 def main() -> None:
     from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook
     from peasoup_tpu.parallel.mesh import MeshPulsarSearch
     from peasoup_tpu.search.plan import SearchConfig
     from peasoup_tpu.utils import enable_compile_cache
 
     enable_compile_cache()
+    install_compile_hook()
 
     if not os.path.exists(TUTORIAL):
         print(json.dumps({
@@ -118,7 +145,11 @@ def main() -> None:
     # reading separately.
     search = MeshPulsarSearch(fil, cfg)
     search.prewarm_tuned = True  # warmup also compiles the auto-tuned program
+    snap_cold = REGISTRY.snapshot()
     search.run()
+    snap_warm = REGISTRY.snapshot()
+    warmup_compiles = telemetry_delta(snap_cold, snap_warm)[
+        "backend_compiles"]
 
     # best of five timed runs: the tunnel to the remote-attached TPU
     # adds 50-100 ms of per-fetch jitter (and occasional multi-second
@@ -131,9 +162,15 @@ def main() -> None:
         t0 = time.time()
         result = search.run()
         runs.append((time.time() - t0, result))
+    snap_timed = REGISTRY.snapshot()
     runs.sort(key=lambda r: r[0])
     elapsed, result = runs[0]
     median_s = runs[len(runs) // 2][0]
+    # device/host attribution + compile count across the 5 timed runs:
+    # a nonzero timed compile count means the wall-clock includes
+    # compilation (it must not — the warmup exists to absorb it)
+    telemetry = telemetry_delta(snap_warm, snap_timed)
+    telemetry["warmup_backend_compiles"] = warmup_compiles
 
     timers = {k: round(v, 4) for k, v in result.timers.items()}
     timers["all_runs_s"] = [round(r[0], 4) for r in runs]
@@ -157,6 +194,7 @@ def main() -> None:
         "median_s": round(median_s, 4),
         "vs_baseline_median": round(BASELINE_TOTAL_S / median_s, 3),
         "timers": timers,
+        "telemetry": telemetry,
         "parity": f"all {len(golden)} golden candidates matched",
     }))
 

@@ -16,6 +16,8 @@ Layout:
     parallel/  device-mesh sharding of the trial grid
     output/    overview.xml + candidates.peasoup writers/readers
     native/    C++ helpers (bit unpacking) with NumPy fallbacks
+    obs/       run telemetry: metrics registry, JSONL event log,
+               machine-readable run_report.json
     errors     typed exception hierarchy (the reference's ErrorChecker)
 """
 

@@ -110,6 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
                         ".npy for golden-file debugging")
     p.add_argument("--profile_dir", default="",
                    help="capture a jax.profiler trace into this directory")
+    p.add_argument("--events_log", default="",
+                   help="structured JSONL event log (peak-buffer "
+                        "overflows, escalations, checkpoint/tune I/O "
+                        "failures, ...); default: <outdir>/events.jsonl")
+    p.add_argument("--metrics_json", default="",
+                   help="machine-readable end-of-run report (stage "
+                        "timers with host/device split, counters, "
+                        "event summary, device + HBM figures); "
+                        "default: <outdir>/run_report.json")
     p.add_argument("--single_device", action="store_true",
                    help="disable mesh sharding even with multiple devices")
     return p
@@ -127,12 +136,18 @@ def args_to_config(args):
     return cfg
 
 
-def write_search_output(result, outdir: str) -> None:
-    """Write candidates.peasoup + overview.xml for a SearchResult."""
+def write_search_output(result, outdir: str) -> dict:
+    """Write candidates.peasoup + overview.xml + run_report.json for a
+    SearchResult; returns the run-report dict (obs/report.py)."""
+    from .obs.report import write_run_report
     from .output.binary import write_candidate_binary
     from .output.xml_writer import OutputFileWriter
 
     os.makedirs(outdir, exist_ok=True)
+    cfg = result.config
+    report_path = (getattr(cfg, "metrics_json", "") or
+                   os.path.join(outdir, "run_report.json"))
+    report = write_run_report(report_path, result)
     byte_mapping = write_candidate_binary(
         result.candidates, os.path.join(outdir, "candidates.peasoup")
     )
@@ -145,7 +160,9 @@ def write_search_output(result, outdir: str) -> None:
     writer.add_device_info()
     writer.add_candidates(result.candidates, byte_mapping)
     writer.add_timing_info(result.timers)
+    writer.add_telemetry(report)
     writer.to_file(os.path.join(outdir, "overview.xml"))
+    return report
 
 
 def main(argv=None) -> int:
@@ -162,6 +179,15 @@ def main(argv=None) -> int:
         from .utils import enable_compile_cache
 
         enable_compile_cache()
+    # telemetry sinks, live BEFORE the run so events stream as they
+    # happen (a crash still leaves the JSONL trail on disk)
+    from .obs.events import configure_event_log
+    from .obs.metrics import install_compile_hook
+
+    install_compile_hook()
+    os.makedirs(cfg.outdir, exist_ok=True)
+    configure_event_log(
+        cfg.events_log or os.path.join(cfg.outdir, "events.jsonl"))
     import time as _time
 
     t_total = _time.time()
@@ -203,8 +229,11 @@ def main(argv=None) -> int:
             stop_trace()
     result.timers["reading"] = t_read
     result.timers["total"] = _time.time() - t_total
-    write_search_output(result, cfg.outdir)
+    report = write_search_output(result, cfg.outdir)
     if args.verbose:
+        from .obs.report import format_stage_table
+
+        print(format_stage_table(report), file=sys.stderr)
         print(f"Wrote {len(result.candidates)} candidates to {cfg.outdir}",
               file=sys.stderr)
     return 0

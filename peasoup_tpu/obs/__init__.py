@@ -1,0 +1,30 @@
+"""Run-telemetry subsystem (no reference equivalent).
+
+The reference instruments its pipeline with NVTX ranges and a
+wall-clock ``<execution_times>`` XML block (`include/utils/nvtx.hpp`,
+`src/pipeline_multi.cu`); everything else — buffer overflows, re-runs,
+recompiles — is invisible.  At production scale those signals must be
+counted, logged and reported per run, so this package provides:
+
+* :mod:`.metrics` — a thread-safe process-wide registry of counters,
+  gauges and stage timers that split host wall-clock from device time
+  (``block_until_ready`` deltas), plus jit-compile tracking;
+* :mod:`.events` — a structured JSONL event log whose
+  :func:`~peasoup_tpu.obs.events.warn_event` both raises the usual
+  Python warning and records a typed, counted event (every
+  ``warnings.warn`` site in ``search/`` and ``parallel/`` routes
+  through it — enforced by a repo lint test);
+* :mod:`.report` — an end-of-run machine-readable ``run_report.json``
+  (timers, counters, events, device info, HBM figures, candidate
+  statistics) written next to ``overview.xml``.
+"""
+
+from .metrics import REGISTRY, MetricsRegistry, install_compile_hook
+from .events import EventLog, configure_event_log, get_event_log, warn_event
+from .report import build_run_report, format_stage_table, write_run_report
+
+__all__ = [
+    "REGISTRY", "MetricsRegistry", "install_compile_hook",
+    "EventLog", "configure_event_log", "get_event_log", "warn_event",
+    "build_run_report", "format_stage_table", "write_run_report",
+]

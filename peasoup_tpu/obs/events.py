@@ -1,0 +1,162 @@
+"""Structured JSONL event log + the ``warn_event`` warning bridge.
+
+The seed pipeline had ~15 ``warnings.warn`` call sites whose signals
+(peak-buffer overflows, capacity escalations, checkpoint
+invalidations, fold-domain skips, chunked-path fallbacks) vanished
+into stderr.  Every one of those sites now calls :func:`warn_event`,
+which
+
+1. records a typed event — one JSON object per line in the configured
+   ``events.jsonl`` (schema below), and
+2. increments the ``events.<kind>`` counter in the metrics registry
+   (so ``run_report.json``'s event summary matches the warnings
+   raised), and
+3. raises the exact same Python warning as before, so ``-W error``,
+   ``pytest.warns`` and log scrapers keep working unchanged.
+
+Line schema (one JSON object per line)::
+
+    {"v": 1, "ts": <unix seconds>, "kind": "<snake_case type>",
+     "message": "<human-readable>", "data": {<typed fields>}}
+
+``data`` carries the machine-readable fields (dm trial index, counts,
+capacities, paths) so a service can alert on them without parsing
+message strings.  A repo lint test asserts no bare ``warnings.warn``
+remains under ``peasoup_tpu/search/`` or ``peasoup_tpu/parallel/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import warnings
+
+from .metrics import REGISTRY
+
+SCHEMA_VERSION = 1
+
+
+def _json_safe(value):
+    """Best-effort conversion of numpy scalars/arrays and misc objects
+    into plain JSON types (events must never crash the search)."""
+    try:
+        json.dumps(value)
+        return value
+    except (TypeError, ValueError):
+        pass
+    tolist = getattr(value, "tolist", None)
+    if callable(tolist):
+        try:
+            return tolist()
+        except Exception:
+            pass
+    return repr(value)
+
+
+class EventLog:
+    """Append-only JSONL event sink with in-memory per-kind counts.
+
+    ``path`` may be empty: events are then counted (registry + local
+    summary) but not persisted — the no-I/O default for library use.
+    The file handle opens lazily on first emit and is line-buffered;
+    an I/O failure disables persistence for the rest of the run with a
+    single plain warning (never an exception: telemetry must not kill
+    a multi-hour search).
+    """
+
+    def __init__(self, path: str = "", registry=None):
+        self.path = path or ""
+        self._registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        self._file = None
+        self._counts: dict[str, int] = {}
+        self._io_failed = False
+
+    def emit(self, kind: str, message: str = "", **fields) -> dict:
+        """Record one typed event; returns the record written."""
+        kind = str(kind)
+        rec = {
+            "v": SCHEMA_VERSION,
+            "ts": round(time.time(), 6),
+            "kind": kind,
+            "message": str(message),
+        }
+        if fields:
+            rec["data"] = {k: _json_safe(v) for k, v in fields.items()}
+        line = json.dumps(rec)
+        with self._lock:
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            if self.path and not self._io_failed:
+                try:
+                    if self._file is None:
+                        d = os.path.dirname(self.path)
+                        if d:
+                            os.makedirs(d, exist_ok=True)
+                        self._file = open(self.path, "a", buffering=1)
+                    self._file.write(line + "\n")
+                except OSError as exc:
+                    self._io_failed = True
+                    warnings.warn(
+                        f"event log {self.path!r} disabled: {exc}")
+        self._registry.inc(f"events.{kind}")
+        return rec
+
+    def summary(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                finally:
+                    self._file = None
+
+
+_global_lock = threading.Lock()
+_LOG = EventLog()
+
+
+def get_event_log() -> EventLog:
+    return _LOG
+
+
+def configure_event_log(path: str) -> EventLog:
+    """Point the process-wide event log at ``path`` (e.g. the CLI's
+    ``<outdir>/events.jsonl``).  Replaces the previous sink; already-
+    emitted events are not rewritten.  The file is created immediately
+    (even if no event ever fires) so "clean run" and "no log
+    configured" are distinguishable artefacts."""
+    global _LOG
+    with _global_lock:
+        _LOG.close()
+        _LOG = EventLog(path)
+        if path:
+            try:
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                open(path, "a").close()
+            except OSError as exc:
+                warnings.warn(f"event log {path!r} not writable: {exc}")
+        return _LOG
+
+
+def warn_event(kind: str, message: str, *, category=UserWarning,
+               stacklevel: int = 3, **fields):
+    """Raise ``warnings.warn(message)`` AND record it as a typed,
+    counted event.
+
+    Drop-in replacement for the pipeline's bare ``warnings.warn``
+    sites: the warning semantics (category, filterability,
+    ``pytest.warns``) are unchanged, and the event lands in the JSONL
+    log plus the ``events.<kind>`` registry counter so end-of-run
+    reports can state exactly what went sideways and how often.
+    ``stacklevel`` defaults to 3 so the warning points at the caller's
+    caller — the same frame the old inline ``warnings.warn`` blamed.
+    """
+    get_event_log().emit(kind, message, **fields)
+    warnings.warn(message, category, stacklevel=stacklevel)

@@ -1,0 +1,211 @@
+"""Thread-safe process-wide metrics registry.
+
+Three primitive kinds, chosen for what the search drivers actually
+need to report (see ISSUE/README "Observability"):
+
+* **counters** — monotonically increasing event tallies (peak-buffer
+  overflows, capacity escalations, checkpoint invalidations, ...);
+* **gauges** — last-written values (HBM budget/estimate figures,
+  trial-grid geometry);
+* **stage timers** — accumulated per-stage durations that split
+  **host wall-clock** from **device time**: the timed block calls
+  ``handle.block(arrays)`` wherever it would ``block_until_ready``,
+  and the measured wait is attributed to the stage as device time.
+  On a remote-attached TPU that wait is device execution plus link
+  latency — exactly the share of wall-clock the host cannot reclaim,
+  which is the attribution ``BENCH_*.json`` previously lacked.
+
+Jit-compile tracking: :func:`install_compile_hook` registers a
+``jax.monitoring`` duration listener counting XLA backend compiles
+(and their total seconds) process-wide, and
+:func:`jit_program_cache_sizes` reports compiled-signature counts per
+named jitted program so a recompile storm is attributable.
+
+Everything is safe to call from worker threads; the registry uses one
+re-entrant lock so nested timers on one thread cannot deadlock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class _TimerHandle:
+    """Yielded by :meth:`MetricsRegistry.timer`; the timed block calls
+    :meth:`block` wherever it would ``block_until_ready`` so the wait
+    is attributed to the stage as device time."""
+
+    __slots__ = ("device_s",)
+
+    def __init__(self):
+        self.device_s = 0.0
+
+    def block(self, tree):
+        """``jax.block_until_ready(tree)``, charging the wait to the
+        stage's device time.  Returns ``tree`` for call-through use."""
+        import jax
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(tree)
+        self.device_s += time.perf_counter() - t0
+        return tree
+
+    def add_device_time(self, seconds: float) -> None:
+        """Charge externally-measured device seconds to the stage
+        (for drivers that already clock their fetches)."""
+        self.device_s += float(seconds)
+
+
+class MetricsRegistry:
+    """Counters + gauges + host/device stage timers behind one lock."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._timers: dict[str, dict] = {}
+
+    # -- counters / gauges -------------------------------------------------
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            val = self._counters.get(name, 0) + int(n)
+            self._counters[name] = val
+            return val
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    # -- stage timers ------------------------------------------------------
+
+    def observe(self, name: str, host_s: float,
+                device_s: float = 0.0) -> None:
+        """Accumulate one observation of a stage's duration."""
+        with self._lock:
+            rec = self._timers.setdefault(
+                name, {"count": 0, "host_s": 0.0, "device_s": 0.0})
+            rec["count"] += 1
+            rec["host_s"] += float(host_s)
+            rec["device_s"] += float(device_s)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Time a stage; nesting is fine (each level records its own
+        stage).  The yielded handle attributes device waits — see
+        :class:`_TimerHandle`."""
+        handle = _TimerHandle()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            self.observe(name, time.perf_counter() - t0, handle.device_s)
+
+    # -- snapshot / reset --------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Deep-copied point-in-time view: ``{"counters", "gauges",
+        "timers"}``."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": {k: dict(v) for k, v in self._timers.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+
+
+#: process-wide registry both drivers, the CLI and bench.py report from
+REGISTRY = MetricsRegistry()
+
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_hook_lock = threading.Lock()
+_hook_installed = False
+
+
+def install_compile_hook(registry: MetricsRegistry | None = None) -> bool:
+    """Count XLA backend compiles into the registry (idempotent).
+
+    Registers a ``jax.monitoring`` duration listener: every backend
+    compile increments ``jit.backend_compiles`` and accumulates into
+    the ``jit_compile`` stage timer, so the report can state how much
+    wall-clock went to compilation and whether a "slow" run was really
+    a recompile storm.  Returns True if the hook is active.
+    """
+    global _hook_installed
+    reg = registry if registry is not None else REGISTRY
+    with _hook_lock:
+        if _hook_installed:
+            return True
+        try:
+            from jax import monitoring
+
+            def _on_duration(event, duration, **kwargs):
+                if event == _BACKEND_COMPILE_EVENT:
+                    reg.inc("jit.backend_compiles")
+                    reg.observe("jit_compile", float(duration))
+
+            monitoring.register_event_duration_secs_listener(_on_duration)
+        except Exception:  # pragma: no cover - jax.monitoring absent
+            return False
+        _hook_installed = True
+        return True
+
+
+def jit_program_cache_sizes() -> dict[str, int]:
+    """Compiled-signature count per named jitted program.
+
+    A jit object's cache size equals the number of distinct
+    (shape, static-arg) signatures compiled through it this process —
+    the per-program face of the global ``jit.backend_compiles``
+    counter.  Probes the pipeline's module-level programs plus the
+    mesh builders' lru caches; anything unimportable (or a jax version
+    without ``_cache_size``) is simply omitted.
+    """
+    out: dict[str, int] = {}
+
+    def probe(name, fn):
+        size = getattr(fn, "_cache_size", None)
+        try:
+            if callable(size):
+                out[name] = int(size())
+        except Exception:
+            pass
+
+    try:
+        from ..search import pipeline as pl
+
+        probe("whiten_trial", pl.whiten_trial)
+        probe("search_accel_chunk", pl.search_accel_chunk)
+        probe("search_accel_chunk_legacy", pl.search_accel_chunk_legacy)
+        probe("rewhiten_for_fold", pl._rewhiten_for_fold)
+        probe("batched_fold_program", pl._batched_fold_program)
+    except Exception:
+        pass
+    try:
+        import sys
+
+        # only report the mesh builders when something already imported
+        # them — probing must not drag the mesh stack into a CPU-only
+        # single-device process
+        mesh = sys.modules.get("peasoup_tpu.parallel.mesh")
+        if mesh is not None:
+            out["build_fused_search"] = (
+                mesh.build_fused_search.cache_info().currsize)
+            out["build_chunked_search"] = (
+                mesh.build_chunked_search.cache_info().currsize)
+    except Exception:
+        pass
+    return out

@@ -1,0 +1,195 @@
+"""End-of-run machine-readable ``run_report.json``.
+
+The reference's only run artefact is ``overview.xml`` with a
+wall-clock ``<execution_times>`` block; a production service needs a
+machine-readable report it can ship to a metrics backend without an
+XML parser.  :func:`build_run_report` assembles one dict from the
+process-wide telemetry (metrics registry + event log) plus the
+``SearchResult``; the CLI writes it as ``run_report.json`` next to
+``overview.xml`` (and mirrors a ``<telemetry>`` section into the XML
+for the legacy toolchain).
+
+Report schema (top-level keys, all optional consumers should
+tolerate additions)::
+
+    version          int    report schema version
+    generated_utc    str    ISO-8601 UTC timestamp
+    timers           {name: seconds}        driver wall-clock timers
+    stage_timers     {name: {count, host_s, device_s}}
+    counters         {name: int}            incl. events.<kind> tallies
+    gauges           {name: float}          incl. hbm.* figures
+    events           {kind: count}          event-log summary
+    jit              {backend_compiles, compile_s, programs: {name: n}}
+    device           {backend, jax_version, device_count, devices: []}
+    candidates       {count, folded, best_snr, best_folded_snr, ...}
+    config           {key search parameters}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+REPORT_VERSION = 1
+
+
+def device_summary() -> dict:
+    """Backend + per-device identity (TPU stand-in for the reference's
+    cuda_device_parameters, mirroring xml_writer.add_device_info)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "jax_version": jax.__version__,
+            "device_count": len(devices),
+            "devices": [
+                {"id": ii, "kind": str(d.device_kind),
+                 "platform": str(d.platform)}
+                for ii, d in enumerate(devices)
+            ],
+        }
+    except Exception as exc:  # pragma: no cover - jax init failure
+        return {"error": repr(exc)}
+
+
+def candidate_summary(candidates) -> dict:
+    """Aggregate candidate statistics (counts, SNR extremes, DM/freq
+    coverage) — the per-run health signal a survey dashboard plots."""
+    cands = list(candidates)
+    out: dict = {"count": len(cands)}
+    if not cands:
+        return out
+    snrs = [float(c.snr) for c in cands]
+    folded = [c for c in cands if float(c.folded_snr) > 0.0]
+    out.update(
+        folded=len(folded),
+        best_snr=round(max(snrs), 4),
+        median_snr=round(sorted(snrs)[len(snrs) // 2], 4),
+        n_assoc_total=sum(c.count_assoc() for c in cands),
+        dm_min=round(min(float(c.dm) for c in cands), 6),
+        dm_max=round(max(float(c.dm) for c in cands), 6),
+        freq_min_hz=round(min(float(c.freq) for c in cands), 6),
+        freq_max_hz=round(max(float(c.freq) for c in cands), 6),
+    )
+    if folded:
+        out["best_folded_snr"] = round(
+            max(float(c.folded_snr) for c in folded), 4)
+    return out
+
+
+_CONFIG_KEYS = (
+    "infilename", "dm_start", "dm_end", "dm_tol", "acc_start", "acc_end",
+    "acc_tol", "nharmonics", "npdmp", "min_snr", "limit", "peak_capacity",
+    "compact_capacity", "hbm_budget_gb", "dm_chunk", "accel_block",
+    "trial_nbits", "subband_dedisp", "size",
+)
+
+
+def build_run_report(result=None, registry=None, events=None,
+                     extra: dict | None = None) -> dict:
+    """Assemble the run report dict.
+
+    ``result``: a ``SearchResult`` (or None for a bare-telemetry
+    report); ``registry``/``events`` default to the process-wide
+    instances.  ``extra`` is merged in last under its own keys — the
+    benchmark uses it for its parity/vs_baseline figures.
+    """
+    from .events import get_event_log
+    from .metrics import REGISTRY, jit_program_cache_sizes
+
+    reg = registry if registry is not None else REGISTRY
+    log = events if events is not None else get_event_log()
+    snap = reg.snapshot()
+    jit_timer = snap["timers"].get("jit_compile", {})
+    report = {
+        "version": REPORT_VERSION,
+        "generated_utc": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "timers": {},
+        "stage_timers": {
+            k: {"count": v["count"],
+                "host_s": round(v["host_s"], 6),
+                "device_s": round(v["device_s"], 6)}
+            for k, v in snap["timers"].items()
+        },
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "events": log.summary(),
+        "jit": {
+            "backend_compiles": snap["counters"].get(
+                "jit.backend_compiles", 0),
+            "compile_s": round(jit_timer.get("host_s", 0.0), 4),
+            "programs": jit_program_cache_sizes(),
+        },
+        "device": device_summary(),
+    }
+    if result is not None:
+        report["timers"] = {
+            k: round(float(v), 6)
+            for k, v in getattr(result, "timers", {}).items()
+            if isinstance(v, (int, float))
+        }
+        report["candidates"] = candidate_summary(result.candidates)
+        cfg = getattr(result, "config", None)
+        if cfg is not None:
+            report["config"] = {
+                k: getattr(cfg, k)
+                for k in _CONFIG_KEYS if hasattr(cfg, k)
+            }
+        report["n_dm_trials"] = int(len(result.dm_list))
+        report["n_accel_trials_dm0"] = int(len(result.acc_list_dm0))
+    if extra:
+        report.update(extra)
+    return report
+
+
+def write_run_report(path: str, result=None, registry=None, events=None,
+                     extra: dict | None = None) -> dict:
+    """Build and atomically write ``run_report.json``; returns the
+    report dict (telemetry I/O failures warn, never raise)."""
+    report = build_run_report(result, registry, events, extra)
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError as exc:
+        import warnings
+
+        warnings.warn(f"could not write run report {path!r}: {exc}")
+    return report
+
+
+def format_stage_table(report: dict) -> str:
+    """Human-readable per-stage timing table (CLI ``--verbose``).
+
+    Renders the registry's stage timers — host wall-clock next to the
+    device share — then the event summary, so a terminal user sees
+    what the XML/JSON consumers see without opening either.
+    """
+    lines = ["stage                          n   host_s  device_s"]
+    stages = report.get("stage_timers", {})
+    for name in sorted(stages, key=lambda k: -stages[k]["host_s"]):
+        rec = stages[name]
+        lines.append(
+            f"{name:<28}{rec['count']:>4} {rec['host_s']:>8.3f} "
+            f"{rec['device_s']:>9.3f}"
+        )
+    jit = report.get("jit", {})
+    if jit:
+        lines.append(
+            f"jit: {jit.get('backend_compiles', 0)} backend compiles, "
+            f"{jit.get('compile_s', 0.0):.2f} s"
+        )
+    ev = report.get("events", {})
+    if ev:
+        lines.append("events: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(ev.items())))
+    return "\n".join(lines)

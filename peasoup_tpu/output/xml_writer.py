@@ -170,3 +170,41 @@ class OutputFileWriter:
         el = self.root.append(XMLElement("execution_times"))
         for key in sorted(timers):
             el.append(XMLElement(key, float(timers[key])))
+
+    def add_telemetry(self, report: dict) -> None:
+        """``<telemetry>`` section mirroring ``run_report.json``
+        (obs/report.py) for the legacy XML toolchain: stage timers
+        with the host/device split, counters, gauges and the event
+        summary.  Names travel as ``name=''`` attributes — registry
+        keys are dotted (``events.foo``), which XML tag names reject.
+        """
+        el = self.root.append(XMLElement("telemetry"))
+        stages = el.append(XMLElement("stage_timers"))
+        for name in sorted(report.get("stage_timers", {})):
+            rec = report["stage_timers"][name]
+            st = stages.append(XMLElement("stage"))
+            st.add_attribute("name", name)
+            st.add_attribute("count", rec["count"])
+            st.append(XMLElement("host_s", float(rec["host_s"])))
+            st.append(XMLElement("device_s", float(rec["device_s"])))
+        counters = el.append(XMLElement("counters"))
+        for name in sorted(report.get("counters", {})):
+            c = counters.append(
+                XMLElement("counter", int(report["counters"][name])))
+            c.add_attribute("name", name)
+        gauges = el.append(XMLElement("gauges"))
+        for name in sorted(report.get("gauges", {})):
+            g = gauges.append(
+                XMLElement("gauge", float(report["gauges"][name])))
+            g.add_attribute("name", name)
+        events = el.append(XMLElement("events"))
+        for kind in sorted(report.get("events", {})):
+            ev = events.append(
+                XMLElement("event", int(report["events"][kind])))
+            ev.add_attribute("kind", kind)
+        jit = report.get("jit", {})
+        jel = el.append(XMLElement("jit"))
+        jel.append(XMLElement("backend_compiles",
+                              int(jit.get("backend_compiles", 0))))
+        jel.append(XMLElement("compile_s",
+                              float(jit.get("compile_s", 0.0))))

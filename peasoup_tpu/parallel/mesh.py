@@ -36,6 +36,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import ConfigError, HBMBudgetError
+from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
@@ -59,6 +61,20 @@ from ..utils.hostfetch import (  # re-exported; also used below
     fetch_to_host,
     put_global,
 )
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma: bool):
+    """``jax.shard_map`` across jax versions: the top-level binding (and
+    its ``check_vma`` kwarg) only exist from 0.5/0.7; earlier releases
+    ship ``jax.experimental.shard_map`` with the equivalent
+    ``check_rep`` flag."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
@@ -289,7 +305,7 @@ def build_fused_search(
         packed = _compact_peaks(idxs, snrs, counts, compact_k)
         return packed, trials
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(
@@ -569,7 +585,7 @@ def build_chunked_search(
         sb_specs = (P("dm", None), P("dm", None), P("dm"))
     else:
         sb_specs = (P("dm", None), P("dm"), P("dm", None))
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(),) * n_parts + sb_specs + (
@@ -817,6 +833,8 @@ class MeshPulsarSearch(PulsarSearch):
             # channel-major transient alongside the packed input
             + 4 * self.fil.nchans * self.fil.nsamps
         )
+        METRICS.gauge("hbm.est_full_bytes", est_full)
+        METRICS.gauge("hbm.budget_bytes", budget)
         if est_full <= budget and not cfg.dm_chunk and not cfg.accel_block:
             return None
 
@@ -1215,8 +1233,11 @@ class MeshPulsarSearch(PulsarSearch):
                         list(fs), d, nsamps_dev, self.out_nsamps)
                 )
             cache[dm_tile] = fn
-        return self._maybe_quantise(
-            fn(jnp.asarray(delays_rows), *data_parts))
+        from ..utils import trace_range
+
+        with trace_range("Dedisperse"), METRICS.timer("dedispersion") as tm:
+            return self._maybe_quantise(
+                tm.block(fn(jnp.asarray(delays_rows), *data_parts)))
 
     def _fold_trials_provider(self, dm_idxs):
         """Re-dedisperse just the candidate DM rows for folding (the
@@ -1246,14 +1267,15 @@ class MeshPulsarSearch(PulsarSearch):
         import time
 
         cfg = self.config
+        METRICS.inc("runs.mesh_chunked")
         if cfg.dump_dir:
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                "path_fallback",
                 "--dump_dir is ignored on the bounded-HBM chunked path "
                 "(trials are never all resident); re-run with "
                 "--single_device or a smaller input to dump whitening "
-                "stages"
+                "stages",
+                what="dump_dir", path="chunked",
             )
         ndm = len(self.dm_list)
         ndm_local_p = plan["ndm_local_p"]
@@ -1303,6 +1325,10 @@ class MeshPulsarSearch(PulsarSearch):
         # observability: the benchmark's transfer model reads these
         self._chunk_buffer_shapes = (cap, compact_k)
         self._chunk_plan = plan
+        METRICS.gauge("chunk.dm_chunk", dm_chunk)
+        METRICS.gauge("chunk.accel_block", plan["accel_block"])
+        METRICS.gauge("chunk.peak_capacity", cap)
+        METRICS.gauge("chunk.compact_k", compact_k)
         from ..utils import trace_range
 
         t0 = time.time()
@@ -1464,10 +1490,11 @@ class MeshPulsarSearch(PulsarSearch):
             phases["fetch"] += time.time() - tp
             pending = nxt if k + 1 < len(todo) else None
             tp = time.time()
-            (groups_l, mx_count, mx_valid, counts_l,
-             clipped_l, _truncated_l) = self._decode_packed(
-                packed, dm_chunk, namax_p, nlevels, cap, compact_k
-            )
+            with trace_range("Peak-Decode"):
+                (groups_l, mx_count, mx_valid, counts_l,
+                 clipped_l, _truncated_l) = self._decode_packed(
+                    packed, dm_chunk, namax_p, nlevels, cap, compact_k
+                )
             hw_count = max(hw_count, mx_count)
             # per-shard TRUE totals (uncapped counts), not nvalid: when
             # this run clipped, nvalid under-measures what an unclipped
@@ -1496,12 +1523,13 @@ class MeshPulsarSearch(PulsarSearch):
             # one segmented native call distills every non-clipped row
             # of the chunk (rows with no peaks get an empty group)
             tp = time.time()
-            batch = self._distill_rows_batch(
-                (int(rows[key]), groups_l.get(key),
-                 acc_lists[int(rows[key])])
-                for key in range(len(rows))
-                if int(rows[key]) < ndm and key not in clipped_l
-            )
+            with trace_range("Distill"):
+                batch = self._distill_rows_batch(
+                    (int(rows[key]), groups_l.get(key),
+                     acc_lists[int(rows[key])])
+                    for key in range(len(rows))
+                    if int(rows[key]) < ndm and key not in clipped_l
+                )
             n_new = 0
             for ii, cands_ii in batch.items():
                 ckpt_done[ii] = cands_ii
@@ -1540,6 +1568,11 @@ class MeshPulsarSearch(PulsarSearch):
             program.clear_cache()
         build_chunked_search.cache_clear()
         gc.collect()
+        # cleanup (cache drop + full-heap gc, ~1 s on a big host heap)
+        # is charged to its own phase: billing it to "research" made
+        # clip-free runs look like they paid a re-search
+        phases["cleanup"] = time.time() - tp
+        tp = time.time()
         rerun = self._rerun_clipped_rows(
             set(all_clipped), all_clipped, self._fold_trials_provider,
         )
@@ -1573,6 +1606,16 @@ class MeshPulsarSearch(PulsarSearch):
         timers.update({f"chunk_{p}": round(v, 2)
                        for p, v in phases.items()})
         timers["searching_device"] = time.time() - t0
+        # mirror the per-phase breakdown into the metrics registry;
+        # dispatch/fetch/compile are time spent waiting on the device
+        # (or the link to it) — the chunked driver's device share
+        for p, v in phases.items():
+            if isinstance(v, float):
+                METRICS.observe(f"chunk_{p}", v)
+        METRICS.observe(
+            "chunked_search", timers["searching_device"],
+            phases["dispatch"] + phases["fetch"] + phases["compile"],
+        )
         for ii in range(ndm):
             dm_cands.append(ckpt_done.get(ii, []))
         if ckpt:
@@ -1661,16 +1704,18 @@ class MeshPulsarSearch(PulsarSearch):
             over = (shard_counts > cap).any(axis=(1, 2))
             under = k < expect
             if under.any():
-                import warnings
-
-                warnings.warn(
+                warn_event(
+                    "peak_underdelivery",
                     f"device peak extraction under-delivered on "
                     f"{int(under.sum())} spectra (shard {s}): got "
                     f"{int(k[under].sum())} of "
                     f"{int(expect[under].sum())} expected slots — "
                     f"re-searching the affected DM rows on the host "
                     f"path (this indicates a backend top-k anomaly "
-                    f"worth reporting)"
+                    f"worth reporting)",
+                    n_spectra=int(under.sum()), shard=int(s),
+                    got=int(k[under].sum()),
+                    expected=int(expect[under].sum()),
                 )
             for d in range(ndm_local):
                 sl = slice(d * namax * nlevels, (d + 1) * namax * nlevels)
@@ -1714,15 +1759,15 @@ class MeshPulsarSearch(PulsarSearch):
         top_k capacities inside the big program crash the v5e
         backend).  Returns {dm_idx: distilled candidates}.
         """
-        import warnings
-
         ndm = len(self.dm_list)
         rows = sorted(ii for ii in clipped_rows if ii < ndm)
         if not rows:
             return {}
-        warnings.warn(
+        warn_event(
+            "capacity_escalation",
             f"peak buffers clipped on {len(rows)} DM trial(s); "
-            f"re-searching those rows with escalated capacity"
+            f"re-searching those rows with escalated capacity",
+            n_rows=len(rows), rows=rows[:64],
         )
         # NOTE: a one-dispatch batched re-search (an escalated-capacity
         # chunk program over all clipped rows) was tried and REVERTED:
@@ -1771,17 +1816,18 @@ class MeshPulsarSearch(PulsarSearch):
         by it (over-capacity rows would stay clipped regardless of
         compact_k) that per-row re-runs would cost more than
         recompiling the dispatch."""
-        import warnings
-
         if (max_nvalid > compact_k and compact_k < total_slots
                 and n_truncated > max(4, ndm // 4)):
             new_ck = int(min(
                 total_slots, 1 << int(np.ceil(np.log2(max_nvalid)))
             ))
-            warnings.warn(
+            warn_event(
+                "compact_buffer_escalation",
                 f"compacted peak buffer truncated {n_truncated} rows "
                 f"({max_nvalid}/{compact_k}); re-running with "
-                f"compact_capacity={new_ck}"
+                f"compact_capacity={new_ck}",
+                n_truncated=int(n_truncated), max_nvalid=int(max_nvalid),
+                compact_k=int(compact_k), new_compact_k=new_ck,
             )
             return cap, new_ck
         return None
@@ -1789,9 +1835,16 @@ class MeshPulsarSearch(PulsarSearch):
     def run(self) -> SearchResult:
         import time
 
+        from ..obs.metrics import install_compile_hook
+
+        install_compile_hook()
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
+        METRICS.gauge("hbm.data_bytes", self._data_bytes())
+        METRICS.gauge("search.n_dm_trials", len(self.dm_list))
+        METRICS.gauge("search.fft_size", self.size)
+        METRICS.gauge("search.n_devices", self.ndev)
 
         ndm = len(self.dm_list)
 
@@ -1848,14 +1901,14 @@ class MeshPulsarSearch(PulsarSearch):
                 plan, acc_lists, namax, timers, t_total, ckpt, ckpt_done
             )
         if cfg.subband_dedisp != "never":
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                "path_fallback",
                 "subband_dedisp is ignored on the fused (small-input) "
                 "mesh path: its one-dispatch program keeps the exact "
                 "direct sweep, which is already cheap at this scale; "
                 "the chunked production driver and --single_device "
-                "honour it"
+                "honour it",
+                what="subband_dedisp", path="fused",
             )
         nlevels = cfg.nharmonics + 1
         # Pallas-kernel dedispersion inside the fused program: needs DM
@@ -1924,17 +1977,23 @@ class MeshPulsarSearch(PulsarSearch):
                 quantise=cfg.trial_nbits == 8,
             )
 
+        METRICS.inc("runs.mesh_fused")
         while True:
             program = make_program(cap, compact_k)
-            with trace_range("Fused-Search"):
+            with trace_range("Fused-Search"), \
+                    METRICS.timer("fused_search") as tm:
                 packed, trials = program(*inputs)
                 # ONE gather over ICI/DCN -> host; ``trials`` stays on
-                # device for the folding phase
+                # device for the folding phase.  The fetch wait is the
+                # device (plus link) share of this stage's wall-clock.
+                tf = time.time()
                 packed = fetch_to_host(packed)
-            (per_dm_groups, mx_count, mx_valid, counts_arr,
-             clipped, truncated) = self._decode_packed(
-                packed, ndm_local, namax, nlevels, cap, compact_k
-            )
+                tm.add_device_time(time.time() - tf)
+            with trace_range("Peak-Decode"), METRICS.timer("peak_decode"):
+                (per_dm_groups, mx_count, mx_valid, counts_arr,
+                 clipped, truncated) = self._decode_packed(
+                    packed, ndm_local, namax, nlevels, cap, compact_k
+                )
             nxt = self._escalated(
                 cap, compact_k, mx_count, mx_valid,
                 ndm_local * namax * nlevels * cap,
@@ -2000,10 +2059,11 @@ class MeshPulsarSearch(PulsarSearch):
         timers["searching_device"] = time.time() - t0
         dm_cands = CandidateCollection()
         ckpt_done = {}
-        batch = self._distill_rows_batch(
-            (ii, per_dm_groups.get(ii), acc_lists[ii])
-            for ii in range(ndm) if ii not in rerun
-        )
+        with trace_range("Distill"), METRICS.timer("distillation"):
+            batch = self._distill_rows_batch(
+                (ii, per_dm_groups.get(ii), acc_lists[ii])
+                for ii in range(ndm) if ii not in rerun
+            )
         for ii in range(ndm):
             cands_ii = rerun[ii] if ii in rerun else batch[ii]
             ckpt_done[ii] = cands_ii

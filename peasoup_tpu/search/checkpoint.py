@@ -16,13 +16,13 @@ from __future__ import annotations
 
 import json
 import os
-import warnings
 from dataclasses import asdict
 
 import numpy as np
 
 from ..data.candidates import Candidate
 from ..errors import CheckpointError
+from ..obs.events import warn_event
 
 # v3: append-only JSONL — header line then one line per completed DM
 # row, so each save is O(rows added) not O(all rows accumulated)
@@ -38,6 +38,7 @@ _FORMAT_VERSION = 3
 _NON_IDENTITY_FIELDS = {
     "verbose", "progress_bar", "checkpoint_file", "checkpoint_interval",
     "outdir", "accel_chunk", "dump_dir", "measure_stages", "tune_file",
+    "events_log", "metrics_json",
 }
 
 
@@ -153,20 +154,27 @@ class SearchCheckpoint:
             if not isinstance(header, dict):
                 raise CheckpointError("missing header line")
         except Exception as exc:
-            warnings.warn(
-                f"ignoring unreadable checkpoint {self.path!r}: {exc}"
+            warn_event(
+                "checkpoint_invalid",
+                f"ignoring unreadable checkpoint {self.path!r}: {exc}",
+                path=self.path, reason="unreadable", error=str(exc),
             )
             return None
         if header.get("version") != _FORMAT_VERSION:
-            warnings.warn(
+            warn_event(
+                "checkpoint_invalid",
                 f"ignoring checkpoint {self.path!r}: format version "
-                f"{header.get('version')} != {_FORMAT_VERSION}"
+                f"{header.get('version')} != {_FORMAT_VERSION}",
+                path=self.path, reason="version_mismatch",
+                found=header.get("version"), expected=_FORMAT_VERSION,
             )
             return None
         if header.get("key") != self.key:
-            warnings.warn(
+            warn_event(
+                "checkpoint_invalid",
                 f"ignoring checkpoint {self.path!r}: it belongs to a "
-                "different search (input/config mismatch)"
+                "different search (input/config mismatch)",
+                path=self.path, reason="key_mismatch",
             )
             return None
         out: dict[int, list[Candidate]] = {}
@@ -190,9 +198,11 @@ class SearchCheckpoint:
                 # torn tail from a crash mid-append: keep the rows
                 # before it and truncate the garbage so this run's
                 # appends land on a clean line boundary
-                warnings.warn(
+                warn_event(
+                    "checkpoint_torn_tail",
                     f"checkpoint {self.path!r}: dropping corrupt data "
-                    f"from line {ln} ({len(out)} completed rows kept)"
+                    f"from line {ln} ({len(out)} completed rows kept)",
+                    path=self.path, line=ln, rows_kept=len(out),
                 )
                 with open(self.path, "r+") as f:
                     f.truncate(good_bytes)

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from ..data.candidates import Candidate, CandidateCollection
 from ..errors import ConfigError
 from ..io.sigproc import Filterbank
+from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
 from ..ops import (
     dedisperse,
     delay_table,
@@ -425,32 +427,34 @@ class PulsarSearch:
         while True:  # auto-escalate on peak-buffer overflow: no silent
             all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
             for c0 in range(0, padded, chunk):
-                if self.resample_block is not None:
-                    idxs, snrs, counts = search_accel_chunk(
-                        tim_w, chunk_tables[c0], mean, std,
-                        float(self.fil.tsamp), cfg.nharmonics, self.bounds,
-                        cap, cfg.min_snr, self.max_shift,
-                        self.resample_block,
-                    )
-                else:
-                    batch = jnp.asarray(accs[c0 : c0 + chunk])
-                    idxs, snrs, counts = search_accel_chunk_legacy(
-                        tim_w, batch, mean, std, float(self.fil.tsamp),
-                        cfg.nharmonics, self.bounds, cap, cfg.min_snr,
-                        self.max_shift,
-                    )
+                with METRICS.timer("accel_search") as tm:
+                    if self.resample_block is not None:
+                        idxs, snrs, counts = search_accel_chunk(
+                            tim_w, chunk_tables[c0], mean, std,
+                            float(self.fil.tsamp), cfg.nharmonics,
+                            self.bounds, cap, cfg.min_snr, self.max_shift,
+                            self.resample_block,
+                        )
+                    else:
+                        batch = jnp.asarray(accs[c0 : c0 + chunk])
+                        idxs, snrs, counts = search_accel_chunk_legacy(
+                            tim_w, batch, mean, std, float(self.fil.tsamp),
+                            cfg.nharmonics, self.bounds, cap, cfg.min_snr,
+                            self.max_shift,
+                        )
+                    tm.block((idxs, snrs, counts))
                 all_idxs.append(np.asarray(idxs))
                 all_snrs.append(np.asarray(snrs))
                 all_counts.append(np.asarray(counts))
             mx = int(max(c.max(initial=0) for c in all_counts))
             if mx <= cap:
                 break
-            import warnings
-
             cap = 1 << int(np.ceil(np.log2(mx)))
-            warnings.warn(
+            warn_event(
+                "capacity_escalation",
                 f"peak buffer overflow on DM trial {idx} (count {mx}); "
-                f"re-running with capacity={cap}"
+                f"re-running with capacity={cap}",
+                dm_trial=int(idx), count=mx, capacity=cap,
             )
         return self.process_dm_peaks(
             dm, idx, acc_list,
@@ -612,11 +616,12 @@ class PulsarSearch:
             cap = capacity or self.config.peak_capacity
             take = min(cnt, cap)
             if cnt > cap:
-                import warnings
-
-                warnings.warn(
+                warn_event(
+                    "peak_buffer_overflow",
                     f"peak buffer overflow: {cnt} > capacity {cap} "
-                    f"(dm={dm}, acc={acc}, nh={level}); raise peak_capacity"
+                    f"(dm={dm}, acc={acc}, nh={level}); raise peak_capacity",
+                    count=cnt, capacity=int(cap), dm=float(dm),
+                    acc=float(acc), nh=int(level),
                 )
             bi = np.asarray(idxs[level][:take])
             bs = np.asarray(snrs[level][:take])
@@ -625,12 +630,13 @@ class PulsarSearch:
                 # prefix means the device extraction under-delivered
                 # (backend top-k anomaly); drop the sentinels rather
                 # than fabricate freq<0 / snr=0 candidates
-                import warnings
-
-                warnings.warn(
+                warn_event(
+                    "peak_underdelivery",
                     f"peak extraction under-delivered "
                     f"{int((bi < 0).sum())} of {take} slots "
-                    f"(dm={dm}, acc={acc}, nh={level})"
+                    f"(dm={dm}, acc={acc}, nh={level})",
+                    missing=int((bi < 0).sum()), expected=int(take),
+                    dm=float(dm), acc=float(acc), nh=int(level),
                 )
                 keep = bi >= 0
                 bi, bs = bi[keep], bs[keep]
@@ -668,11 +674,18 @@ class PulsarSearch:
         return search_key(self.config.infilename, self.fil, self.config)
 
     def run(self) -> SearchResult:
+        from ..obs.metrics import install_compile_hook
         from ..utils import ProgressBar, trace_range
 
+        install_compile_hook()
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
+        METRICS.inc("runs.host_loop")
+        METRICS.gauge("hbm.budget_bytes", cfg.hbm_budget_gb * 1e9)
+        METRICS.gauge("hbm.data_bytes", self._data_bytes())
+        METRICS.gauge("search.n_dm_trials", len(self.dm_list))
+        METRICS.gauge("search.fft_size", self.size)
 
         # consult the checkpoint BEFORE dedispersing: a fully-complete
         # resume only needs trials if folding will run
@@ -682,9 +695,10 @@ class PulsarSearch:
         timers["dedispersion"] = 0.0
         if not (complete and cfg.npdmp == 0):
             t0 = time.time()
-            with trace_range("Dedisperse"):
+            with trace_range("Dedisperse"), \
+                    METRICS.timer("dedispersion") as tm:
                 trials = self.dedisperse()
-                trials.block_until_ready()
+                tm.block(trials)
             timers["dedispersion"] = time.time() - t0
 
         t0 = time.time()
@@ -692,7 +706,7 @@ class PulsarSearch:
         pbar = ProgressBar(len(self.dm_list), "DM trials ",
                            enabled=cfg.progress_bar)
         pbar.start()
-        with trace_range("DM-Loop"):
+        with trace_range("DM-Loop"), METRICS.timer("searching"):
             for ii in range(len(self.dm_list)):
                 if ii not in done:
                     done[ii] = self.search_dm_trial(trials, ii)
@@ -719,10 +733,12 @@ class PulsarSearch:
         candidate DM rows are re-dedispersed only if folding runs.
         """
         cfg = self.config
-        dm_still = DMDistiller(cfg.freq_tol, True)
-        harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True, False)
-        cands = dm_still.distill(dm_cands.cands)
-        cands = harm_still.distill(cands)
+        with METRICS.timer("distillation"):
+            dm_still = DMDistiller(cfg.freq_tol, True)
+            harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True,
+                                           False)
+            cands = dm_still.distill(dm_cands.cands)
+            cands = harm_still.distill(cands)
 
         hdr = self.fil.header
         scorer = CandidateScorer(
@@ -765,7 +781,7 @@ class PulsarSearch:
                     search_accel_chunk.clear_cache()
                     search_accel_chunk_legacy.clear_cache()
                     gc.collect()
-                with trace_range("Folding"):
+                with trace_range("Folding"), METRICS.timer("folding"):
                     fold_candidates(
                         cands, trials, self.out_nsamps, hdr.tsamp,
                         cfg.npdmp,
@@ -930,12 +946,12 @@ def fold_candidates(
     }
     bad = [ii for ii in fold_ids if 4 * max(shifts[ii], 1) >= nsamps]
     if bad:
-        import warnings
-
-        warnings.warn(
+        warn_event(
+            "fold_domain_skip",
             f"skipping fold of {len(bad)} candidate(s) whose "
             f"acceleration shift exceeds the resampler's validity "
-            f"domain for a {nsamps}-sample fold (needs 4*shift < nsamps)"
+            f"domain for a {nsamps}-sample fold (needs 4*shift < nsamps)",
+            n_skipped=len(bad), nsamps=int(nsamps),
         )
         fold_ids = [ii for ii in fold_ids if ii not in bad]
     if not fold_ids:
@@ -1064,8 +1080,10 @@ def load_killmask(filename: str, nchans: int) -> np.ndarray:
             if line:
                 vals.append(int(line))
     if len(vals) != nchans:
-        import warnings
-
-        warnings.warn("killmask is not the same size as nchans; ignoring")
+        warn_event(
+            "killmask_mismatch",
+            "killmask is not the same size as nchans; ignoring",
+            killmask_len=len(vals), nchans=int(nchans), path=filename,
+        )
         return np.ones(nchans, np.float32)
     return np.array(vals, dtype=np.float32)

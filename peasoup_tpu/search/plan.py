@@ -116,6 +116,12 @@ class SearchConfig:
     # only across identical-delay trials, making sub-band output
     # bit-identical to the direct sweep)
     subband_eps: float = 0.5
+    # run-telemetry sinks (obs/): structured JSONL event log and the
+    # machine-readable run_report.json.  Empty = default next to
+    # overview.xml in outdir (CLI); presentation-only, never part of
+    # the search identity key
+    events_log: str = ""
+    metrics_json: str = ""
 
 
 class AccelerationPlan:

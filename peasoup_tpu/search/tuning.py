@@ -29,7 +29,8 @@ from __future__ import annotations
 
 import json
 import os
-import warnings
+
+from ..obs.events import warn_event
 
 _TUNE_VERSION = 1
 
@@ -43,7 +44,11 @@ def load_tuning(path: str, key: str) -> dict | None:
         with open(path) as f:
             obj = json.load(f)
     except Exception as exc:
-        warnings.warn(f"ignoring unreadable tune file {path!r}: {exc}")
+        warn_event(
+            "tune_io_error",
+            f"ignoring unreadable tune file {path!r}: {exc}",
+            path=path, op="load", error=str(exc),
+        )
         return None
     if obj.get("version") != _TUNE_VERSION or obj.get("key") != key:
         return None
@@ -77,7 +82,11 @@ def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
             json.dump(obj, f)
         os.replace(tmp, path)
     except OSError as exc:
-        warnings.warn(f"could not write tune file {path!r}: {exc}")
+        warn_event(
+            "tune_io_error",
+            f"could not write tune file {path!r}: {exc}",
+            path=path, op="save", error=str(exc),
+        )
 
 
 def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,

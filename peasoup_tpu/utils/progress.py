@@ -1,10 +1,11 @@
-"""Terminal progress reporting with ETA.
+"""Terminal progress reporting with ETA and throughput.
 
 Equivalent of the reference's pthread progress bar
 (`include/utils/progress_bar.hpp:7-73`), which prints percent complete
 and an ETA extrapolated from elapsed wall-clock.  Here progress is
 driven by explicit ``update(done)`` calls from the search loop instead
-of a polling thread.
+of a polling thread, and the line carries done/total counts plus the
+observed trials/s; ``finish()`` leaves a one-line run summary.
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ class ProgressBar:
         self.enabled = enabled
         self._start = None
         self._last_len = 0
+        self._done = 0
 
     def start(self) -> None:
         self._start = time.time()
@@ -33,13 +35,16 @@ class ProgressBar:
             return
         if self._start is None:
             self._start = time.time()
+        self._done = int(done)
         frac = min(done / self.total, 1.0)
         elapsed = time.time() - self._start
         eta = elapsed * (1.0 - frac) / frac if frac > 0 else float("inf")
+        rate = done / elapsed if elapsed > 0 and done > 0 else 0.0
         nfill = int(frac * self.width)
         bar = "#" * nfill + "-" * (self.width - nfill)
         eta_s = f"{eta:6.1f}s" if eta != float("inf") else "   ?  "
-        line = f"\r{self.label}[{bar}] {100 * frac:5.1f}%  ETA {eta_s}"
+        line = (f"\r{self.label}[{bar}] {done}/{self.total} "
+                f"{100 * frac:5.1f}%  {rate:6.1f}/s  ETA {eta_s}")
         self.stream.write(line + " " * max(0, self._last_len - len(line)))
         self._last_len = len(line)
         self.stream.flush()
@@ -48,5 +53,10 @@ class ProgressBar:
         if not self.enabled:
             return
         self.update(self.total)
-        self.stream.write("\n")
+        elapsed = time.time() - self._start if self._start else 0.0
+        rate = self.total / elapsed if elapsed > 0 else 0.0
+        self.stream.write(
+            f"\n{self.label}{self.total} trials in {elapsed:.1f} s, "
+            f"{rate:.1f} trials/s\n"
+        )
         self.stream.flush()

@@ -1,0 +1,346 @@
+"""Run-telemetry subsystem tests: metrics registry, JSONL event log,
+warn_event bridge, run_report.json end-to-end, and the repo lint that
+keeps every search/parallel warning routed through telemetry."""
+
+import json
+import os
+import re
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs.events import EventLog, warn_event
+from peasoup_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from peasoup_tpu.obs.report import build_run_report, format_stage_table
+
+
+# --------------------------------------------------------------------------
+# metrics registry
+# --------------------------------------------------------------------------
+
+def test_counter_concurrent_increments():
+    reg = MetricsRegistry()
+    n_threads, per_thread = 8, 500
+
+    def work():
+        for _ in range(per_thread):
+            reg.inc("hits")
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.counter("hits") == n_threads * per_thread
+
+
+def test_gauge_last_write_wins():
+    reg = MetricsRegistry()
+    reg.gauge("hbm.budget_bytes", 1.0)
+    reg.gauge("hbm.budget_bytes", 13e9)
+    assert reg.snapshot()["gauges"]["hbm.budget_bytes"] == 13e9
+
+
+def test_timer_nesting_and_device_host_split():
+    import jax.numpy as jnp
+
+    reg = MetricsRegistry()
+    with reg.timer("outer") as tm_out:
+        with reg.timer("inner") as tm_in:
+            arr = jnp.arange(1024) * 2
+            tm_in.block(arr)
+        tm_out.block(arr)
+    snap = reg.snapshot()["timers"]
+    assert snap["outer"]["count"] == 1
+    assert snap["inner"]["count"] == 1
+    # the inner stage is a sub-span of the outer one
+    assert snap["outer"]["host_s"] >= snap["inner"]["host_s"]
+    # device wait is a sub-span of host wall-clock, for both stages
+    for name in ("outer", "inner"):
+        assert 0.0 <= snap[name]["device_s"] <= snap[name]["host_s"]
+
+
+def test_timer_counts_accumulate():
+    reg = MetricsRegistry()
+    for _ in range(3):
+        with reg.timer("stage"):
+            pass
+    rec = reg.snapshot()["timers"]["stage"]
+    assert rec["count"] == 3
+    assert rec["host_s"] >= 0.0
+
+
+# --------------------------------------------------------------------------
+# event log
+# --------------------------------------------------------------------------
+
+def test_event_log_jsonl_schema(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    reg = MetricsRegistry()
+    log = EventLog(path, registry=reg)
+    log.emit("peak_buffer_overflow", "overflowed",
+             count=np.int64(131), capacity=64, dm=np.float32(2.5))
+    log.emit("peak_buffer_overflow", "again", count=200, capacity=64)
+    log.emit("tune_io_error", "disk on fire", path="/dev/null")
+    log.close()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 3
+    recs = [json.loads(ln) for ln in lines]
+    for rec in recs:
+        assert rec["v"] == 1
+        assert isinstance(rec["ts"], float)
+        assert isinstance(rec["kind"], str)
+        assert isinstance(rec["message"], str)
+    # numpy scalars must land as plain JSON numbers
+    assert recs[0]["data"] == {"count": 131, "capacity": 64, "dm": 2.5}
+    assert log.summary() == {"peak_buffer_overflow": 2, "tune_io_error": 1}
+    # every emit also lands in the registry's events.<kind> counters
+    assert reg.counter("events.peak_buffer_overflow") == 2
+    assert reg.counter("events.tune_io_error") == 1
+
+
+def test_event_log_without_path_still_counts():
+    log = EventLog("", registry=MetricsRegistry())
+    log.emit("x", "no sink configured")
+    assert log.summary() == {"x": 1}
+
+
+def test_warn_event_raises_warning_and_records_event(tmp_path):
+    from peasoup_tpu.obs import events as ev
+
+    old = ev.get_event_log()
+    path = str(tmp_path / "warn_events.jsonl")
+    ev.configure_event_log(path)
+    before = REGISTRY.counter("events.capacity_escalation")
+    try:
+        with pytest.warns(UserWarning, match="re-running with capacity"):
+            warn_event(
+                "capacity_escalation",
+                "peak buffer overflow on DM trial 3 (count 99); "
+                "re-running with capacity=128",
+                dm_trial=3, count=99, capacity=128,
+            )
+    finally:
+        log = ev.get_event_log()
+        ev._LOG = old  # restore the process-wide sink for later tests
+        log.close()
+    assert REGISTRY.counter("events.capacity_escalation") == before + 1
+    rec = json.loads(open(path).read().splitlines()[0])
+    assert rec["kind"] == "capacity_escalation"
+    assert rec["data"] == {"dm_trial": 3, "count": 99, "capacity": 128}
+
+
+# --------------------------------------------------------------------------
+# report assembly
+# --------------------------------------------------------------------------
+
+def test_build_report_and_stage_table():
+    reg = MetricsRegistry()
+    reg.inc("events.peak_buffer_overflow", 2)
+    reg.gauge("hbm.data_bytes", 4096)
+    with reg.timer("dedispersion"):
+        pass
+    log = EventLog("", registry=reg)
+    log.emit("peak_buffer_overflow", "x")
+    report = build_run_report(registry=reg, events=log)
+    assert report["version"] == 1
+    assert report["events"] == {"peak_buffer_overflow": 1}
+    assert "dedispersion" in report["stage_timers"]
+    assert {"count", "host_s", "device_s"} <= set(
+        report["stage_timers"]["dedispersion"])
+    assert report["device"]["device_count"] >= 1
+    table = format_stage_table(report)
+    assert "dedispersion" in table
+    assert "host_s" in table and "device_s" in table
+
+
+# --------------------------------------------------------------------------
+# end-to-end: CLI run writes run_report.json + events.jsonl
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def synth_fil(tmp_path_factory):
+    """Tiny 8-bit filterbank with a strong 976 Hz pulse train: loud
+    enough that a peak_capacity=2 search must overflow and escalate."""
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(0)
+    nsamps, nchans = 4096, 16
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    path = str(tmp_path_factory.mktemp("obs_e2e") / "synth.fil")
+    write_filterbank(path, Filterbank(header=hdr, data=data))
+    return path
+
+
+def _run_cli_collecting_warnings(args):
+    from peasoup_tpu.cli import main
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rc = main(args)
+    return rc, [str(w.message) for w in rec]
+
+
+def _check_report_dir(outdir, warned_msgs):
+    report = json.load(open(os.path.join(outdir, "run_report.json")))
+    events = [json.loads(ln) for ln in
+              open(os.path.join(outdir, "events.jsonl"))]
+    # every warning raised during the run is a counted, typed event
+    assert sum(report["events"].values()) == len(warned_msgs)
+    assert len(events) == len(warned_msgs)
+    assert sorted(e["message"] for e in events) == sorted(warned_msgs)
+    # nonzero stage timers with a host/device split
+    stages = report["stage_timers"]
+    assert any(rec["host_s"] > 0 for rec in stages.values())
+    for rec in stages.values():
+        assert 0.0 <= rec["device_s"] <= max(rec["host_s"], 1e-9) * 1.01
+    assert report["jit"]["backend_compiles"] >= 0
+    assert report["candidates"]["count"] >= 1
+    # the XML mirror is present
+    xml = open(os.path.join(outdir, "overview.xml"),
+               encoding="latin-1").read()
+    assert "<telemetry>" in xml and "<stage_timers>" in xml
+    return report
+
+
+def test_cli_host_loop_run_report(synth_fil, tmp_path):
+    """Host-loop driver: a forced-overflow run's escalation warnings
+    must appear 1:1 as counted events in run_report.json."""
+    REGISTRY.reset()
+    outdir = str(tmp_path / "out_host")
+    rc, warned = _run_cli_collecting_warnings([
+        "-i", synth_fil, "-o", outdir,
+        "--dm_start", "0", "--dm_end", "20", "--min_snr", "6",
+        "--peak_capacity", "2", "--npdmp", "2", "--limit", "10",
+        "--single_device",
+    ])
+    assert rc == 0
+    assert len(warned) > 0, "tiny capacity must force escalations"
+    report = _check_report_dir(outdir, warned)
+    assert report["events"].get("capacity_escalation", 0) == len(warned)
+    assert report["stage_timers"]["dedispersion"]["host_s"] > 0
+    assert report["stage_timers"]["accel_search"]["count"] > 0
+    assert report["counters"]["runs.host_loop"] == 1
+    assert report["gauges"]["hbm.data_bytes"] > 0
+
+
+def test_cli_mesh_run_report(synth_fil, tmp_path):
+    """Mesh (fused) driver through the CLI default path."""
+    REGISTRY.reset()
+    outdir = str(tmp_path / "out_mesh")
+    rc, warned = _run_cli_collecting_warnings([
+        "-i", synth_fil, "-o", outdir,
+        "--dm_start", "0", "--dm_end", "20", "--min_snr", "6",
+        "--npdmp", "2", "--limit", "10",
+    ])
+    assert rc == 0
+    report = _check_report_dir(outdir, warned)
+    assert report["stage_timers"]["fused_search"]["host_s"] > 0
+    assert report["stage_timers"]["peak_decode"]["count"] >= 1
+    assert report["counters"]["runs.mesh_fused"] == 1
+    assert report["gauges"]["search.n_devices"] == 8
+
+
+def test_chunked_driver_phase_timers(synth_fil):
+    """Bounded-HBM chunked driver: per-phase breakdown mirrors into
+    the registry with a device share on the aggregate stage."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    REGISTRY.reset()
+    fil = read_filterbank(synth_fil)
+    cfg = SearchConfig(dm_start=0.0, dm_end=20.0, min_snr=6.0, npdmp=2,
+                       limit=10, dm_chunk=2, accel_block=1)
+    result = MeshPulsarSearch(fil, cfg).run()
+    assert len(result.candidates) > 0
+    snap = REGISTRY.snapshot()
+    assert snap["counters"]["runs.mesh_chunked"] == 1
+    timers = snap["timers"]
+    for phase in ("chunk_prep", "chunk_compile", "chunk_fetch",
+                  "chunk_decode", "chunk_distill"):
+        assert phase in timers
+    agg = timers["chunked_search"]
+    assert agg["host_s"] > 0
+    assert 0.0 <= agg["device_s"] <= agg["host_s"] * 1.01
+    assert snap["gauges"]["chunk.dm_chunk"] == 2
+
+
+def test_tutorial_run_report(tutorial_fil, tmp_path):
+    """ISSUE acceptance: a tutorial-scale CLI run writes a parseable
+    run_report.json whose overflow/escalation counters match the
+    warnings raised."""
+    REGISTRY.reset()
+    outdir = str(tmp_path / "out_tut")
+    rc, warned = _run_cli_collecting_warnings([
+        "-i", tutorial_fil, "-o", outdir,
+        "--dm_start", "0", "--dm_end", "60",
+        "--acc_start", "-5", "--acc_end", "5",
+        "--acc_pulse_width", "64000",
+        "--peak_capacity", "8", "--limit", "50",
+        "--single_device",
+    ])
+    assert rc == 0
+    report = _check_report_dir(outdir, warned)
+    n_escalations = sum(
+        1 for m in warned if "re-running with capacity" in m)
+    assert report["events"].get(
+        "capacity_escalation", 0) == n_escalations
+
+
+# --------------------------------------------------------------------------
+# repo lint: no bare warnings.warn in search/ or parallel/
+# --------------------------------------------------------------------------
+
+def test_no_bare_warnings_warn_in_search_and_parallel():
+    """Every warning in the drivers must route through
+    obs.events.warn_event so it is counted and logged — a bare
+    warnings.warn would silently bypass telemetry."""
+    import peasoup_tpu
+
+    pkg_root = os.path.dirname(peasoup_tpu.__file__)
+    bare = re.compile(
+        r"\bwarnings\s*\.\s*warn\s*\(|\bfrom\s+warnings\s+import\b")
+    offenders = []
+    for sub in ("search", "parallel"):
+        subdir = os.path.join(pkg_root, sub)
+        for name in sorted(os.listdir(subdir)):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(subdir, name)
+            for ln, line in enumerate(open(path), start=1):
+                if bare.search(line):
+                    offenders.append(f"{sub}/{name}:{ln}: {line.strip()}")
+    assert not offenders, (
+        "bare warnings.warn found (route through obs.events.warn_event):\n"
+        + "\n".join(offenders)
+    )
+
+
+# --------------------------------------------------------------------------
+# progress bar satellite
+# --------------------------------------------------------------------------
+
+def test_progress_bar_counts_rate_and_summary():
+    import io
+
+    from peasoup_tpu.utils import ProgressBar
+
+    buf = io.StringIO()
+    p = ProgressBar(10, "DM trials ", stream=buf, width=10)
+    p.start()
+    p.update(5)
+    p.finish()
+    text = buf.getvalue()
+    assert "5/10" in text          # done/total counts
+    assert "/s" in text            # throughput
+    assert "ETA" in text
+    # final summary line
+    assert re.search(r"10 trials in \d+\.\d s, \d+(\.\d)? trials/s", text)
