@@ -1,0 +1,15 @@
+# Convenience entry points; CI runs `make lint test`.
+# JAX_PLATFORMS=cpu keeps both off any attached accelerator.
+
+PY ?= python
+
+.PHONY: lint test bench
+
+lint:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.analysis
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+bench:
+	$(PY) bench.py

@@ -108,7 +108,19 @@ def telemetry_delta(before: dict, after: dict) -> dict:
     }
 
 
+def run_lint() -> int:
+    """``bench.py --lint``: the peasoup-lint gate (AST rules + jaxpr
+    program checks) as a bench-entry spelling of ``make lint``, so CI
+    that drives everything through bench.py can run the checker in one
+    command before tier-1."""
+    from peasoup_tpu.analysis.cli import main as lint_main
+
+    return lint_main([])
+
+
 def main() -> None:
+    if "--lint" in sys.argv[1:]:
+        sys.exit(run_lint())
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook
     from peasoup_tpu.parallel.mesh import MeshPulsarSearch

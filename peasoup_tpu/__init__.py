@@ -18,6 +18,8 @@ Layout:
     native/    C++ helpers (bit unpacking) with NumPy fallbacks
     obs/       run telemetry: metrics registry, JSONL event log,
                machine-readable run_report.json
+    analysis/  peasoup-lint: AST rule engine + jaxpr invariant checker
+               (``python -m peasoup_tpu.analysis``)
     errors     typed exception hierarchy (the reference's ErrorChecker)
 """
 

@@ -1,0 +1,41 @@
+"""peasoup-lint: static analysis for the TPU search pipeline.
+
+Two complementary layers keep the pipeline's TPU invariants enforced
+on every PR (the generalisation of PR 1's one-off no-bare-warnings
+test):
+
+* an AST rule engine (:mod:`.engine`, :mod:`.rules`) that walks the
+  package sources and flags the Python-level mistakes that silently
+  cost a device->host stall or a recompile per DM trial — bare
+  ``warnings.warn`` bypassing telemetry (PSL001), host syncs inside
+  jitted programs (PSL002), device float64 leaks under ``ops/``
+  (PSL003), Python branching on traced values (PSL004) and untyped
+  ``ValueError``/``RuntimeError`` raises in the drivers (PSL005);
+* a jaxpr-level checker (:mod:`.jaxpr_check`) that traces the five
+  registered pipeline programs (dedisperse, spectrum, harmonics,
+  peaks, fold) at representative shapes and asserts no f64
+  intermediates (outside documented allowances), no host-callback or
+  transfer primitives, and a bounded distinct-compiled-signature
+  count via the compile tracking in ``obs/metrics.py``.
+
+Run ``python -m peasoup_tpu.analysis`` (or ``make lint``); see the
+README's "Static analysis" section for rule IDs, the
+``# psl: disable=PSL0xx`` suppression syntax and the committed
+baseline (``lint_baseline.json``) for grandfathered violations.
+"""
+
+from .engine import (  # noqa: F401
+    Baseline,
+    SourceFile,
+    Violation,
+    iter_source_files,
+    run_rules,
+)
+from .rules import ALL_RULES, rules_by_id  # noqa: F401
+from .jaxpr_check import (  # noqa: F401
+    JaxprFinding,
+    ProgramSpec,
+    check_program,
+    check_registered_programs,
+    registered_programs,
+)

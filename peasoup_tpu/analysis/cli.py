@@ -1,0 +1,132 @@
+"""``python -m peasoup_tpu.analysis`` — run the linter + jaxpr checks.
+
+Exit status: 0 when the tree is clean (every finding fixed, suppressed
+with a pragma, or grandfathered in the committed baseline) and the
+jaxpr invariants hold; 1 when there is anything new to fix; 2 on usage
+errors.  ``--json`` emits one machine-readable object for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import Baseline, repo_root, run_rules
+from .rules import ALL_RULES, rules_by_id
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m peasoup_tpu.analysis",
+        description="peasoup-lint: AST + jaxpr invariant checker for "
+                    "the TPU search pipeline",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: the "
+                        "peasoup_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object instead of text")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: <repo>/"
+                        f"{DEFAULT_BASELINE})")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather all current violations into the "
+                        "baseline (and drop expired entries)")
+    p.add_argument("--root", default=None,
+                   help="directory violations are reported relative "
+                        "to (default: the repo root); rule path "
+                        "filters match against these relative paths")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run "
+                        "(default: all)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("--no-jaxpr", action="store_true",
+                   help="skip the jaxpr-level program checks "
+                        "(no jax import; fast)")
+    p.add_argument("--jaxpr-only", action="store_true",
+                   help="run only the jaxpr-level program checks")
+    p.add_argument("--signature-bound", type=int, default=8,
+                   help="max distinct compiled signatures per program "
+                        "(default: 8)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.title}")
+        return 0
+
+    try:
+        rules = rules_by_id(
+            args.rules.split(",") if args.rules else None)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    root = args.root or repo_root()
+    baseline_path = args.baseline or os.path.join(
+        root, DEFAULT_BASELINE)
+
+    new, grandfathered, expired = [], [], []
+    suppressed, errors = 0, []
+    if not args.jaxpr_only:
+        violations, suppressed, errors = run_rules(
+            rules, args.paths or None, root=root)
+        try:
+            baseline = Baseline.load(baseline_path)
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        new, grandfathered, expired = baseline.split(violations)
+        if args.write_baseline:
+            Baseline.from_violations(violations).save(baseline_path)
+
+    jaxpr_findings = []
+    if not args.no_jaxpr:
+        from .jaxpr_check import check_registered_programs
+
+        jaxpr_findings = check_registered_programs(
+            signature_bound=args.signature_bound)
+
+    ok = not new and not jaxpr_findings and not errors
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": ok,
+            "violations": [v.to_json() for v in new],
+            "grandfathered": len(grandfathered),
+            "suppressed": suppressed,
+            "expired_baseline": expired,
+            "errors": [{"path": p, "message": m} for p, m in errors],
+            "jaxpr": [f.to_json() for f in jaxpr_findings],
+        }, indent=2))
+        return 0 if ok else 1
+
+    for path, message in errors:
+        print(f"{path}: parse error: {message}")
+    for v in new:
+        print(v.format())
+    for f in jaxpr_findings:
+        print(f.format())
+    if expired:
+        print(f"note: {len(expired)} baseline entr"
+              f"{'y is' if len(expired) == 1 else 'ies are'} expired "
+              f"(violation fixed) — run --write-baseline to drop:")
+        for e in expired:
+            print(f"  {e['rule']} {e['path']}: {e.get('snippet', '')}")
+    summary = (
+        f"{len(new)} new violation(s), {len(grandfathered)} "
+        f"grandfathered, {suppressed} suppressed"
+    )
+    if not args.no_jaxpr:
+        summary += f", {len(jaxpr_findings)} jaxpr finding(s)"
+    print(("OK — " if ok else "FAIL — ") + summary)
+    return 0 if ok else 1

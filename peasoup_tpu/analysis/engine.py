@@ -1,0 +1,274 @@
+"""AST rule engine: source loading, suppressions, baseline, runner.
+
+The engine is rule-agnostic: a rule is any object with an ``id`` (the
+``PSL0xx`` code), a ``title``, an ``applies(relpath)`` predicate and a
+``run(sf)`` generator yielding :class:`Violation`.  The concrete TPU
+rules live in :mod:`peasoup_tpu.analysis.rules`.
+
+Suppressions
+------------
+
+A violation is suppressed by a ``psl`` pragma comment on the flagged
+line (or on the line of the enclosing statement for multi-line
+expressions)::
+
+    x = float(count)  # psl: disable=PSL002 -- static shape probe
+
+File-wide suppression (use sparingly; prefer line pragmas)::
+
+    # psl: disable-file=PSL003 -- emulated-f64 legacy resample path
+
+Several IDs may be given comma-separated, and everything after ``--``
+is a free-form reason (required by convention, not enforced by the
+parser).
+
+Baseline
+--------
+
+``lint_baseline.json`` (repo root) grandfathers pre-existing
+violations so new rules can land strict without a flag-day fixup of
+every historical site.  Entries are keyed by (rule, path, source
+snippet) — deliberately *line-number free*, so unrelated edits in the
+same file do not churn the baseline.  An entry whose violation has
+been fixed is reported as *expired* and removed on the next
+``--write-baseline``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One finding: ``rule`` is the PSL id, ``path`` the repo-relative
+    posix path, ``snippet`` the stripped source line (the stable part
+    of the baseline key)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    snippet: str = ""
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+
+_PRAGMA = re.compile(
+    r"#\s*psl:\s*(disable(?:-file)?)\s*=\s*"
+    r"(?P<ids>[A-Za-z0-9*]+(?:\s*,\s*[A-Za-z0-9*]+)*)"
+    r"(?:\s*--\s*(?P<reason>.*))?"
+)
+
+
+@dataclass
+class SourceFile:
+    """A parsed source file plus its suppression pragmas."""
+
+    path: str       # absolute
+    relpath: str    # repo-relative, posix separators
+    source: str
+    tree: ast.AST
+    lines: list[str] = field(default_factory=list)
+    line_disables: dict[int, set[str]] = field(default_factory=dict)
+    file_disables: set[str] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: str, relpath: str) -> "SourceFile":
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        sf = cls(path=path, relpath=relpath, source=source, tree=tree,
+                 lines=source.splitlines())
+        for lineno, line in enumerate(sf.lines, start=1):
+            if "psl:" not in line:
+                continue
+            m = _PRAGMA.search(line)
+            if not m:
+                continue
+            ids = {s.strip().upper() for s in m.group("ids").split(",")}
+            if m.group(1) == "disable-file":
+                sf.file_disables |= ids
+            else:
+                sf.line_disables.setdefault(lineno, set()).update(ids)
+        return sf
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule_id: str, line: int,
+                      end_line: int | None = None) -> bool:
+        """True if ``rule_id`` is disabled on any line of the flagged
+        statement's span (so the pragma may sit on the opening or the
+        closing line of a multi-line call)."""
+        if rule_id in self.file_disables or "*" in self.file_disables:
+            return True
+        for ln in range(line, (end_line or line) + 1):
+            ids = self.line_disables.get(ln)
+            if ids and (rule_id in ids or "*" in ids):
+                return True
+        return False
+
+    def violation(self, rule_id: str, node: ast.AST, message: str
+                  ) -> Violation:
+        line = getattr(node, "lineno", 1)
+        return Violation(
+            rule=rule_id, path=self.relpath, line=line, message=message,
+            snippet=self.snippet_at(line),
+        )
+
+
+def package_root() -> str:
+    """Absolute path of the installed ``peasoup_tpu`` package."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    """The directory holding the package (where the baseline lives)."""
+    return os.path.dirname(package_root())
+
+
+def iter_source_files(paths: list[str] | None = None,
+                      root: str | None = None):
+    """Yield :class:`SourceFile` for every ``.py`` under ``paths``
+    (default: the ``peasoup_tpu`` package).  Files that fail to parse
+    are yielded as ``(path, exception)`` tuples so the caller can
+    report rather than crash."""
+    root = root or repo_root()
+    if not paths:
+        paths = [package_root()]
+    seen: set[str] = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            files = [p]
+        else:
+            files = sorted(
+                os.path.join(dirpath, name)
+                for dirpath, dirnames, names in os.walk(p)
+                for name in names
+                if name.endswith(".py")
+                and "__pycache__" not in dirpath
+            )
+        for fp in files:
+            if fp in seen:
+                continue
+            seen.add(fp)
+            rel = os.path.relpath(fp, root).replace(os.sep, "/")
+            try:
+                yield SourceFile.load(fp, rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+                yield (fp, exc)
+
+
+def run_rules(rules, paths: list[str] | None = None,
+              root: str | None = None):
+    """Apply ``rules`` to the sources; returns
+    ``(violations, suppressed, errors)`` where ``suppressed`` counts
+    pragma-silenced findings and ``errors`` is a list of
+    ``(path, message)`` for unparseable files."""
+    violations: list[Violation] = []
+    suppressed = 0
+    errors: list[tuple[str, str]] = []
+    for sf in iter_source_files(paths, root=root):
+        if isinstance(sf, tuple):
+            path, exc = sf
+            errors.append((path, f"{type(exc).__name__}: {exc}"))
+            continue
+        for rule in rules:
+            if not rule.applies(sf.relpath):
+                continue
+            for v in rule.run(sf):
+                end = v.line
+                # widen the pragma window to the statement the engine
+                # reported (ast end_lineno travels on the node; the
+                # rule already folded it into the Violation line when
+                # it mattered) — a trailing pragma on the same line is
+                # the common case either way
+                if sf.is_suppressed(v.rule, v.line, end):
+                    suppressed += 1
+                else:
+                    violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, suppressed, errors
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+class Baseline:
+    """Committed grandfather list for pre-existing violations."""
+
+    VERSION = 1
+
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries = entries or []
+
+    @staticmethod
+    def _key(entry: dict) -> tuple[str, str, str]:
+        return (entry["rule"], entry["path"], entry.get("snippet", ""))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"unsupported baseline version {data.get('version')!r} "
+                f"in {path} (expected {cls.VERSION})"
+            )
+        return cls(data.get("entries", []))
+
+    def save(self, path: str) -> None:
+        data = {
+            "version": self.VERSION,
+            "entries": sorted(
+                self.entries,
+                key=lambda e: (e["path"], e["rule"], e.get("snippet", "")),
+            ),
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def split(self, violations: list[Violation]):
+        """Partition into ``(new, grandfathered, expired_entries)``:
+        findings not in the baseline, findings it covers, and entries
+        whose violation no longer exists (fixed code — drop them)."""
+        keys = {self._key(e) for e in self.entries}
+        new = [v for v in violations if v.key() not in keys]
+        old = [v for v in violations if v.key() in keys]
+        live = {v.key() for v in violations}
+        expired = [e for e in self.entries if self._key(e) not in live]
+        return new, old, expired
+
+    @classmethod
+    def from_violations(cls, violations: list[Violation],
+                        reason: str = "grandfathered") -> "Baseline":
+        return cls([
+            {"rule": v.rule, "path": v.path, "snippet": v.snippet,
+             "reason": reason}
+            for v in violations
+        ])

@@ -1,0 +1,283 @@
+"""Jaxpr-level invariant checks over the registered pipeline programs.
+
+The AST rules catch what the *source* says; this module checks what the
+traced programs actually *contain*.  Each registered program —
+dedisperse, spectrum (the whitening chain), harmonics, peaks, fold —
+is traced with :func:`jax.make_jaxpr` at a small representative shape
+and its (recursively flattened) equations are checked for:
+
+* **f64/complex128 intermediates** — software-emulated on TPU; a leak
+  multiplies the op's cost silently.  The fold program's phase/index
+  math is *deliberately* f64 (reference-exact ``__double2int_rd``
+  semantics, see ``ops/fold.py:phase_bins``) and carries a documented
+  allowance; everything else must be clean.
+* **host-callback / transfer primitives** — ``pure_callback``,
+  ``io_callback``, ``infeed``/``outfeed``, ``device_put`` and friends
+  inside a jitted program mean a host round-trip per call.
+* **compiled-signature stability** — each program is executed twice at
+  identical shapes through a jitted entry; a second compile on the
+  repeat call means the signature churns (weak types, python scalars
+  re-hashing) and a production run would recompile per DM trial.  The
+  per-program counts are additionally read through the PR-1 compile
+  tracking (``obs.metrics.jit_program_cache_sizes``) and bounded.
+
+Everything here is lazy: jax is imported only when a check runs, so
+``import peasoup_tpu.analysis`` stays cheap for the AST-only path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable
+
+#: primitives that imply a host round-trip inside a device program.
+#: Any primitive whose name contains "callback" is also rejected.
+HOST_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "outside_call", "host_callback",
+    "infeed", "outfeed", "device_put", "copy_to_host_async",
+})
+
+#: dtypes that are software-emulated (f64) or unsupported (c128) on TPU
+_BANNED_DTYPES = frozenset({"float64", "complex128"})
+
+
+@dataclass(frozen=True)
+class JaxprFinding:
+    program: str
+    check: str      # "f64-intermediate" | "host-primitive" |
+                    # "signature-churn" | "signature-bound" | "trace-error"
+    detail: str
+
+    def format(self) -> str:
+        return f"jaxpr:{self.program}: {self.check}: {self.detail}"
+
+    def to_json(self) -> dict:
+        return {"program": self.program, "check": self.check,
+                "detail": self.detail}
+
+
+@dataclass
+class ProgramSpec:
+    """One registered pipeline program.
+
+    ``build()`` returns ``(fn, args)`` — statics already bound, args
+    small representative arrays — used both for :func:`jax.make_jaxpr`
+    and (wrapped in ``jax.jit``) for the signature-stability check.
+    """
+
+    name: str
+    build: Callable[[], tuple[Callable, tuple]]
+    allow_f64: bool = False
+    allow_reason: str = ""
+    #: names this program populates in jit_program_cache_sizes()
+    tracked_programs: tuple[str, ...] = field(default_factory=tuple)
+
+
+def registered_programs() -> list[ProgramSpec]:
+    """The five pipeline programs the checker runs over (ISSUE 2)."""
+
+    def _dedisperse():
+        import importlib
+
+        import jax.numpy as jnp
+
+        dd = importlib.import_module("peasoup_tpu.ops.dedisperse")
+        data = jnp.zeros((16, 2048), jnp.float32)
+        delays = jnp.zeros((4, 16), jnp.int32)
+        return partial(dd.dedisperse, out_nsamps=1024), (data, delays)
+
+    def _spectrum():
+        import jax.numpy as jnp
+
+        from ..search import pipeline as pl
+
+        tim = jnp.zeros((2048,), jnp.float32)
+        none = jnp.zeros((0,), jnp.float32)
+        fn = partial(pl.whiten_core, bin_width=1.0 / 2048.0,
+                     b5=0.05, b25=0.5, use_zap=False)
+        return fn, (tim, none, none)
+
+    def _harmonics():
+        import jax.numpy as jnp
+
+        from ..ops.harmonics import harmonic_sums
+
+        spec = jnp.zeros((1025,), jnp.float32)
+        return partial(harmonic_sums, nharms=4), (spec,)
+
+    def _peaks():
+        import jax.numpy as jnp
+
+        from ..ops.peaks import extract_top_peaks
+
+        spec = jnp.zeros((1025,), jnp.float32)
+        fn = partial(extract_top_peaks, thresh=6.0, start_idx=1,
+                     stop_idx=1000, capacity=32)
+        return fn, (spec,)
+
+    def _fold():
+        import jax.numpy as jnp
+
+        from ..ops.fold import fold_time_series_core, optimise_device
+
+        def fold_and_optimise(tim):
+            return optimise_device(
+                fold_time_series_core(tim, 0.007, 6.4e-5, 64, 16))
+
+        return fold_and_optimise, (jnp.zeros((16384,), jnp.float32),)
+
+    return [
+        ProgramSpec("dedisperse", _dedisperse),
+        ProgramSpec("spectrum", _spectrum,
+                    tracked_programs=("whiten_trial",)),
+        ProgramSpec("harmonics", _harmonics),
+        ProgramSpec("peaks", _peaks),
+        ProgramSpec(
+            "fold", _fold, allow_f64=True,
+            allow_reason=(
+                "reference-exact f64 phase/index math "
+                "(__double2int_rd parity, ops/fold.py:phase_bins) — "
+                "3 flops/element of emulated f64 by design"
+            ),
+        ),
+    ]
+
+
+# --------------------------------------------------------------------------
+# jaxpr traversal
+# --------------------------------------------------------------------------
+
+def _iter_eqns(jaxpr):
+    """All equations of ``jaxpr`` and every sub-jaxpr (scan/while/cond
+    bodies, pjit calls), recursively.  Sub-jaxprs are discovered
+    duck-typed through eqn params so the walk survives jax moving
+    Jaxpr/ClosedJaxpr between modules."""
+    stack = [jaxpr]
+    while stack:
+        jx = stack.pop()
+        if hasattr(jx, "jaxpr"):  # ClosedJaxpr
+            jx = jx.jaxpr
+        for eqn in jx.eqns:
+            yield eqn
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (tuple, list)) else (val,)
+                for sub in vals:
+                    if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                        stack.append(sub)
+
+
+def check_jaxpr(jaxpr, program: str, allow_f64: bool = False
+                ) -> list[JaxprFinding]:
+    """f64-intermediate + host-primitive checks on one (Closed)Jaxpr."""
+    findings: list[JaxprFinding] = []
+    f64_prims: dict[str, str] = {}
+    for eqn in _iter_eqns(jaxpr):
+        pname = eqn.primitive.name
+        if pname in HOST_PRIMITIVES or "callback" in pname:
+            findings.append(JaxprFinding(
+                program, "host-primitive",
+                f"primitive `{pname}` implies a host round-trip "
+                f"inside the device program",
+            ))
+        if allow_f64:
+            continue
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and str(dtype) in _BANNED_DTYPES:
+                f64_prims.setdefault(pname, str(dtype))
+    for pname, dtype in sorted(f64_prims.items()):
+        findings.append(JaxprFinding(
+            program, "f64-intermediate",
+            f"primitive `{pname}` produces {dtype} (software-emulated "
+            f"on TPU) — keep device math f32/c64 or move it host-side",
+        ))
+    return findings
+
+
+def check_program(spec: ProgramSpec) -> list[JaxprFinding]:
+    """Trace one program and run the jaxpr checks."""
+    import jax
+
+    try:
+        fn, args = spec.build()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 - reported, not raised
+        return [JaxprFinding(
+            spec.name, "trace-error",
+            f"{type(exc).__name__}: {str(exc).splitlines()[0]}",
+        )]
+    return check_jaxpr(jaxpr, spec.name, allow_f64=spec.allow_f64)
+
+
+def check_signatures(specs=None, bound: int = 8) -> list[JaxprFinding]:
+    """Execute each program twice at identical shapes and bound its
+    distinct-compiled-signature count.
+
+    The repeat call must be a cache hit — a second compile means the
+    jitted signature is unstable (weak types, python-scalar hashing)
+    and production runs would recompile per trial.  Afterwards the
+    pipeline-registered programs are read through
+    ``obs.metrics.jit_program_cache_sizes`` and bounded by ``bound``.
+    """
+    import jax
+
+    findings: list[JaxprFinding] = []
+    specs = registered_programs() if specs is None else specs
+    for spec in specs:
+        try:
+            fn, args = spec.build()
+            jfn = jax.jit(fn)
+            jax.block_until_ready(jfn(*args))
+            first = jfn._cache_size()
+            jax.block_until_ready(jfn(*args))
+            second = jfn._cache_size()
+        except Exception as exc:  # noqa: BLE001 - reported, not raised
+            findings.append(JaxprFinding(
+                spec.name, "trace-error",
+                f"{type(exc).__name__}: {str(exc).splitlines()[0]}",
+            ))
+            continue
+        if second > first:
+            findings.append(JaxprFinding(
+                spec.name, "signature-churn",
+                f"repeat call at identical shapes compiled a new "
+                f"signature ({first} -> {second})",
+            ))
+        if second > bound:
+            findings.append(JaxprFinding(
+                spec.name, "signature-bound",
+                f"{second} compiled signatures > bound {bound}",
+            ))
+
+    from ..obs.metrics import jit_program_cache_sizes
+
+    for name, size in sorted(jit_program_cache_sizes().items()):
+        if size > bound:
+            findings.append(JaxprFinding(
+                name, "signature-bound",
+                f"jit program cache holds {size} distinct compiled "
+                f"signatures > bound {bound} (recompile storm)",
+            ))
+    return findings
+
+
+def check_registered_programs(names=None, signature_bound: int = 8,
+                              signatures: bool = True
+                              ) -> list[JaxprFinding]:
+    """Run every jaxpr check over the registered programs; the CLI and
+    ``tests/test_lint.py`` entry point."""
+    specs = registered_programs()
+    if names:
+        wanted = set(names)
+        unknown = wanted - {s.name for s in specs}
+        if unknown:
+            raise ValueError(f"unknown program(s): {sorted(unknown)}")
+        specs = [s for s in specs if s.name in wanted]
+    findings: list[JaxprFinding] = []
+    for spec in specs:
+        findings.extend(check_program(spec))
+    if signatures:
+        findings.extend(check_signatures(specs, bound=signature_bound))
+    return findings

@@ -1,0 +1,539 @@
+"""The PSL rules: TPU invariants of the search pipeline, as AST checks.
+
+=======  ==========================================================
+PSL001   bare ``warnings.warn`` outside ``obs/`` (bypasses telemetry)
+PSL002   host-sync call inside a jitted function (device->host stall)
+PSL003   device float64/complex128 under ``ops/`` (emulated on TPU)
+PSL004   Python ``if``/``while`` on a traced value in a jitted
+         function (TracerBoolConversionError, or a silent recompile
+         when the branch folds on a concrete weak type)
+PSL005   raw ``ValueError``/``RuntimeError`` raise in ``search/`` or
+         ``parallel/`` (use the typed ``peasoup_tpu.errors`` classes)
+=======  ==========================================================
+
+Jit detection is syntactic and intra-module: a function is "known
+jitted" when it is decorated with ``jax.jit`` / ``partial(jax.jit,
+...)`` or wrapped by a module-level ``name = jax.jit(fn, ...)``
+assignment.  Static argnames are honoured — a parameter listed in
+``static_argnames`` is a Python value, not a tracer, so branching on
+it or ``float()``-ing it is fine.
+
+Taint is a forward syntactic pass: non-static parameters are traced;
+an assignment whose right-hand side *value-depends* on a traced name
+taints its targets.  Structure probes (``x.shape``, ``x.dtype``,
+``x.ndim``, ``len(x)``, ``isinstance(x, ...)``, ``x is None``) do NOT
+value-depend on the tracer — they are static under jit — so shapes
+derived from traced arrays stay untainted and do not false-positive
+PSL002/PSL004.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .engine import SourceFile, Violation
+
+# attribute probes on a tracer that yield static Python values
+_SAFE_ATTRS = {"shape", "dtype", "ndim", "size", "itemsize", "weak_type"}
+# builtins whose result does not depend on traced *values*
+_SAFE_CALLS = {"isinstance", "len", "callable", "hasattr", "getattr",
+               "type", "id", "repr"}
+
+
+# --------------------------------------------------------------------------
+# jit detection
+# --------------------------------------------------------------------------
+
+@dataclass
+class JitInfo:
+    node: ast.FunctionDef
+    static: set[str] = field(default_factory=set)
+    via: str = ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Names, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _const_strs(node: ast.AST) -> set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    return set()
+
+
+def _const_ints(node: ast.AST) -> set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        }
+    return set()
+
+
+def _jit_call_statics(call: ast.Call) -> tuple[set[str], set[int]]:
+    names: set[str] = set()
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names |= _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            nums |= _const_ints(kw.value)
+    return names, nums
+
+
+def _jit_spec_of_expr(expr: ast.AST):
+    """``(static_argnames, static_argnums)`` if ``expr`` denotes a
+    jax.jit wrapping, else None.  Handles ``jax.jit``, ``jax.jit(...)``
+    and ``partial(jax.jit, ...)`` (the decorator spelling used by the
+    pipeline's chunk programs)."""
+    if _is_jax_jit(expr):
+        return set(), set()
+    if isinstance(expr, ast.Call):
+        if _is_jax_jit(expr.func):
+            return _jit_call_statics(expr)
+        if _dotted(expr.func) in ("partial", "functools.partial") and \
+                expr.args and _is_jax_jit(expr.args[0]):
+            return _jit_call_statics(expr)
+    return None
+
+
+def _argnum_names(fn: ast.FunctionDef, nums: set[int]) -> set[str]:
+    pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    return {pos[i] for i in nums if 0 <= i < len(pos)}
+
+
+def collect_jitted(tree: ast.AST) -> list[JitInfo]:
+    """Every function in ``tree`` that is known-jitted (see module
+    docstring), with its static argnames resolved."""
+    defs: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+
+    out: dict[int, JitInfo] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                spec = _jit_spec_of_expr(dec)
+                if spec is not None:
+                    names, nums = spec
+                    out[id(node)] = JitInfo(
+                        node, names | _argnum_names(node, nums),
+                        via="decorator")
+        elif isinstance(node, ast.Call):
+            # any jax.jit(fn, ...) call — module-level `name = jax.jit
+            # (fn)` wrappers, `return jax.jit(mapped)` in the mesh
+            # program builders, inline jax.jit(...)(...) dispatches
+            if _is_jax_jit(node.func) and node.args and \
+                    isinstance(node.args[0], ast.Name):
+                fn = defs.get(node.args[0].id)
+                if fn is not None:
+                    names, nums = _jit_call_statics(node)
+                    out.setdefault(id(fn), JitInfo(
+                        fn, names | _argnum_names(fn, nums),
+                        via="jax.jit() wrapper"))
+    return list(out.values())
+
+
+# --------------------------------------------------------------------------
+# value-dependence + taint
+# --------------------------------------------------------------------------
+
+def _parent_map(root: ast.AST) -> dict[int, ast.AST]:
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _use_is_safe(name: ast.Name, parents: dict[int, ast.AST]) -> bool:
+    """True when this occurrence of a traced name cannot leak a traced
+    *value* into Python control flow: shape/dtype probes, isinstance,
+    len, identity comparisons."""
+    node: ast.AST = name
+    while True:
+        parent = parents.get(id(node))
+        if parent is None:
+            return False
+        if isinstance(parent, ast.Attribute) and parent.value is node:
+            if parent.attr in _SAFE_ATTRS:
+                return True
+            return False  # method/attr that may carry the value
+        if isinstance(parent, ast.Subscript) and parent.value is node:
+            node = parent  # x[0].shape is still a structure probe path
+            continue
+        if isinstance(parent, ast.Call):
+            if node in parent.args or any(
+                    kw.value is node for kw in parent.keywords):
+                return _dotted(parent.func) in _SAFE_CALLS
+            return False
+        if isinstance(parent, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in parent.ops):
+                return True
+            return False
+        if isinstance(parent, (ast.Tuple, ast.List)):
+            node = parent
+            continue
+        return False
+
+
+def value_dependent(expr: ast.AST, traced: set[str],
+                    parents: dict[int, ast.AST]) -> bool:
+    """Does ``expr`` depend on the *value* (not just the structure) of
+    any traced name?"""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in traced:
+            if not _use_is_safe(node, parents):
+                return True
+    return False
+
+
+def _target_names(target: ast.AST):
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name):
+            yield node.id
+
+
+def traced_names(info: JitInfo, parents: dict[int, ast.AST]) -> set[str]:
+    """Non-static parameters of the jitted function, plus locals
+    assigned from value-dependent expressions (forward fixpoint)."""
+    fn = info.node
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    traced = {p for p in params if p not in info.static and p != "self"}
+    for _ in range(16):  # fixpoint; depth bounded by assignment chains
+        changed = False
+        for node in ast.walk(fn):
+            value, targets = None, []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.NamedExpr)):
+                value, targets = node.value, [node.target]
+            elif isinstance(node, ast.For):
+                value, targets = node.iter, [node.target]
+            if value is None:
+                continue
+            if value_dependent(value, traced, parents):
+                for target in targets:
+                    for name in _target_names(target):
+                        if name not in traced:
+                            traced.add(name)
+                            changed = True
+        if not changed:
+            break
+    return traced
+
+
+# --------------------------------------------------------------------------
+# rule framework
+# --------------------------------------------------------------------------
+
+class Rule:
+    id: str = "PSL000"
+    title: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.endswith(".py")
+
+    def run(self, sf: SourceFile):
+        raise NotImplementedError
+
+
+def _in_pkg(relpath: str, *subdirs: str) -> bool:
+    return any(relpath.startswith(f"peasoup_tpu/{d}/") for d in subdirs)
+
+
+# --------------------------------------------------------------------------
+# PSL001 — bare warnings.warn outside obs/
+# --------------------------------------------------------------------------
+
+class NoBareWarningsRule(Rule):
+    """Every warning must route through ``obs.events.warn_event`` so it
+    is counted and JSONL-logged; a bare ``warnings.warn`` silently
+    bypasses run telemetry.  ``obs/`` itself is exempt (warn_event's
+    own implementation raises the Python warning there)."""
+
+    id = "PSL001"
+    title = "bare warnings.warn bypasses telemetry"
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith("peasoup_tpu/")
+                and not relpath.startswith("peasoup_tpu/obs/")
+                and relpath.endswith(".py"))
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "warnings":
+                yield sf.violation(
+                    self.id, node,
+                    "import from `warnings` — route warnings through "
+                    "peasoup_tpu.obs.events.warn_event so they are "
+                    "counted and logged",
+                )
+            elif isinstance(node, ast.Call) and \
+                    _dotted(node.func) == "warnings.warn":
+                yield sf.violation(
+                    self.id, node,
+                    "bare warnings.warn() — use "
+                    "peasoup_tpu.obs.events.warn_event(kind, message, "
+                    "**data) so the warning lands in run telemetry",
+                )
+
+
+# --------------------------------------------------------------------------
+# PSL002 — host syncs inside jitted functions
+# --------------------------------------------------------------------------
+
+_HOST_SYNC_METHODS = {"block_until_ready", "item", "tolist", "to_py"}
+_HOST_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_NP_FUNCS = {"np.asarray", "np.array", "numpy.asarray",
+                  "numpy.array", "onp.asarray", "onp.array"}
+
+
+class NoHostSyncInJitRule(Rule):
+    """A ``.block_until_ready()``, ``.item()``, ``float()``/``int()``
+    on a tracer, ``np.asarray`` or ``jax.device_get`` inside a jitted
+    program either fails at trace time or — worse — silently pins a
+    device->host transfer (and a potential recompile) into the hot
+    path of every DM trial."""
+
+    id = "PSL002"
+    title = "host sync inside a jitted function"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("peasoup_tpu/") and \
+            relpath.endswith(".py")
+
+    def run(self, sf: SourceFile):
+        for info in collect_jitted(sf.tree):
+            parents = _parent_map(info.node)
+            traced = traced_names(info, parents)
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = _dotted(fn)
+                if isinstance(fn, ast.Attribute) and \
+                        fn.attr in _HOST_SYNC_METHODS:
+                    recv_dep = value_dependent(fn.value, traced, parents)
+                    if fn.attr == "block_until_ready" or recv_dep:
+                        yield sf.violation(
+                            self.id, node,
+                            f".{fn.attr}() inside jitted "
+                            f"`{info.node.name}` forces a device->host "
+                            f"sync per call — return the array and "
+                            f"sync outside the program",
+                        )
+                elif name in _HOST_CAST_BUILTINS:
+                    if node.args and value_dependent(
+                            node.args[0], traced, parents):
+                        yield sf.violation(
+                            self.id, node,
+                            f"{name}() on a traced value inside jitted "
+                            f"`{info.node.name}` concretises the "
+                            f"tracer (host sync / TracerConversion"
+                            f"Error) — keep it a jnp array",
+                        )
+                elif name in _HOST_NP_FUNCS:
+                    if node.args and value_dependent(
+                            node.args[0], traced, parents):
+                        yield sf.violation(
+                            self.id, node,
+                            f"{name}() on a traced value inside jitted "
+                            f"`{info.node.name}` pulls the array to "
+                            f"host — use jnp.asarray or restructure",
+                        )
+                elif name in ("jax.device_get", "device_get"):
+                    yield sf.violation(
+                        self.id, node,
+                        f"jax.device_get inside jitted "
+                        f"`{info.node.name}` is a host transfer — "
+                        f"fetch after the program returns",
+                    )
+
+
+# --------------------------------------------------------------------------
+# PSL003 — device float64 under ops/
+# --------------------------------------------------------------------------
+
+_F64_ATTRS = {"float64", "complex128", "double", "float_"}
+_F64_STRINGS = {"float64", "complex128", "double"}
+
+
+class NoDeviceF64Rule(Rule):
+    """float64 is software-emulated on TPU (and complex128 unsupported)
+    — a stray ``jnp.float64`` in a kernel silently multiplies its cost.
+    Host-side ``np.float64`` table math is exempt: only the jax/jnp
+    namespaces are device dtypes.  The deliberate f64 index-math sites
+    (``ops/resample.py`` legacy path, ``ops/fold.py`` phase_bins) carry
+    ``psl: disable`` pragmas with their reasons."""
+
+    id = "PSL003"
+    title = "device float64/complex128 under ops/"
+
+    def applies(self, relpath: str) -> bool:
+        return _in_pkg(relpath, "ops")
+
+    def _jnp_aliases(self, tree: ast.AST) -> set[str]:
+        aliases = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.numpy":
+                        aliases.add(a.asname or "jax.numpy")
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+        return aliases or {"jnp"}
+
+    def run(self, sf: SourceFile):
+        aliases = self._jnp_aliases(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in _F64_ATTRS and \
+                    _dotted(node.value) in aliases | {"jax.numpy"}:
+                yield sf.violation(
+                    self.id, node,
+                    f"device dtype {_dotted(node.value)}.{node.attr} — "
+                    f"f64 is emulated on TPU; use f32 (or do the f64 "
+                    f"math host-side in numpy)",
+                )
+            elif isinstance(node, ast.Call):
+                root = _dotted(node.func).split(".")[0]
+                if root not in aliases:
+                    continue
+                for kw in node.keywords:
+                    if kw.arg == "dtype" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value in _F64_STRINGS:
+                        yield sf.violation(
+                            self.id, node,
+                            f'dtype="{kw.value.value}" in a '
+                            f"{root}.* call — f64 is emulated on TPU",
+                        )
+
+
+# --------------------------------------------------------------------------
+# PSL004 — Python branching on traced values
+# --------------------------------------------------------------------------
+
+class NoTracedBranchRule(Rule):
+    """``if``/``while`` on a traced value inside a jitted function is
+    either a TracerBoolConversionError at trace time or, when the
+    value happens to be concrete (weak types, shape-dependent consts),
+    a per-value recompile.  Use ``lax.cond`` / ``lax.select`` /
+    ``jnp.where``.  Branching on static argnames and on structure
+    probes (``x.shape``, ``x is None``, ``isinstance``) is fine and
+    not flagged."""
+
+    id = "PSL004"
+    title = "Python branch on traced value in jitted function"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.startswith("peasoup_tpu/") and \
+            relpath.endswith(".py")
+
+    def run(self, sf: SourceFile):
+        for info in collect_jitted(sf.tree):
+            parents = _parent_map(info.node)
+            traced = traced_names(info, parents)
+            for node in ast.walk(info.node):
+                if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                    if value_dependent(node.test, traced, parents):
+                        kind = {"If": "if", "While": "while",
+                                "IfExp": "conditional expression"}[
+                                    type(node).__name__]
+                        yield sf.violation(
+                            self.id, node,
+                            f"Python `{kind}` on a traced value inside "
+                            f"jitted `{info.node.name}` — use lax.cond"
+                            f"/lax.select/jnp.where (or mark the "
+                            f"argument static)",
+                        )
+
+
+# --------------------------------------------------------------------------
+# PSL005 — untyped raises in the drivers
+# --------------------------------------------------------------------------
+
+_RAW_EXCS = {"ValueError", "RuntimeError"}
+
+
+class TypedErrorsRule(Rule):
+    """``search/`` and ``parallel/`` raise the typed
+    ``peasoup_tpu.errors`` hierarchy (ConfigError, InputFileError,
+    HBMBudgetError, DomainError, CheckpointError) so callers catch a
+    *class* of failure instead of string-matching ValueErrors.  Every
+    typed class still subclasses the builtin it replaces, so this is
+    always a safe upgrade."""
+
+    id = "PSL005"
+    title = "raw ValueError/RuntimeError in search/ or parallel/"
+
+    def applies(self, relpath: str) -> bool:
+        return _in_pkg(relpath, "search", "parallel")
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = ""
+            if isinstance(exc, ast.Call):
+                name = _dotted(exc.func)
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in _RAW_EXCS:
+                yield sf.violation(
+                    self.id, node,
+                    f"raise {name} in a driver — raise the matching "
+                    f"typed peasoup_tpu.errors class (ConfigError, "
+                    f"InputFileError, HBMBudgetError, DomainError, "
+                    f"CheckpointError) instead",
+                )
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    NoBareWarningsRule(),
+    NoHostSyncInJitRule(),
+    NoDeviceF64Rule(),
+    NoTracedBranchRule(),
+    TypedErrorsRule(),
+)
+
+
+def rules_by_id(ids=None) -> list[Rule]:
+    if not ids:
+        return list(ALL_RULES)
+    wanted = {i.strip().upper() for i in ids}
+    unknown = wanted - {r.id for r in ALL_RULES}
+    if unknown:
+        raise ValueError(f"unknown rule id(s): {sorted(unknown)}")
+    return [r for r in ALL_RULES if r.id in wanted]

@@ -37,8 +37,8 @@ def phase_bins(nsamps: int, period, tsamp, nbins: int) -> jnp.ndarray:
     """Per-sample phase-bin assignment, matching the reference's
     ``__double2int_rd(modf(jj * (tsamp/period)) * nbins)``
     (`src/kernels.cu:621-627`, f64 with the precomputed tsamp/period)."""
-    j = jnp.arange(nsamps, dtype=jnp.float64)
-    tbp = jnp.asarray(tsamp, jnp.float64) / jnp.asarray(period, jnp.float64)
+    j = jnp.arange(nsamps, dtype=jnp.float64)  # psl: disable=PSL003 -- reference-exact f64 phase math (__double2int_rd)
+    tbp = jnp.asarray(tsamp, jnp.float64) / jnp.asarray(period, jnp.float64)  # psl: disable=PSL003 -- reference-exact f64 phase math
     phase = j * tbp
     frac = phase - jnp.floor(phase)
     return jnp.floor(frac * nbins).astype(jnp.int32)

@@ -32,8 +32,8 @@ SPEED_OF_LIGHT = 299792458.0
 
 def _accel_fact(accel, tsamp) -> jnp.ndarray:
     return (
-        jnp.asarray(accel, jnp.float64)
-        * jnp.asarray(tsamp, jnp.float64)
+        jnp.asarray(accel, jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64 (module docstring)
+        * jnp.asarray(tsamp, jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
         / (2.0 * SPEED_OF_LIGHT)
     )
 
@@ -42,8 +42,8 @@ def resample(tim: jnp.ndarray, accel, tsamp) -> jnp.ndarray:
     """Kernel-I resampling, symmetric about the midpoint."""
     n = tim.shape[0]
     af = _accel_fact(accel, tsamp)
-    i = jnp.arange(n, dtype=jnp.float64)
-    half = jnp.float64(n) / 2.0
+    i = jnp.arange(n, dtype=jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
+    half = jnp.float64(n) / 2.0  # psl: disable=PSL003 -- index ramp needs true f64
     idx = jnp.rint(i + af * ((i - half) ** 2 - half * half)).astype(jnp.int32)
     return tim[jnp.clip(idx, 0, n - 1)]
 
@@ -74,10 +74,10 @@ def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None
     """
     n = tim.shape[0]
     af = _accel_fact(accel, tsamp)
-    i = jnp.arange(n, dtype=jnp.float64)
+    i = jnp.arange(n, dtype=jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
     # round the SUM like the reference (half-to-even ties depend on the
     # integer part, so rint(i + x) != i + rint(x) exactly at ties)
-    idx = jnp.rint(i + i * af * (i - jnp.float64(n)))
+    idx = jnp.rint(i + i * af * (i - jnp.float64(n)))  # psl: disable=PSL003 -- index ramp needs true f64
     if max_shift is None or max_shift > _SELECT_MAX_SHIFT:
         return tim[jnp.clip(idx.astype(jnp.int32), 0, n - 1)]
     d = (idx - i).astype(jnp.int32)

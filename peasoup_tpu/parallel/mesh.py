@@ -108,7 +108,7 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
     flat_snr = snrs.reshape(-1)
     n = flat_bin.shape[0]
     if n > 2**31 - 2:
-        raise ValueError(
+        raise ConfigError(
             f"peak-buffer slot count {n} overflows int32 slot indices; "
             f"reduce peak_capacity, accel count per dispatch "
             f"(accel_block) or DM rows per shard"

@@ -23,6 +23,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..errors import InputFileError
 from ..io.sigproc import read_filterbank
 from ..ops import (
     deredden,
@@ -103,14 +104,14 @@ def run_coincidencer(
         if tsamp is None:
             tsamp = float(fil.tsamp)
         elif float(fil.tsamp) != tsamp:
-            raise ValueError(
+            raise InputFileError(
                 f"tsamp mismatch across beams: {fn} has {fil.tsamp}, "
                 f"first beam has {tsamp}"
             )
     size = len(tims[0])
     for fn, t in zip(filenames, tims):
         if len(t) != size:
-            raise ValueError(
+            raise InputFileError(
                 f"Not all filterbanks the same length: {fn}"
             )
     bin_width = 1.0 / (size * tsamp)

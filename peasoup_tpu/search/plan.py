@@ -6,6 +6,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..errors import ConfigError
+
 
 def prev_power_of_two(val: int) -> int:
     """Exact reference semantics (`include/utils/utils.hpp:12-18`):
@@ -189,7 +191,7 @@ class FixedAccelerationPlan:
         # DM-independent: build once, serve every generate_accel_list
         self._cached = self._grid()
         if len(self._cached) == 0:
-            raise ValueError(
+            raise ConfigError(
                 f"empty fixed-step accel grid (acc_start={acc_lo} >= "
                 f"acc_end={acc_hi}): the serial driver would search "
                 f"zero trials"
@@ -205,7 +207,7 @@ class FixedAccelerationPlan:
                 # f32 increment no longer advances (step <= 0 or below
                 # the magnitude's epsilon): the C loop would spin
                 # forever — fail instead
-                raise ValueError(
+                raise ConfigError(
                     f"acc_step={float(self.step)} does not advance the "
                     f"float32 grid at {float(jj)}; use a larger step"
                 )

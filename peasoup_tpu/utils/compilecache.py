@@ -15,7 +15,6 @@ serialise executables — jax falls back to compiling as usual.
 from __future__ import annotations
 
 import os
-import warnings
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> str | None:
@@ -48,5 +47,11 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         return cache_dir
     except Exception as exc:  # unwritable dir, unknown config, ...
-        warnings.warn(f"persistent compile cache disabled: {exc}")
+        from ..obs.events import warn_event
+
+        warn_event(
+            "compile_cache_disabled",
+            f"persistent compile cache disabled: {exc}",
+            cache_dir=cache_dir,
+        )
         return None

@@ -17,6 +17,24 @@ REFERENCE = "/root/reference"
 
 
 @pytest.fixture(scope="session")
+def pallas_interpret():
+    """Skip (with the probe's reason) on jax builds whose pallas
+    interpret mode cannot run the package's kernels — e.g. jax
+    0.4.37's i64 leak across interpret-mode pjit boundaries under
+    jax_enable_x64.  See
+    ``peasoup_tpu.ops.dedisperse_pallas.pallas_interpret_supported``."""
+    from peasoup_tpu.ops.dedisperse_pallas import (
+        pallas_interpret_supported,
+    )
+
+    ok, reason = pallas_interpret_supported()
+    if not ok:
+        pytest.skip(
+            f"pallas interpret mode unsupported on this jax build: "
+            f"{reason}")
+
+
+@pytest.fixture(scope="session")
 def tutorial_fil() -> str:
     path = os.path.join(REFERENCE, "example_data", "tutorial.fil")
     if not os.path.exists(path):

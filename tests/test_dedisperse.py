@@ -220,7 +220,7 @@ def _random_case(rng, nchans, nsamps, ndm, dtype):
 
 
 @pytest.mark.parametrize("dtype", [np.float32, np.uint8])
-def test_dedisperse_pallas_parity(dtype):
+def test_dedisperse_pallas_parity(dtype, pallas_interpret):
     """Tile/pad/clamp paths: ndm not a tile multiple, out_nsamps not a
     time-tile multiple, windows clamped at the array end."""
     rng = np.random.default_rng(3)
@@ -238,7 +238,7 @@ def test_dedisperse_pallas_parity(dtype):
     np.testing.assert_allclose(out, golden, rtol=1e-6, atol=1e-5)
 
 
-def test_dedisperse_pallas_matches_scan_path():
+def test_dedisperse_pallas_matches_scan_path(pallas_interpret):
     """Pallas kernel == the XLA scan path on the same inputs."""
     rng = np.random.default_rng(4)
     data, delays, out_nsamps = _random_case(rng, 16, 2048, 12, np.float32)
@@ -263,7 +263,7 @@ def test_dedisperse_pallas_rejects_short_input():
 
 @pytest.mark.parametrize("dtype", [np.float32, np.uint8])
 @pytest.mark.parametrize("nparts", [1, 2])
-def test_dedisperse_pallas_flat_parity(dtype, nparts):
+def test_dedisperse_pallas_flat_parity(dtype, nparts, pallas_interpret):
     """Flat-input kernel (the production hot path, VERDICT r2 item 3):
     bit-parity with the numpy reference over single- and multi-part
     flat inputs, u8 and f32, with tile-aligned caller padding."""
@@ -344,7 +344,7 @@ def test_dedisperse_flat_chan_range_partials():
     np.testing.assert_array_equal(full, pieces)
 
 
-def test_dedisperse_pallas_flat_chan_range():
+def test_dedisperse_pallas_flat_chan_range(pallas_interpret):
     """Pallas flat kernel with chan_range == numpy over that channel
     slice only (sub-band stage 1)."""
     from peasoup_tpu.ops.dedisperse import split_flat_channels
@@ -453,7 +453,7 @@ def test_chunked_subband_e2e_matches_direct(tutorial_fil):
         assert a.dm == b.dm and a.acc == b.acc
 
 
-def test_dedisperse_pallas_flat_subband_kernel():
+def test_dedisperse_pallas_flat_subband_kernel(pallas_interpret):
     """One-launch sub-band stage 1 (grid over sub-bands, K-tile
     windows, cross-step double buffering): every sub-band's partials
     must equal numpy over that channel slice (integer data => exact)."""
@@ -539,7 +539,7 @@ def test_trial_nbits8_requires_integer_input(tutorial_fil):
         PulsarSearch(fil, SearchConfig(trial_nbits=16))
 
 
-def test_subband_stage2_kernel_assembly_exact():
+def test_subband_stage2_kernel_assembly_exact(pallas_interpret):
     """The Pallas stage-2-as-dedispersion path (flat f32 partials as a
     synthetic nsub-channel filterbank + one-hot row selection, the
     chunked driver's kernel2 mode) must be bit-identical to the direct

@@ -1,0 +1,467 @@
+"""peasoup-lint tier-1 tests: the rule engine on fixture snippets, the
+suppression/baseline machinery, the repo-wide clean gate (ISSUE 2
+acceptance) and the jaxpr-level program checks."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from peasoup_tpu.analysis.engine import (
+    Baseline,
+    SourceFile,
+    repo_root,
+    run_rules,
+)
+from peasoup_tpu.analysis.rules import ALL_RULES, rules_by_id
+
+REPO = repo_root()
+
+
+def _lint_snippet(tmp_path, code, relpath="peasoup_tpu/ops/fixture.py",
+                  rules=None):
+    """Write ``code`` under a fixture tree and run the rules exactly as
+    the CLI would, returning (violations, suppressed)."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    violations, suppressed, errors = run_rules(
+        rules or ALL_RULES, [str(path)], root=str(tmp_path))
+    assert not errors, errors
+    return violations, suppressed
+
+
+# --------------------------------------------------------------------------
+# per-rule fixtures: each known-bad snippet must be flagged
+# --------------------------------------------------------------------------
+
+def test_psl001_bare_warn_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import warnings
+
+        def f():
+            warnings.warn("boo")
+    """, relpath="peasoup_tpu/utils/fixture.py")
+    assert [v.rule for v in vs] == ["PSL001"]
+    assert "warn_event" in vs[0].message
+
+
+def test_psl001_from_import_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        from warnings import warn
+    """, relpath="peasoup_tpu/search/fixture.py")
+    assert [v.rule for v in vs] == ["PSL001"]
+
+
+def test_psl001_exempt_under_obs(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import warnings
+
+        def f():
+            warnings.warn("the telemetry bridge itself")
+    """, relpath="peasoup_tpu/obs/fixture.py")
+    assert vs == []
+
+
+def test_psl002_host_syncs_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = np.asarray(x)
+            x.block_until_ready()
+            c = x.sum().item()
+            return a, b, c
+    """)
+    assert [v.rule for v in vs] == ["PSL002"] * 4
+    assert [v.line for v in vs] == [8, 9, 10, 11]
+
+
+def test_psl002_static_and_structure_not_flagged(tmp_path):
+    """Statics, shape probes and shape-derived locals are Python
+    values — float()/branching on them must not be flagged."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            n = x.shape[0]
+            scale = float(k) / float(n)
+            if n > 4 and k:
+                return x * scale
+            return x
+    """)
+    assert vs == []
+
+
+def test_psl002_jit_wrapper_assignment_detected(tmp_path):
+    """`name = jax.jit(core, static_argnames=...)` marks `core` jitted
+    — the pipeline's whiten_trial spelling."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def core(x, n):
+            return int(x) + n
+
+        core_jit = jax.jit(core, static_argnames=("n",))
+    """)
+    assert [v.rule for v in vs] == ["PSL002"]
+    assert "core" in vs[0].message
+
+
+def test_psl003_device_f64_flagged_host_f64_not(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        import numpy as np
+
+        def f(x):
+            table = np.arange(8, dtype=np.float64)  # host math: fine
+            bad1 = jnp.asarray(x, jnp.float64)
+            bad2 = jnp.arange(8, dtype="float64")
+            return table, bad1, bad2
+    """)
+    assert [v.rule for v in vs] == ["PSL003", "PSL003"]
+    assert [v.line for v in vs] == [7, 8]
+
+
+def test_psl003_only_under_ops(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float64)
+    """, relpath="peasoup_tpu/search/fixture.py")
+    assert vs == []
+
+
+def test_psl004_traced_branch_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            if y.sum() > 0:
+                return y
+            while x.max() > 1:
+                x = x - 1
+            return x
+    """)
+    assert [v.rule for v in vs] == ["PSL004", "PSL004"]
+
+
+def test_psl004_structure_branches_not_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                return x
+            if isinstance(x, (list, tuple)):
+                x = x[0]
+            if x.shape[0] % 8:
+                return x * 2
+            return x * mask
+    """)
+    assert vs == []
+
+
+def test_psl005_raw_raise_flagged_typed_not(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        from peasoup_tpu.errors import ConfigError
+
+        def f(n):
+            if n == 1:
+                raise ValueError("untyped")
+            if n == 2:
+                raise RuntimeError("untyped")
+            raise ConfigError("typed is fine")
+    """, relpath="peasoup_tpu/parallel/fixture.py")
+    assert [v.rule for v in vs] == ["PSL005", "PSL005"]
+
+
+def test_psl005_not_applied_to_ops(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        def f():
+            raise ValueError("ops guards keep builtin raises")
+    """, relpath="peasoup_tpu/ops/fixture.py")
+    assert vs == []
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float64)  # psl: disable=PSL003 -- reference-exact f64
+    """)
+    assert vs == []
+    assert suppressed == 1
+
+
+def test_inline_suppression_wrong_id_does_not_silence(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float64)  # psl: disable=PSL001
+    """)
+    assert [v.rule for v in vs] == ["PSL003"]
+    assert suppressed == 0
+
+
+def test_file_level_suppression(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        # psl: disable-file=PSL003 -- emulated-f64 test fixture
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x, jnp.float64), jnp.float64(0)
+    """)
+    assert vs == []
+    assert suppressed == 2
+
+
+def test_multiple_ids_one_pragma(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return float(jnp.float64(1) * x)  # psl: disable=PSL002,PSL003 -- fixture
+    """)
+    assert vs == []
+    assert suppressed == 2
+
+
+# --------------------------------------------------------------------------
+# baseline add / expire round-trip
+# --------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    code = """
+        import warnings
+
+        def f():
+            warnings.warn("legacy site one")
+
+        def g():
+            warnings.warn("legacy site two")
+    """
+    vs, _ = _lint_snippet(tmp_path, code,
+                          relpath="peasoup_tpu/utils/fixture.py")
+    assert len(vs) == 2
+
+    # add: grandfather everything, reload, nothing is "new"
+    bl_path = str(tmp_path / "baseline.json")
+    Baseline.from_violations(vs, reason="pre-PSL001 sites").save(bl_path)
+    bl = Baseline.load(bl_path)
+    new, old, expired = bl.split(vs)
+    assert new == [] and len(old) == 2 and expired == []
+
+    # expire: fix one site; its entry is reported expired, and an
+    # unrelated line shift must NOT expire the other (key is
+    # line-free)
+    fixed = code.replace('warnings.warn("legacy site one")', "pass")
+    fixed = fixed.replace(
+        "import warnings",
+        "# a new leading comment shifts every line\n        "
+        "import warnings")
+    vs2, _ = _lint_snippet(tmp_path, fixed,
+                           relpath="peasoup_tpu/utils/fixture.py")
+    assert len(vs2) == 1
+    new, old, expired = bl.split(vs2)
+    assert new == [] and len(old) == 1
+    assert len(expired) == 1
+    assert "site one" in expired[0]["snippet"]
+
+    # re-write drops the expired entry
+    Baseline.from_violations(vs2).save(bl_path)
+    assert len(Baseline.load(bl_path).entries) == 1
+
+
+def test_baseline_version_mismatch_rejected(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"version": 99, "entries": []}))
+    with pytest.raises(ValueError):
+        Baseline.load(str(p))
+
+
+# --------------------------------------------------------------------------
+# repo-wide gates (ISSUE 2 acceptance)
+# --------------------------------------------------------------------------
+
+def test_repo_is_clean_under_all_rules():
+    """`python -m peasoup_tpu.analysis` must exit 0 on the repo: every
+    violation fixed, pragma-suppressed with a reason, or baselined."""
+    violations, _suppressed, errors = run_rules(ALL_RULES)
+    assert not errors, errors
+    bl = Baseline.load(os.path.join(REPO, "lint_baseline.json"))
+    new, _old, _expired = bl.split(violations)
+    assert new == [], "new lint violations:\n" + "\n".join(
+        v.format() for v in new)
+
+
+def test_baseline_is_near_empty():
+    """Grandfathering is for emergencies; this PR fixed the real
+    violations instead.  Hold the line."""
+    bl = Baseline.load(os.path.join(REPO, "lint_baseline.json"))
+    assert len(bl.entries) <= 3, (
+        "baseline is growing — fix violations instead of baselining: "
+        + json.dumps(bl.entries, indent=2)
+    )
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.analysis", "--no-jaxpr",
+         "--json"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["violations"] == []
+
+
+def test_cli_exits_nonzero_on_injected_violation(tmp_path):
+    bad = tmp_path / "peasoup_tpu" / "search" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import warnings\nwarnings.warn('injected')\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.analysis", "--no-jaxpr",
+         "--json", "--root", str(tmp_path),
+         "--baseline", str(tmp_path / "bl.json"), str(bad)],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert [v["rule"] for v in payload["violations"]] == ["PSL001"]
+
+
+def test_rules_by_id_selects_and_rejects():
+    assert [r.id for r in rules_by_id(["PSL001"])] == ["PSL001"]
+    with pytest.raises(ValueError):
+        rules_by_id(["PSL999"])
+
+
+# --------------------------------------------------------------------------
+# jaxpr-level checks
+# --------------------------------------------------------------------------
+
+def test_jaxpr_registered_programs_clean():
+    """The five registered pipeline programs hold the device-program
+    invariants (fold's documented f64 allowance aside)."""
+    from peasoup_tpu.analysis.jaxpr_check import (
+        check_registered_programs,
+        registered_programs,
+    )
+
+    names = {s.name for s in registered_programs()}
+    assert names == {"dedisperse", "spectrum", "harmonics", "peaks",
+                     "fold"}
+    findings = check_registered_programs()
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_jaxpr_fold_allowance_is_documented():
+    from peasoup_tpu.analysis.jaxpr_check import registered_programs
+
+    fold = next(s for s in registered_programs() if s.name == "fold")
+    assert fold.allow_f64 and "phase_bins" in fold.allow_reason
+
+
+def test_jaxpr_fails_on_injected_f64():
+    """ISSUE 2 acceptance: an f64 intermediate smuggled into a
+    registered program must be caught."""
+    import jax.numpy as jnp
+
+    from peasoup_tpu.analysis.jaxpr_check import (
+        ProgramSpec,
+        check_program,
+    )
+    from peasoup_tpu.search import pipeline as pl
+
+    def build():
+        from functools import partial
+
+        tim = jnp.zeros((2048,), jnp.float32)
+        none = jnp.zeros((0,), jnp.float32)
+        core = partial(pl.whiten_core, bin_width=1.0 / 2048.0,
+                       b5=0.05, b25=0.5, use_zap=False)
+
+        def leaky(tim, birdies, widths):
+            tim = (tim.astype(jnp.float64) * 2.0).astype(jnp.float32)
+            return core(tim, birdies, widths)
+
+        return leaky, (tim, none, none)
+
+    findings = check_program(ProgramSpec("spectrum-injected", build))
+    assert any(f.check == "f64-intermediate" for f in findings)
+
+
+def test_jaxpr_fails_on_injected_host_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from peasoup_tpu.analysis.jaxpr_check import (
+        ProgramSpec,
+        check_program,
+    )
+
+    def build():
+        def f(x):
+            return jax.pure_callback(
+                lambda a: np.asarray(a),
+                jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        return f, (jnp.zeros((8,), jnp.float32),)
+
+    findings = check_program(ProgramSpec("injected-callback", build))
+    assert any(f.check == "host-primitive" for f in findings)
+
+
+def test_jaxpr_trace_error_is_reported_not_raised():
+    from peasoup_tpu.analysis.jaxpr_check import (
+        ProgramSpec,
+        check_program,
+    )
+
+    def build():
+        def f(x):
+            raise RuntimeError("broken build")
+
+        return f, (0,)
+
+    findings = check_program(ProgramSpec("broken", build))
+    assert [f.check for f in findings] == ["trace-error"]
+    assert "broken build" in findings[0].detail
+
+
+def test_jaxpr_signature_stability():
+    """Repeat calls at identical shapes must not compile new
+    signatures (production runs would recompile per DM trial), and
+    the pipeline-registered programs stay under the signature bound
+    via the PR-1 cache probes."""
+    from peasoup_tpu.analysis.jaxpr_check import check_signatures
+
+    findings = check_signatures()
+    assert findings == [], "\n".join(f.format() for f in findings)

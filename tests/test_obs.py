@@ -302,22 +302,24 @@ def test_tutorial_run_report(tutorial_fil, tmp_path):
 def test_no_bare_warnings_warn_in_search_and_parallel():
     """Every warning in the drivers must route through
     obs.events.warn_event so it is counted and logged — a bare
-    warnings.warn would silently bypass telemetry."""
+    warnings.warn would silently bypass telemetry.
+
+    Since ISSUE 2 this is the PSL001 rule of the
+    ``peasoup_tpu.analysis`` engine (which covers the whole package,
+    not just the drivers — see tests/test_lint.py); this test pins the
+    original driver-scoped guarantee onto that rule."""
     import peasoup_tpu
+    from peasoup_tpu.analysis.engine import run_rules
+    from peasoup_tpu.analysis.rules import rules_by_id
 
     pkg_root = os.path.dirname(peasoup_tpu.__file__)
-    bare = re.compile(
-        r"\bwarnings\s*\.\s*warn\s*\(|\bfrom\s+warnings\s+import\b")
-    offenders = []
-    for sub in ("search", "parallel"):
-        subdir = os.path.join(pkg_root, sub)
-        for name in sorted(os.listdir(subdir)):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(subdir, name)
-            for ln, line in enumerate(open(path), start=1):
-                if bare.search(line):
-                    offenders.append(f"{sub}/{name}:{ln}: {line.strip()}")
+    violations, _suppressed, errors = run_rules(
+        rules_by_id(["PSL001"]),
+        [os.path.join(pkg_root, "search"),
+         os.path.join(pkg_root, "parallel")],
+    )
+    assert not errors, errors
+    offenders = [v.format() for v in violations]
     assert not offenders, (
         "bare warnings.warn found (route through obs.events.warn_event):\n"
         + "\n".join(offenders)

@@ -584,7 +584,7 @@ def test_extract_top_peaks_two_stage_branch():
     assert np.all(np.diff(sv[iv >= 0]) <= 0)
 
 
-def test_harmonic_sums_pallas_exact_interpret():
+def test_harmonic_sums_pallas_exact_interpret(pallas_interpret):
     """The fused Pallas TPU kernel (interpret mode on CPU) must be
     bit-identical with the gather formulation, plain and under vmap
     (the hot paths vmap harmonic_sums over accel batches)."""
@@ -617,7 +617,7 @@ def test_harmonic_sums_pallas_exact_interpret():
             err_msg=f"level {k+1}: vmapped pallas mismatch")
 
 
-def test_harmonic_sums_pallas_nharms5_exact_interpret():
+def test_harmonic_sums_pallas_nharms5_exact_interpret(pallas_interpret):
     """nharms=5 on the kernel path (level 5's 16 odd stretches share
     the level-4 accumulator, 32 residue classes per stretch) must be
     bit-identical with the gather formulation."""
