@@ -2,8 +2,10 @@
 # JAX_PLATFORMS=cpu keeps both off any attached accelerator.
 
 PY ?= python
+TUTORIAL ?= /root/reference/example_data/tutorial.fil
+SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 
-.PHONY: lint test bench
+.PHONY: lint test bench trace-smoke
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.analysis
@@ -13,3 +15,15 @@ test:
 
 bench:
 	$(PY) bench.py
+
+# span-tracing smoke test: a tutorial run must write a parseable
+# Chrome trace whose span names cover the five pipeline stages
+trace-smoke:
+	rm -rf $(SMOKE_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli -i $(TUTORIAL) \
+	    -o $(SMOKE_DIR) --dm_start 0 --dm_end 60 --acc_start -5 \
+	    --acc_end 5 --acc_pulse_width 64000 --npdmp 2 --limit 50 \
+	    --single_device --trace_json $(SMOKE_DIR)/trace.json
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.trace_report \
+	    $(SMOKE_DIR)/trace.json \
+	    --require Dedisperse DM-Loop Accel-Search Distill Folding

@@ -9,6 +9,9 @@ PSL004   Python ``if``/``while`` on a traced value in a jitted
          when the branch folds on a concrete weak type)
 PSL005   raw ``ValueError``/``RuntimeError`` raise in ``search/`` or
          ``parallel/`` (use the typed ``peasoup_tpu.errors`` classes)
+PSL006   raw ``METRICS.timer(...)`` / ``trace_range(...)`` call
+         outside ``obs/`` (stage timing must go through the
+         ``obs.trace.span`` API so every stage is span-traced)
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -520,12 +523,66 @@ class TypedErrorsRule(Rule):
                 )
 
 
+# --------------------------------------------------------------------------
+# PSL006 — raw stage timing/tracing outside obs/
+# --------------------------------------------------------------------------
+
+#: receivers whose ``.timer(...)`` is the raw registry API (the
+#: process-wide aliases the drivers import)
+_TIMER_RECEIVERS = {"METRICS", "REGISTRY"}
+
+
+class SpanApiRule(Rule):
+    """Pipeline stages time themselves through ``obs.trace.span`` —
+    one call that opens a hierarchical span (Chrome-trace exportable,
+    HBM-sampled, per-trial attributable), feeds the stage-timer
+    registry via ``metric=``, and forwards the name to the jax
+    profiler.  A raw ``METRICS.timer(...)`` or ``trace_range(...)``
+    call outside ``obs/`` produces a stage the trace cannot see (or a
+    profiler range the report cannot count) — the split telemetry this
+    rule exists to prevent.  Deliberate exceptions carry a
+    ``# psl: disable=PSL006 -- reason`` pragma."""
+
+    id = "PSL006"
+    title = "raw METRICS.timer/trace_range outside obs/ (use span())"
+
+    def applies(self, relpath: str) -> bool:
+        return (relpath.startswith("peasoup_tpu/")
+                and not relpath.startswith("peasoup_tpu/obs/")
+                and relpath.endswith(".py"))
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name == "trace_range" or name.endswith(".trace_range"):
+                yield sf.violation(
+                    self.id, node,
+                    "trace_range() outside obs/ — open an "
+                    "obs.trace.span(...) instead (it still forwards "
+                    "to jax.profiler.TraceAnnotation, and the span "
+                    "lands in the exported trace + run report)",
+                )
+                continue
+            parts = name.split(".")
+            if (len(parts) >= 2 and parts[-1] == "timer"
+                    and parts[-2] in _TIMER_RECEIVERS):
+                yield sf.violation(
+                    self.id, node,
+                    f"{name}() outside obs/ — use obs.trace.span("
+                    f"name, metric=...) so the stage is span-traced "
+                    f"AND registry-timed in one call",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
     NoHostSyncInJitRule(),
     NoDeviceF64Rule(),
     NoTracedBranchRule(),
     TypedErrorsRule(),
+    SpanApiRule(),
 )
 
 

@@ -119,6 +119,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "timers with host/device split, counters, "
                         "event summary, device + HBM figures); "
                         "default: <outdir>/run_report.json")
+    p.add_argument("--trace_json", default="",
+                   help="Chrome trace-event JSON of the run's span "
+                        "tree (per-chunk/per-trial attribution, HBM "
+                        "watermarks; open in Perfetto or "
+                        "chrome://tracing, or summarise with "
+                        "python -m peasoup_tpu.tools.trace_report); "
+                        "multihost runs write one merged trace from "
+                        "process 0; default: <outdir>/trace.json")
     p.add_argument("--single_device", action="store_true",
                    help="disable mesh sharding even with multiple devices")
     return p
@@ -183,11 +191,15 @@ def main(argv=None) -> int:
     # happen (a crash still leaves the JSONL trail on disk)
     from .obs.events import configure_event_log
     from .obs.metrics import install_compile_hook
+    from .obs.trace import get_tracer
 
     install_compile_hook()
     os.makedirs(cfg.outdir, exist_ok=True)
     configure_event_log(
         cfg.events_log or os.path.join(cfg.outdir, "events.jsonl"))
+    # per-run span tree: the trace file must describe THIS run, not
+    # every run of a long-lived process
+    get_tracer().reset()
     import time as _time
 
     t_total = _time.time()
@@ -230,10 +242,20 @@ def main(argv=None) -> int:
     result.timers["reading"] = t_read
     result.timers["total"] = _time.time() - t_total
     report = write_search_output(result, cfg.outdir)
+    # span trace LAST (it covers the output-writing tail too); on
+    # multihost runs every process gathers, process 0 writes the merge
+    from .obs.trace import write_merged_trace
+
+    trace_path = write_merged_trace(
+        cfg.trace_json or os.path.join(cfg.outdir, "trace.json"))
     if args.verbose:
         from .obs.report import format_stage_table
 
         print(format_stage_table(report), file=sys.stderr)
+        if trace_path:
+            print(f"Wrote span trace to {trace_path} (open in Perfetto "
+                  f"or summarise with python -m "
+                  f"peasoup_tpu.tools.trace_report)", file=sys.stderr)
         print(f"Wrote {len(result.candidates)} candidates to {cfg.outdir}",
               file=sys.stderr)
     return 0

@@ -16,15 +16,27 @@ counted, logged and reported per run, so this package provides:
   through it — enforced by a repo lint test);
 * :mod:`.report` — an end-of-run machine-readable ``run_report.json``
   (timers, counters, events, device info, HBM figures, candidate
-  statistics) written next to ``overview.xml``.
+  statistics) written next to ``overview.xml``;
+* :mod:`.trace` — hierarchical span tracing with per-chunk/per-trial
+  attribution, HBM watermarks, Chrome trace-event (Perfetto) export
+  and multihost merge; :func:`~peasoup_tpu.obs.trace.span` is the ONE
+  API pipeline stages time themselves with (lint rule PSL006).
 """
 
 from .metrics import REGISTRY, MetricsRegistry, install_compile_hook
 from .events import EventLog, configure_event_log, get_event_log, warn_event
 from .report import build_run_report, format_stage_table, write_run_report
+from .trace import (
+    Tracer,
+    get_tracer,
+    span,
+    span_table,
+    write_merged_trace,
+)
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "install_compile_hook",
     "EventLog", "configure_event_log", "get_event_log", "warn_event",
     "build_run_report", "format_stage_table", "write_run_report",
+    "Tracer", "get_tracer", "span", "span_table", "write_merged_trace",
 ]

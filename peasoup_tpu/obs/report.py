@@ -18,6 +18,8 @@ tolerate additions)::
     stage_timers     {name: {count, host_s, device_s}}
     counters         {name: int}            incl. events.<kind> tallies
     gauges           {name: float}          incl. hbm.* figures
+    spans            {name: {count, total_s, self_s, device_s}}
+                     span-trace table (obs/trace.py), self-time ordered
     events           {kind: count}          event-log summary
     jit              {backend_compiles, compile_s, programs: {name: n}}
     device           {backend, jax_version, device_count, devices: []}
@@ -117,6 +119,7 @@ def build_run_report(result=None, registry=None, events=None,
         },
         "counters": snap["counters"],
         "gauges": snap["gauges"],
+        "spans": {},
         "events": log.summary(),
         "jit": {
             "backend_compiles": snap["counters"].get(
@@ -126,6 +129,12 @@ def build_run_report(result=None, registry=None, events=None,
         },
         "device": device_summary(),
     }
+    try:
+        from .trace import span_table
+
+        report["spans"] = span_table()
+    except Exception:  # pragma: no cover - tracing must never kill a run
+        pass
     if result is not None:
         report["timers"] = {
             k: round(float(v), 6)

@@ -1,0 +1,453 @@
+"""Hierarchical span tracing: always-on, structured, per-trial.
+
+The reference instruments its pipeline with NVTX push/pop ranges
+(`include/utils/nvtx.hpp:8-24`, `src/pipeline_multi.cu:144,207,318`)
+that are invisible unless a profiler is attached; the PR-1 stage
+timers aggregate per stage but cannot attribute time to an individual
+chunk or DM trial.  This module is the layer both lacked:
+
+* :func:`span` — a nestable context manager recording a
+  :class:`SpanRecord` (id, parent id, wall-clock start/end, measured
+  device time via ``handle.block`` / ``add_device_time``, structured
+  attributes, jit-compile delta, HBM watermark) into the process-wide
+  :class:`Tracer`.  It forwards the span name to
+  ``jax.profiler.TraceAnnotation`` so a live ``--profile_dir`` capture
+  still sees the same named ranges, and (when ``metric=`` is given)
+  feeds the PR-1 stage-timer registry so ``run_report.json``'s
+  ``stage_timers`` keep their host/device split.  ONE call site
+  replaces the old ``trace_range(...)`` + ``METRICS.timer(...)`` pair
+  (enforced outside ``obs/`` by lint rule PSL006).
+* Chrome trace-event export (:func:`chrome_events`,
+  :func:`write_merged_trace`) — balanced ``B``/``E`` phase pairs,
+  monotonic timestamps per thread, span attributes in ``args`` — the
+  file loads directly in Perfetto / ``chrome://tracing``.
+* :func:`span_table` — per-name totals with **self** time (total
+  minus direct children), merged into ``run_report.json``.
+* Multihost aggregation — every process serialises its local spans
+  (:func:`local_trace_payload`, pid-tagged with
+  ``jax.process_index()``); ``parallel.multihost.gather_host_payloads``
+  all-gathers the payloads and process 0 writes the merged trace.
+
+HBM watermarks: :func:`hbm_watermark` polls ``device.memory_stats()``
+on every local device at span close (``bytes_in_use`` /
+``peak_bytes_in_use`` maxima).  Backends without memory stats (CPU)
+return ``None`` on the first probe and sampling is disabled for the
+rest of the process — a graceful no-op, never an error.  Supported
+backends additionally maintain the run-level ``hbm.high_water_bytes``
+gauge in the metrics registry.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+from .metrics import REGISTRY
+
+#: hard cap on retained spans per process — a runaway per-element
+#: instrumentation bug must degrade to dropped spans (counted in the
+#: ``trace.spans_dropped`` metric), not unbounded host memory
+MAX_SPANS = 100_000
+
+_COMPILE_COUNTER = "jit.backend_compiles"
+
+
+def hbm_watermark() -> dict | None:
+    """Max ``bytes_in_use`` / ``peak_bytes_in_use`` over local devices,
+    or None when the backend has no memory stats (CPU) — the caller
+    treats None as "unsupported" and stops polling."""
+    try:
+        import jax
+
+        stats = [d.memory_stats() for d in jax.local_devices()]
+    except Exception:
+        return None
+    out = None
+    for ms in stats:
+        if not ms:
+            continue
+        if out is None:
+            out = {"bytes_in_use": 0, "peak_bytes_in_use": 0}
+        out["bytes_in_use"] = max(
+            out["bytes_in_use"], int(ms.get("bytes_in_use", 0)))
+        out["peak_bytes_in_use"] = max(
+            out["peak_bytes_in_use"],
+            int(ms.get("peak_bytes_in_use", ms.get("bytes_in_use", 0))))
+    return out
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class SpanHandle:
+    """Yielded by :func:`span`.  The timed block calls :meth:`block`
+    wherever it would ``block_until_ready`` (the wait is charged to the
+    span — and the stage timer — as device time) and :meth:`set` to
+    attach attributes discovered mid-span."""
+
+    __slots__ = ("name", "span_id", "parent_id", "tid", "metric",
+                 "attrs", "device_s", "t_start", "t_end", "_compiles0")
+
+    def __init__(self, name, span_id, parent_id, tid, metric, attrs,
+                 compiles0):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tid = tid
+        self.metric = metric
+        self.attrs = attrs
+        self.device_s = 0.0
+        self.t_start = 0.0
+        self.t_end = 0.0
+        self._compiles0 = compiles0
+
+    def set(self, **attrs) -> None:
+        """Attach/overwrite span attributes."""
+        self.attrs.update(attrs)
+
+    def block(self, tree):
+        """``jax.block_until_ready(tree)``, charging the wait to the
+        span's device time.  Returns ``tree`` for call-through use."""
+        import jax
+
+        t0 = time.perf_counter()
+        jax.block_until_ready(tree)
+        self.device_s += time.perf_counter() - t0
+        return tree
+
+    def add_device_time(self, seconds: float) -> None:
+        """Charge externally-measured device/link seconds (drivers
+        that already clock their fetches)."""
+        self.device_s += float(seconds)
+
+    @property
+    def host_s(self) -> float:
+        """Wall-clock span duration (0.0 until the span closes)."""
+        return max(self.t_end - self.t_start, 0.0)
+
+
+@dataclass
+class SpanRecord:
+    """One closed span.  Times are ``time.perf_counter`` values; add
+    the owning tracer's ``epoch`` for wall-clock seconds."""
+
+    name: str
+    span_id: int
+    parent_id: int | None
+    tid: int
+    t_start: float
+    t_end: float
+    device_s: float
+    attrs: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Thread-safe in-memory span tree for one process/run."""
+
+    def __init__(self, registry=None, max_spans: int = MAX_SPANS):
+        self._registry = registry if registry is not None else REGISTRY
+        self._lock = threading.Lock()
+        self._records: list[SpanRecord] = []
+        self._next_id = 1
+        self._tls = threading.local()
+        self._tids: dict[int, int] = {}
+        self._max_spans = max_spans
+        self.dropped = 0
+        self._profiler = None   # lazy: jax.profiler module, or False
+        self._hbm_supported: bool | None = None
+        self._hbm_high = 0
+        #: wall-clock = perf_counter + epoch (lets merged multi-host
+        #: traces share one absolute time base)
+        self.epoch = time.time() - time.perf_counter()
+
+    # -- recording ---------------------------------------------------------
+
+    def _thread_state(self):
+        st = getattr(self._tls, "state", None)
+        if st is None:
+            ident = threading.get_ident()
+            with self._lock:
+                tid = self._tids.setdefault(ident, len(self._tids))
+            st = {"tid": tid, "stack": []}
+            self._tls.state = st
+        return st
+
+    def _annotation(self, name):
+        if self._profiler is None:
+            try:
+                import jax.profiler
+
+                self._profiler = jax.profiler
+            except Exception:  # pragma: no cover - jax unavailable
+                self._profiler = False
+        if self._profiler:
+            return self._profiler.TraceAnnotation(name)
+        return None
+
+    @contextmanager
+    def span(self, name: str, metric: str | None = None, **attrs):
+        """Open a nested span; see module docstring.  ``metric`` also
+        records the span into the PR-1 stage-timer registry under that
+        (snake_case) stage name."""
+        st = self._thread_state()
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        parent = st["stack"][-1].span_id if st["stack"] else None
+        handle = SpanHandle(
+            str(name), span_id, parent, st["tid"], metric, dict(attrs),
+            self._registry.counter(_COMPILE_COUNTER),
+        )
+        handle.t_start = time.perf_counter()
+        st["stack"].append(handle)
+        ann = self._annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        try:
+            yield handle
+        except BaseException as exc:
+            handle.attrs.setdefault("error", type(exc).__name__)
+            raise
+        finally:
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:  # pragma: no cover - profiler teardown
+                    pass
+            handle.t_end = time.perf_counter()
+            if st["stack"] and st["stack"][-1] is handle:
+                st["stack"].pop()
+            else:  # pragma: no cover - exotic generator teardown order
+                st["stack"] = [h for h in st["stack"] if h is not handle]
+            self._close(handle)
+
+    def _close(self, handle: SpanHandle) -> None:
+        compiles = (self._registry.counter(_COMPILE_COUNTER)
+                    - handle._compiles0)
+        if compiles > 0:
+            handle.attrs["compiles"] = compiles
+        if self._hbm_supported is not False:
+            wm = hbm_watermark()
+            if wm is None:
+                self._hbm_supported = False
+            else:
+                self._hbm_supported = True
+                handle.attrs["hbm_bytes_in_use"] = wm["bytes_in_use"]
+                handle.attrs["hbm_peak_bytes"] = wm["peak_bytes_in_use"]
+                if wm["peak_bytes_in_use"] > self._hbm_high:
+                    self._hbm_high = wm["peak_bytes_in_use"]
+                    self._registry.gauge(
+                        "hbm.high_water_bytes", self._hbm_high)
+        rec = SpanRecord(
+            name=handle.name, span_id=handle.span_id,
+            parent_id=handle.parent_id, tid=handle.tid,
+            t_start=handle.t_start, t_end=handle.t_end,
+            device_s=handle.device_s, attrs=handle.attrs,
+        )
+        with self._lock:
+            if len(self._records) < self._max_spans:
+                self._records.append(rec)
+            else:
+                self.dropped += 1
+                self._registry.inc("trace.spans_dropped")
+        if handle.metric:
+            self._registry.observe(
+                handle.metric, handle.host_s, handle.device_s)
+
+    # -- access ------------------------------------------------------------
+
+    def records(self) -> list[SpanRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def reset(self) -> None:
+        """Start a fresh per-run span tree (ids keep increasing so
+        references into an exported trace stay unambiguous)."""
+        with self._lock:
+            self._records.clear()
+        self.dropped = 0
+        self._hbm_high = 0
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _TRACER
+
+
+def span(name: str, metric: str | None = None, **attrs):
+    """Module-level face of ``get_tracer().span(...)`` — the ONE API
+    pipeline stages use (PSL006)."""
+    return _TRACER.span(name, metric=metric, **attrs)
+
+
+# --------------------------------------------------------------------------
+# Chrome trace-event export
+# --------------------------------------------------------------------------
+
+def chrome_events(records, process_index: int = 0,
+                  epoch: float = 0.0) -> list[dict]:
+    """Balanced ``B``/``E`` trace events (µs timestamps, monotonic per
+    tid) plus ``M`` metadata, loadable in Perfetto/chrome://tracing.
+
+    Spans nest properly per thread by construction; the emitter walks
+    each thread's span forest depth-first so every ``B`` has its ``E``
+    and timestamps never run backwards (children are clamped into
+    their parent's interval against float rounding).
+    """
+    by_id = {r.span_id: r for r in records}
+    children: dict[int, list[SpanRecord]] = {}
+    roots: dict[int, list[SpanRecord]] = {}
+    for r in sorted(records, key=lambda r: (r.t_start, r.span_id)):
+        if r.parent_id is not None and r.parent_id in by_id:
+            children.setdefault(r.parent_id, []).append(r)
+        else:
+            roots.setdefault(r.tid, []).append(r)
+    events: list[dict] = [{
+        "ph": "M", "name": "process_name", "pid": process_index,
+        "tid": 0, "args": {"name": f"host {process_index}"},
+    }]
+
+    def us(t: float) -> float:
+        return round((t + epoch) * 1e6, 3)
+
+    def emit(r: SpanRecord, lo: float, hi: float, cursor: float) -> float:
+        ts_b = min(max(us(r.t_start), lo, cursor), hi)
+        args = {"span_id": r.span_id,
+                "device_ms": round(r.device_s * 1e3, 3)}
+        if r.parent_id is not None:
+            args["parent_id"] = r.parent_id
+        args.update(r.attrs)
+        events.append({
+            "name": r.name, "cat": "peasoup", "ph": "B", "ts": ts_b,
+            "pid": process_index, "tid": r.tid, "args": args,
+        })
+        cursor = ts_b
+        for c in children.get(r.span_id, []):
+            cursor = emit(c, ts_b, max(us(r.t_end), ts_b), cursor)
+        ts_e = min(max(us(r.t_end), cursor), max(hi, cursor))
+        events.append({
+            "name": r.name, "ph": "E", "ts": ts_e,
+            "pid": process_index, "tid": r.tid,
+        })
+        return ts_e
+
+    for tid in sorted(roots):
+        cursor = float("-inf")
+        for r in roots[tid]:
+            cursor = emit(r, float("-inf"), float("inf"), cursor)
+    return events
+
+
+def local_trace_payload(tracer: Tracer | None = None) -> bytes:
+    """This process's spans as one opaque JSON payload (pid-tagged with
+    ``jax.process_index()``) — the unit the multihost gather ships."""
+    tracer = tracer if tracer is not None else _TRACER
+    pi = _process_index()
+    return json.dumps({
+        "v": 1,
+        "process_index": pi,
+        "dropped": tracer.dropped,
+        "events": chrome_events(tracer.records(), process_index=pi,
+                                epoch=tracer.epoch),
+    }).encode()
+
+
+def write_merged_trace(path: str, tracer: Tracer | None = None,
+                       gather=None,
+                       process_index: int | None = None) -> str | None:
+    """Gather every host's spans and write ONE merged Chrome trace.
+
+    ``gather`` maps this process's payload (bytes) to the ordered list
+    of all processes' payloads; it defaults to
+    ``parallel.multihost.gather_host_payloads`` (the real allgather —
+    single-process runs never touch collectives).  Only process 0
+    writes; other processes participate in the gather and return None.
+    Telemetry I/O failures warn, never raise.
+    """
+    payload = local_trace_payload(tracer)
+    if gather is None:
+        from ..parallel.multihost import gather_host_payloads as gather
+    parts = gather(payload)
+    pi = process_index if process_index is not None else _process_index()
+    if pi != 0:
+        return None
+    events: list[dict] = []
+    n_parts = 0
+    for part in parts:
+        try:
+            doc = json.loads(part)
+        except (TypeError, ValueError):
+            continue
+        n_parts += 1
+        events.extend(doc.get("events", []))
+    # one shared zero point: the earliest span across every host
+    ts0 = min((e["ts"] for e in events
+               if "ts" in e and e.get("ph") != "M"), default=0.0)
+    for e in events:
+        if "ts" in e:
+            e["ts"] = round(e["ts"] - ts0, 3)
+    doc = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"tool": "peasoup-tpu", "n_processes": n_parts},
+    }
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError as exc:
+        import warnings
+
+        warnings.warn(f"could not write trace {path!r}: {exc}")
+        return None
+    return path
+
+
+# --------------------------------------------------------------------------
+# span table (run_report.json)
+# --------------------------------------------------------------------------
+
+def span_table(records=None) -> dict:
+    """Per-name aggregate {count, total_s, self_s, device_s}, ordered
+    by descending self time (total minus direct children) — the
+    "where did the run actually go" table run_report.json carries."""
+    records = list(records if records is not None else _TRACER.records())
+    by_id = {r.span_id: r for r in records}
+    child_time: dict[int, float] = {}
+    for r in records:
+        if r.parent_id in by_id:
+            child_time[r.parent_id] = (
+                child_time.get(r.parent_id, 0.0) + (r.t_end - r.t_start))
+    agg: dict[str, dict] = {}
+    for r in records:
+        rec = agg.setdefault(
+            r.name,
+            {"count": 0, "total_s": 0.0, "self_s": 0.0, "device_s": 0.0})
+        dur = r.t_end - r.t_start
+        rec["count"] += 1
+        rec["total_s"] += dur
+        rec["self_s"] += max(dur - child_time.get(r.span_id, 0.0), 0.0)
+        rec["device_s"] += r.device_s
+    return {
+        name: {k: (v if k == "count" else round(v, 6))
+               for k, v in rec.items()}
+        for name, rec in sorted(agg.items(),
+                                key=lambda kv: -kv[1]["self_s"])
+    }

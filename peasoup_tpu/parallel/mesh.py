@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..errors import ConfigError, HBMBudgetError
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
+from ..obs.trace import span
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
@@ -1233,11 +1234,33 @@ class MeshPulsarSearch(PulsarSearch):
                         list(fs), d, nsamps_dev, self.out_nsamps)
                 )
             cache[dm_tile] = fn
-        from ..utils import trace_range
-
-        with trace_range("Dedisperse"), METRICS.timer("dedispersion") as tm:
+        with span("Dedisperse", metric="dedispersion",
+                  n_rows=int(len(delays_rows)),
+                  dm_tile=int(dm_tile)) as sp:
             return self._maybe_quantise(
-                tm.block(fn(jnp.asarray(delays_rows), *data_parts)))
+                sp.block(fn(jnp.asarray(delays_rows), *data_parts)))
+
+    def measure_dedispersion_stage(self) -> float:
+        """One warm + one timed dedispersion-only dispatch; returns the
+        steady-state stage seconds (also recorded as a ``Dedisperse``
+        span / ``dedispersion`` stage timer).
+
+        The mesh programs fuse dedispersion into the search dispatch,
+        so there is no in-run stage boundary to clock — this dedicated
+        dispatch is how ``--measure_stages`` (and bench.py) put a real
+        number in ``timers["dedispersion"]`` instead of the 0.0 the
+        fused path otherwise reports.
+        """
+        import time
+
+        warm = self.dedisperse_sharded()
+        np.asarray(warm[:1, :1])  # compile + execute untimed
+        t0 = time.time()
+        with span("Dedisperse", metric="dedispersion",
+                  n_dm_trials=len(self.dm_list), measured=True) as sp:
+            trials = self.dedisperse_sharded()
+            sp.block(trials)
+        return time.time() - t0
 
     def _fold_trials_provider(self, dm_idxs):
         """Re-dedisperse just the candidate DM rows for folding (the
@@ -1329,7 +1352,6 @@ class MeshPulsarSearch(PulsarSearch):
         METRICS.gauge("chunk.accel_block", plan["accel_block"])
         METRICS.gauge("chunk.peak_capacity", cap)
         METRICS.gauge("chunk.compact_k", compact_k)
-        from ..utils import trace_range
 
         t0 = time.time()
         # sub-band (two-stage) dedispersion plan — must precede the
@@ -1443,7 +1465,19 @@ class MeshPulsarSearch(PulsarSearch):
                         put_global(a2, shard1),
                         put_global(a3, shard),
                     )
-            with trace_range(f"Chunked-Search-{ci}"):
+            # per-chunk attribution: which DM rows this dispatch covers
+            # and how many real (non-padding) trials it searches.  NB
+            # the span closes at dispatch RETURN (execution is async by
+            # design — double-buffering); the wait shows up in the
+            # fetch span of the same chunk.
+            live = [int(r) for r in rows if int(r) < ndm]
+            with span(f"Chunked-Search-{ci}", chunk=int(ci),
+                      n_dm_rows=len(live),
+                      dm_lo=(float(self.dm_list[min(live)])
+                             if live else None),
+                      dm_hi=(float(self.dm_list[max(live)])
+                             if live else None),
+                      n_trials=sum(len(acc_lists[r]) for r in live)):
                 return program(
                     *data_parts,
                     *sb_args,
@@ -1486,11 +1520,17 @@ class MeshPulsarSearch(PulsarSearch):
                 nxt = dispatch(*todo[k + 1])
                 phases["dispatch"] += time.time() - tp
             tp = time.time()
-            packed = fetch_to_host(pending)
+            with span("Chunk-Fetch", chunk=int(ci)) as sp_f:
+                tf = time.time()
+                packed = fetch_to_host(pending)
+                # the fetch wait IS device (+link) time: the dispatch
+                # span closed at async return, so the wait lands here
+                sp_f.add_device_time(time.time() - tf)
             phases["fetch"] += time.time() - tp
             pending = nxt if k + 1 < len(todo) else None
             tp = time.time()
-            with trace_range("Peak-Decode"):
+            with span("Peak-Decode", metric="peak_decode",
+                      chunk=int(ci)):
                 (groups_l, mx_count, mx_valid, counts_l,
                  clipped_l, _truncated_l) = self._decode_packed(
                     packed, dm_chunk, namax_p, nlevels, cap, compact_k
@@ -1523,7 +1563,7 @@ class MeshPulsarSearch(PulsarSearch):
             # one segmented native call distills every non-clipped row
             # of the chunk (rows with no peaks get an empty group)
             tp = time.time()
-            with trace_range("Distill"):
+            with span("Distill", metric="distillation", chunk=int(ci)):
                 batch = self._distill_rows_batch(
                     (int(rows[key]), groups_l.get(key),
                      acc_lists[int(rows[key])])
@@ -1888,6 +1928,7 @@ class MeshPulsarSearch(PulsarSearch):
             self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
         ]
         namax = max(len(a) for a in acc_lists)
+        n_trials_total = sum(len(a) for a in acc_lists)
 
         plan = self._plan_chunking(namax)
         if plan is not None:
@@ -1944,8 +1985,6 @@ class MeshPulsarSearch(PulsarSearch):
             getattr(self, "_ck_hint", cfg.compact_capacity),
         )
 
-        from ..utils import trace_range
-
         t0 = time.time()
         inputs = self._device_inputs(acc_lists, ndm_p, namax)
         cap0 = cap
@@ -1980,16 +2019,21 @@ class MeshPulsarSearch(PulsarSearch):
         METRICS.inc("runs.mesh_fused")
         while True:
             program = make_program(cap, compact_k)
-            with trace_range("Fused-Search"), \
-                    METRICS.timer("fused_search") as tm:
+            with span("Fused-Search", metric="fused_search",
+                      n_dm_trials=ndm, n_trials=int(n_trials_total),
+                      dm_lo=float(self.dm_list[0]),
+                      dm_hi=float(self.dm_list[-1]),
+                      capacity=int(cap), compact_k=int(compact_k),
+                      hbm_budget_bytes=float(cfg.hbm_budget_gb * 1e9),
+                      ) as sp:
                 packed, trials = program(*inputs)
                 # ONE gather over ICI/DCN -> host; ``trials`` stays on
                 # device for the folding phase.  The fetch wait is the
                 # device (plus link) share of this stage's wall-clock.
                 tf = time.time()
                 packed = fetch_to_host(packed)
-                tm.add_device_time(time.time() - tf)
-            with trace_range("Peak-Decode"), METRICS.timer("peak_decode"):
+                sp.add_device_time(time.time() - tf)
+            with span("Peak-Decode", metric="peak_decode"):
                 (per_dm_groups, mx_count, mx_valid, counts_arr,
                  clipped, truncated) = self._decode_packed(
                     packed, ndm_local, namax, nlevels, cap, compact_k
@@ -2046,20 +2090,12 @@ class MeshPulsarSearch(PulsarSearch):
             )
         timers["dedispersion"] = 0.0  # fused into the search program
         if cfg.measure_stages:
-            # one real timed dedisp-only dispatch (the fused program
-            # has no separable stage boundary to clock); first call
-            # warms the compile untimed
-            w_trials = self.dedisperse_sharded()
-            np.asarray(w_trials[:1, :1])
-            tm = time.time()
-            d_trials = self.dedisperse_sharded()
-            np.asarray(d_trials[:1, :1])
-            timers["dedispersion"] = time.time() - tm
+            timers["dedispersion"] = self.measure_dedispersion_stage()
         # sub-span of "searching" (which covers device + host decode)
         timers["searching_device"] = time.time() - t0
         dm_cands = CandidateCollection()
         ckpt_done = {}
-        with trace_range("Distill"), METRICS.timer("distillation"):
+        with span("Distill", metric="distillation", n_dm_trials=ndm):
             batch = self._distill_rows_batch(
                 (ii, per_dm_groups.get(ii), acc_lists[ii])
                 for ii in range(ndm) if ii not in rerun

@@ -64,3 +64,34 @@ def global_mesh(axis: str = "dm"):
     from jax.sharding import Mesh
 
     return Mesh(np.array(jax.devices()), (axis,))
+
+
+def gather_host_payloads(payload: bytes) -> list[bytes]:
+    """All-gather one opaque bytes payload per process, ordered by
+    process index.
+
+    The span tracer uses this to merge per-host traces: every host
+    serialises its local spans (``obs.trace.local_trace_payload``),
+    the payloads ride a padded uint8 ``process_allgather`` over
+    ICI/DCN, and process 0 writes the merged Chrome trace.  A
+    single-process run returns ``[payload]`` without touching
+    collectives, so the path is free off-pod.
+    """
+    import jax
+
+    payload = bytes(payload)
+    if jax.process_count() == 1:
+        return [payload]
+    from jax.experimental import multihost_utils
+
+    arr = np.frombuffer(payload, np.uint8)
+    # lengths first: payload sizes differ per host (span counts do)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.array([arr.size], np.int64))).reshape(-1)
+    width = max(int(lens.max()), 1)
+    padded = np.zeros(width, np.uint8)
+    padded[: arr.size] = arr
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded)
+    ).reshape(len(lens), width)
+    return [bytes(gathered[i, : int(lens[i])]) for i in range(len(lens))]

@@ -33,6 +33,7 @@ from ..errors import ConfigError
 from ..io.sigproc import Filterbank
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
+from ..obs.trace import span
 from ..ops import (
     dedisperse,
     delay_table,
@@ -427,7 +428,10 @@ class PulsarSearch:
         while True:  # auto-escalate on peak-buffer overflow: no silent
             all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
             for c0 in range(0, padded, chunk):
-                with METRICS.timer("accel_search") as tm:
+                with span("Accel-Search", metric="accel_search",
+                          dm_trial=int(idx), dm=dm, chunk_start=int(c0),
+                          n_trials=int(min(chunk, n - c0)),
+                          capacity=int(cap)) as sp:
                     if self.resample_block is not None:
                         idxs, snrs, counts = search_accel_chunk(
                             tim_w, chunk_tables[c0], mean, std,
@@ -442,7 +446,7 @@ class PulsarSearch:
                             cfg.nharmonics, self.bounds, cap, cfg.min_snr,
                             self.max_shift,
                         )
-                    tm.block((idxs, snrs, counts))
+                    sp.block((idxs, snrs, counts))
                 all_idxs.append(np.asarray(idxs))
                 all_snrs.append(np.asarray(snrs))
                 all_counts.append(np.asarray(counts))
@@ -675,7 +679,7 @@ class PulsarSearch:
 
     def run(self) -> SearchResult:
         from ..obs.metrics import install_compile_hook
-        from ..utils import ProgressBar, trace_range
+        from ..utils import ProgressBar
 
         install_compile_hook()
         cfg = self.config
@@ -695,10 +699,11 @@ class PulsarSearch:
         timers["dedispersion"] = 0.0
         if not (complete and cfg.npdmp == 0):
             t0 = time.time()
-            with trace_range("Dedisperse"), \
-                    METRICS.timer("dedispersion") as tm:
+            with span("Dedisperse", metric="dedispersion",
+                      n_dm_trials=len(self.dm_list),
+                      out_nsamps=int(self.out_nsamps)) as sp:
                 trials = self.dedisperse()
-                tm.block(trials)
+                sp.block(trials)
             timers["dedispersion"] = time.time() - t0
 
         t0 = time.time()
@@ -706,7 +711,8 @@ class PulsarSearch:
         pbar = ProgressBar(len(self.dm_list), "DM trials ",
                            enabled=cfg.progress_bar)
         pbar.start()
-        with trace_range("DM-Loop"), METRICS.timer("searching"):
+        with span("DM-Loop", metric="searching",
+                  n_dm_trials=len(self.dm_list)):
             for ii in range(len(self.dm_list)):
                 if ii not in done:
                     done[ii] = self.search_dm_trial(trials, ii)
@@ -733,7 +739,8 @@ class PulsarSearch:
         candidate DM rows are re-dedispersed only if folding runs.
         """
         cfg = self.config
-        with METRICS.timer("distillation"):
+        with span("Distill", metric="distillation",
+                  n_candidates=len(dm_cands.cands)):
             dm_still = DMDistiller(cfg.freq_tol, True)
             harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True,
                                            False)
@@ -747,8 +754,6 @@ class PulsarSearch:
         scorer.score_all(cands)
 
         import time
-
-        from ..utils import trace_range
 
         t0 = time.time()
         if cfg.npdmp > 0:
@@ -781,7 +786,8 @@ class PulsarSearch:
                     search_accel_chunk.clear_cache()
                     search_accel_chunk_legacy.clear_cache()
                     gc.collect()
-                with trace_range("Folding"), METRICS.timer("folding"):
+                with span("Folding", metric="folding",
+                          npdmp=int(cfg.npdmp)):
                     fold_candidates(
                         cands, trials, self.out_nsamps, hdr.tsamp,
                         cfg.npdmp,

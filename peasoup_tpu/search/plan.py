@@ -124,6 +124,11 @@ class SearchConfig:
     # the search identity key
     events_log: str = ""
     metrics_json: str = ""
+    # span-trace export (obs/trace.py): Chrome trace-event JSON,
+    # loadable in Perfetto/chrome://tracing; multihost runs merge all
+    # hosts' spans into the one file process 0 writes.  Empty =
+    # <outdir>/trace.json (CLI default)
+    trace_json: str = ""
 
 
 class AccelerationPlan:

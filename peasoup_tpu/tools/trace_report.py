@@ -1,0 +1,173 @@
+"""Summarise a span trace without Perfetto.
+
+Reads the Chrome trace-event JSON written by ``--trace_json`` /
+``bench.py --trace`` (`obs/trace.py`), rebuilds the span forest from
+the ``B``/``E`` phase pairs, and prints
+
+* a top-N **self-time** table (total minus direct children — the
+  "where did the run actually go" ordering), and
+* the **critical path**: starting from the longest root span, descend
+  into the longest child at every level.
+
+``--require NAME...`` exits nonzero unless every named span is
+present — the ``make trace-smoke`` gate.
+
+Usage::
+
+    python -m peasoup_tpu.tools.trace_report outdir/trace.json
+    python -m peasoup_tpu.tools.trace_report trace.json --top 20
+    python -m peasoup_tpu.tools.trace_report trace.json \
+        --require Dedisperse DM-Loop Accel-Search Distill Folding
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    return doc  # bare event array is also a valid Chrome trace
+
+
+def rebuild_spans(events: list[dict]) -> list[dict]:
+    """Span forest from B/E pairs, per (pid, tid) stack.
+
+    Returns the flat list of spans ``{name, pid, tid, ts, dur_ms,
+    self_ms, device_ms, args, children}`` (roots have ``parent`` None).
+    Raises ValueError on unbalanced phases — a trace that cannot be
+    trusted should fail loudly, not summarise garbage.
+    """
+    per: dict[tuple, list[dict]] = {}
+    for e in events:
+        if e.get("ph") in ("B", "E"):
+            per.setdefault((e.get("pid", 0), e.get("tid", 0)),
+                           []).append(e)
+    spans: list[dict] = []
+    for (pid, tid), evs in per.items():
+        evs.sort(key=lambda e: e["ts"])  # stable: file order on ties
+        stack: list[dict] = []
+        for e in evs:
+            if e["ph"] == "B":
+                s = {
+                    "name": e.get("name", "?"), "pid": pid, "tid": tid,
+                    "ts": e["ts"], "args": e.get("args", {}),
+                    "children": [],
+                    "parent": stack[-1] if stack else None,
+                }
+                if stack:
+                    stack[-1]["children"].append(s)
+                stack.append(s)
+                spans.append(s)
+            else:
+                if not stack:
+                    raise ValueError(
+                        f"unbalanced trace: E without B at ts={e['ts']} "
+                        f"(pid={pid}, tid={tid})")
+                s = stack.pop()
+                s["dur_ms"] = (e["ts"] - s["ts"]) / 1e3
+        if stack:
+            raise ValueError(
+                f"unbalanced trace: {len(stack)} unclosed span(s) on "
+                f"pid={pid}, tid={tid} (first: {stack[0]['name']})")
+    for s in spans:
+        s["self_ms"] = max(
+            s["dur_ms"] - sum(c["dur_ms"] for c in s["children"]), 0.0)
+        s["device_ms"] = float(s["args"].get("device_ms", 0.0))
+    return spans
+
+
+def self_time_table(spans: list[dict], top: int = 15) -> str:
+    agg: dict[str, dict] = {}
+    for s in spans:
+        rec = agg.setdefault(s["name"], {
+            "count": 0, "total_ms": 0.0, "self_ms": 0.0,
+            "device_ms": 0.0})
+        rec["count"] += 1
+        rec["total_ms"] += s["dur_ms"]
+        rec["self_ms"] += s["self_ms"]
+        rec["device_ms"] += s["device_ms"]
+    rows = sorted(agg.items(), key=lambda kv: -kv[1]["self_ms"])[:top]
+    width = max([len("span")] + [len(name) for name, _ in rows]) + 2
+    lines = [f"{'span':<{width}}{'n':>5} {'total_ms':>10} "
+             f"{'self_ms':>10} {'device_ms':>10}"]
+    for name, rec in rows:
+        lines.append(
+            f"{name:<{width}}{rec['count']:>5} {rec['total_ms']:>10.2f} "
+            f"{rec['self_ms']:>10.2f} {rec['device_ms']:>10.2f}")
+    if len(agg) > top:
+        lines.append(f"... ({len(agg) - top} more span name(s))")
+    return "\n".join(lines)
+
+
+def critical_path(spans: list[dict]) -> list[dict]:
+    roots = [s for s in spans if s["parent"] is None]
+    if not roots:
+        return []
+    path = []
+    node = max(roots, key=lambda s: s["dur_ms"])
+    while node is not None:
+        path.append(node)
+        node = (max(node["children"], key=lambda s: s["dur_ms"])
+                if node["children"] else None)
+    return path
+
+
+def format_critical_path(path: list[dict]) -> str:
+    lines = ["critical path (longest child at each level):"]
+    for depth, s in enumerate(path):
+        lines.append(
+            f"{'  ' * (depth + 1)}{s['name']}  "
+            f"{s['dur_ms']:.2f} ms (self {s['self_ms']:.2f} ms, "
+            f"device {s['device_ms']:.2f} ms)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m peasoup_tpu.tools.trace_report",
+        description="top-N self-time table + critical path of a "
+                    "peasoup-tpu span trace (Chrome trace-event JSON)",
+    )
+    p.add_argument("trace", help="trace JSON (--trace_json output)")
+    p.add_argument("--top", type=int, default=15,
+                   help="rows in the self-time table (default 15)")
+    p.add_argument("--require", nargs="+", default=None, metavar="NAME",
+                   help="exit 1 unless every named span is present "
+                        "(smoke-test gate)")
+    args = p.parse_args(argv)
+
+    try:
+        events = load_events(args.trace)
+        spans = rebuild_spans(events)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    if not spans:
+        print("empty trace: no B/E span events", file=sys.stderr)
+        return 2
+    pids = sorted({s["pid"] for s in spans})
+    print(f"{len(spans)} spans over {len(pids)} process(es) "
+          f"{pids}")
+    print()
+    print(self_time_table(spans, args.top))
+    print()
+    print(format_critical_path(critical_path(spans)))
+    if args.require:
+        names = {s["name"] for s in spans}
+        missing = [n for n in args.require if n not in names]
+        if missing:
+            print(f"\nMISSING required span(s): {', '.join(missing)}",
+                  file=sys.stderr)
+            return 1
+        print(f"\nall {len(args.require)} required spans present")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,4 +1,4 @@
-"""Profiler trace annotations — the NVTX-range equivalent.
+"""Raw profiler trace annotations — the NVTX-range equivalent.
 
 The reference compiles NVTX push/pop ranges around "Dedisperse",
 "DM-Loop", "Acceleration-Loop" and "Harmonic summing"
@@ -7,6 +7,14 @@ On TPU the analogue is ``jax.profiler``: ``trace_range`` annotates a
 host-side region so it shows up in TensorBoard/Perfetto traces captured
 with ``start_trace``/``stop_trace`` (or the CLI's ``--profile_dir``).
 Annotations are no-ops unless a trace is being captured.
+
+NOTE: pipeline code must NOT call ``trace_range`` directly any more —
+``peasoup_tpu.obs.trace.span`` is the one stage-timing API (it still
+forwards the name to ``jax.profiler.TraceAnnotation``, and adds the
+always-on span record, registry stage timer, HBM watermark and
+Chrome-trace export).  Lint rule PSL006 enforces this outside
+``obs/``; ``trace_range`` stays for external users and the profiler
+start/stop helpers below.
 """
 
 from __future__ import annotations

@@ -197,6 +197,55 @@ def test_psl005_not_applied_to_ops(tmp_path):
     assert vs == []
 
 
+def test_psl006_raw_timer_and_trace_range_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        from peasoup_tpu.obs.metrics import REGISTRY as METRICS
+        from peasoup_tpu.utils import trace_range
+
+        def f():
+            with trace_range("Dedisperse"):
+                pass
+            with METRICS.timer("dedispersion") as tm:
+                pass
+            with METRICS.timer("x"), trace_range("y"):
+                pass
+    """, relpath="peasoup_tpu/search/fixture.py")
+    assert [v.rule for v in vs] == ["PSL006"] * 4
+    assert all("span" in v.message for v in vs)
+
+
+def test_psl006_span_api_and_obs_exempt(tmp_path):
+    # the replacement API itself is clean
+    vs, _ = _lint_snippet(tmp_path, """
+        from peasoup_tpu.obs.trace import span
+
+        def f():
+            with span("Dedisperse", metric="dedispersion",
+                      n_rows=8) as sp:
+                sp.block(None)
+    """, relpath="peasoup_tpu/search/fixture.py")
+    assert vs == []
+    # obs/ (where the registry and span() are implemented) is exempt
+    vs, _ = _lint_snippet(tmp_path, """
+        def f(METRICS):
+            with METRICS.timer("jit_compile"):
+                pass
+    """, relpath="peasoup_tpu/obs/fixture.py")
+    assert vs == []
+
+
+def test_psl006_pragma_escape(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        from peasoup_tpu.obs.metrics import REGISTRY as METRICS
+
+        def f():
+            with METRICS.timer("micro"):  # psl: disable=PSL006 -- benchmark-only scratch timer
+                pass
+    """, relpath="peasoup_tpu/search/fixture.py")
+    assert vs == []
+    assert suppressed == 1
+
+
 # --------------------------------------------------------------------------
 # suppressions
 # --------------------------------------------------------------------------

@@ -1,0 +1,452 @@
+"""Span-tracing tests: nesting/ids, Chrome trace-event schema
+validity, host/device split, HBM sampler behaviour, multihost merge
+with a faked 2-process gather, the trace_report tool, and the e2e
+tutorial run whose trace must cover the five pipeline stages."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs.metrics import MetricsRegistry
+from peasoup_tpu.obs.trace import (
+    Tracer,
+    chrome_events,
+    get_tracer,
+    local_trace_payload,
+    span_table,
+    write_merged_trace,
+)
+
+
+def _balance_check(events):
+    """Every B has its E, LIFO per (pid, tid); ts never runs backwards
+    per tid.  Returns the B/E event count checked."""
+    stacks = {}
+    last_ts = {}
+    n = 0
+    for e in events:
+        ph = e.get("ph")
+        if ph not in ("B", "E"):
+            continue
+        n += 1
+        key = (e.get("pid", 0), e.get("tid", 0))
+        assert e["ts"] >= last_ts.get(key, float("-inf")), (
+            f"ts ran backwards on {key}: {e}")
+        last_ts[key] = e["ts"]
+        if ph == "B":
+            stacks.setdefault(key, []).append(e["name"])
+        else:
+            assert stacks.get(key), f"E without B: {e}"
+            assert stacks[key].pop() == e["name"]
+    for key, st in stacks.items():
+        assert st == [], f"unclosed spans on {key}: {st}"
+    return n
+
+
+# --------------------------------------------------------------------------
+# span nesting, ids, attributes
+# --------------------------------------------------------------------------
+
+def test_span_nesting_parent_ids():
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("outer", n_dm_trials=3) as o:
+        with t.span("inner") as i:
+            pass
+        with t.span("inner2") as i2:
+            pass
+    with t.span("sibling") as s:
+        pass
+    recs = {r.name: r for r in t.records()}
+    assert len(recs) == 4
+    assert recs["outer"].parent_id is None
+    assert recs["sibling"].parent_id is None
+    assert recs["inner"].parent_id == recs["outer"].span_id
+    assert recs["inner2"].parent_id == recs["outer"].span_id
+    ids = [r.span_id for r in t.records()]
+    assert len(set(ids)) == 4
+    # children close before (or when) the parent does
+    assert recs["inner"].t_end <= recs["outer"].t_end
+    assert recs["outer"].attrs["n_dm_trials"] == 3
+    assert o.span_id == recs["outer"].span_id
+    assert i.span_id != i2.span_id != s.span_id
+
+
+def test_span_metric_feeds_stage_timer_registry():
+    reg = MetricsRegistry()
+    t = Tracer(registry=reg)
+    with t.span("Dedisperse", metric="dedispersion"):
+        pass
+    with t.span("no-metric"):
+        pass
+    timers = reg.snapshot()["timers"]
+    assert list(timers) == ["dedispersion"]
+    assert timers["dedispersion"]["count"] == 1
+    assert timers["dedispersion"]["host_s"] >= 0.0
+
+
+def test_span_set_attrs_and_error_capture():
+    t = Tracer(registry=MetricsRegistry())
+    with pytest.raises(RuntimeError):
+        with t.span("boom") as sp:
+            sp.set(rows=7)
+            raise RuntimeError("x")
+    (rec,) = t.records()
+    assert rec.attrs["rows"] == 7
+    assert rec.attrs["error"] == "RuntimeError"
+
+
+def test_device_host_split_sanity():
+    import jax.numpy as jnp
+
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("compute") as sp:
+        arr = jnp.arange(4096) * 3
+        out = sp.block(arr)
+    assert out is arr
+    (rec,) = t.records()
+    assert 0.0 <= rec.device_s <= (rec.t_end - rec.t_start)
+
+
+def test_span_threads_get_distinct_tids():
+    t = Tracer(registry=MetricsRegistry())
+
+    def work():
+        with t.span("worker"):
+            pass
+
+    th = threading.Thread(target=work)
+    with t.span("main"):
+        th.start()
+        th.join()
+    recs = {r.name: r for r in t.records()}
+    assert recs["main"].tid != recs["worker"].tid
+    # a thread's root span has no parent (stacks are per-thread)
+    assert recs["worker"].parent_id is None
+
+
+def test_span_cap_drops_not_grows():
+    reg = MetricsRegistry()
+    t = Tracer(registry=reg, max_spans=3)
+    for _ in range(5):
+        with t.span("s"):
+            pass
+    assert len(t.records()) == 3
+    assert t.dropped == 2
+    assert reg.counter("trace.spans_dropped") == 2
+
+
+# --------------------------------------------------------------------------
+# Chrome trace-event schema
+# --------------------------------------------------------------------------
+
+def test_chrome_events_balanced_and_monotonic():
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("a", k=1):
+        with t.span("b"):
+            with t.span("c"):
+                pass
+        with t.span("d"):
+            pass
+    with t.span("e"):
+        pass
+    events = chrome_events(t.records(), process_index=0, epoch=t.epoch)
+    assert _balance_check(events) == 10  # 5 spans x (B + E)
+    b = next(e for e in events if e.get("ph") == "B" and e["name"] == "a")
+    assert b["args"]["k"] == 1
+    assert "span_id" in b["args"] and "device_ms" in b["args"]
+    bb = next(e for e in events if e.get("ph") == "B" and e["name"] == "b")
+    assert bb["args"]["parent_id"] == b["args"]["span_id"]
+    # JSON round-trips
+    json.loads(json.dumps(events))
+
+
+def test_write_merged_trace_single_process(tmp_path):
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("root"):
+        with t.span("leaf"):
+            pass
+    path = str(tmp_path / "trace.json")
+    out = write_merged_trace(path, tracer=t, gather=lambda b: [b],
+                             process_index=0)
+    assert out == path
+    doc = json.load(open(path))
+    assert doc["metadata"]["n_processes"] == 1
+    evs = doc["traceEvents"]
+    _balance_check(evs)
+    # timestamps are normalised to the earliest span
+    ts = [e["ts"] for e in evs if "ts" in e and e.get("ph") != "M"]
+    assert min(ts) == 0.0
+
+
+# --------------------------------------------------------------------------
+# HBM watermark sampler
+# --------------------------------------------------------------------------
+
+def test_hbm_sampler_noop_on_cpu():
+    """CPU devices report no memory stats: spans carry no hbm attrs,
+    no high-water gauge appears, and nothing raises."""
+    reg = MetricsRegistry()
+    t = Tracer(registry=reg)
+    with t.span("s"):
+        pass
+    (rec,) = t.records()
+    assert "hbm_bytes_in_use" not in rec.attrs
+    assert "hbm.high_water_bytes" not in reg.snapshot()["gauges"]
+    # the unsupported probe result is cached — later spans skip polling
+    assert t._hbm_supported is False
+
+
+def test_hbm_sampler_records_watermarks_when_supported(monkeypatch):
+    from peasoup_tpu.obs import trace as tr
+
+    stats = iter([
+        {"bytes_in_use": 100, "peak_bytes_in_use": 800},
+        {"bytes_in_use": 50, "peak_bytes_in_use": 1200},
+    ])
+    monkeypatch.setattr(tr, "hbm_watermark", lambda: next(stats))
+    reg = MetricsRegistry()
+    t = Tracer(registry=reg)
+    with t.span("s1"):
+        pass
+    with t.span("s2"):
+        pass
+    r1, r2 = t.records()
+    assert r1.attrs["hbm_bytes_in_use"] == 100
+    assert r1.attrs["hbm_peak_bytes"] == 800
+    assert r2.attrs["hbm_peak_bytes"] == 1200
+    # run-level high-water gauge tracks the max peak seen
+    assert reg.snapshot()["gauges"]["hbm.high_water_bytes"] == 1200
+
+
+# --------------------------------------------------------------------------
+# span table
+# --------------------------------------------------------------------------
+
+def test_span_table_self_vs_total():
+    import time
+
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("outer"):
+        with t.span("inner"):
+            time.sleep(0.02)
+    table = span_table(t.records())
+    assert set(table) == {"outer", "inner"}
+    assert table["inner"]["total_s"] >= 0.02
+    # outer's self time excludes its child
+    assert table["outer"]["self_s"] <= (
+        table["outer"]["total_s"] - table["inner"]["total_s"] + 1e-3)
+    for rec in table.values():
+        assert {"count", "total_s", "self_s", "device_s"} <= set(rec)
+
+
+# --------------------------------------------------------------------------
+# multihost merge (faked 2-process gather)
+# --------------------------------------------------------------------------
+
+def test_multihost_merge_faked_two_process_gather(tmp_path):
+    """Host 0 gathers both processes' payloads and writes ONE merged
+    trace whose events keep their per-process pid tags; host 1 joins
+    the gather but writes nothing."""
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("Fused-Search", n_dm_trials=59):
+        with t.span("Peak-Decode"):
+            pass
+    payload0 = local_trace_payload(t)
+    # fake the second process: same spans, pid-tagged 1
+    doc1 = json.loads(payload0)
+    doc1["process_index"] = 1
+    for e in doc1["events"]:
+        e["pid"] = 1
+    payload1 = json.dumps(doc1).encode()
+
+    def fake_gather(payload):
+        assert payload == payload0
+        return [payload0, payload1]
+
+    path = str(tmp_path / "merged.json")
+    out = write_merged_trace(path, tracer=t, gather=fake_gather,
+                             process_index=0)
+    assert out == path
+    doc = json.load(open(path))
+    assert doc["metadata"]["n_processes"] == 2
+    pids = {e.get("pid") for e in doc["traceEvents"]
+            if e.get("ph") in ("B", "E")}
+    assert pids == {0, 1}
+    _balance_check(doc["traceEvents"])
+    # process 1 participates but does not write
+    other = str(tmp_path / "other.json")
+    assert write_merged_trace(other, tracer=t, gather=fake_gather,
+                              process_index=1) is None
+    assert not os.path.exists(other)
+
+
+def test_gather_host_payloads_single_process():
+    from peasoup_tpu.parallel.multihost import gather_host_payloads
+
+    assert gather_host_payloads(b"abc") == [b"abc"]
+
+
+# --------------------------------------------------------------------------
+# trace_report tool
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def sample_trace(tmp_path):
+    import time
+
+    t = Tracer(registry=MetricsRegistry())
+    with t.span("Fused-Search"):
+        with t.span("Peak-Decode"):
+            time.sleep(0.01)
+    with t.span("Folding"):
+        pass
+    path = str(tmp_path / "trace.json")
+    write_merged_trace(path, tracer=t, gather=lambda b: [b],
+                       process_index=0)
+    return path
+
+
+def test_trace_report_table_and_critical_path(sample_trace, capsys):
+    from peasoup_tpu.tools.trace_report import main
+
+    rc = main([sample_trace, "--top", "10"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Fused-Search" in out and "Peak-Decode" in out
+    assert "critical path" in out
+    # the critical path descends Fused-Search -> Peak-Decode
+    cp = out[out.index("critical path"):]
+    assert cp.index("Fused-Search") < cp.index("Peak-Decode")
+
+
+def test_trace_report_require_gate(sample_trace, capsys):
+    from peasoup_tpu.tools.trace_report import main
+
+    assert main([sample_trace, "--require", "Fused-Search",
+                 "Folding"]) == 0
+    capsys.readouterr()
+    assert main([sample_trace, "--require", "Dedisperse"]) == 1
+    assert "Dedisperse" in capsys.readouterr().err
+
+
+def test_trace_report_rejects_unbalanced(tmp_path, capsys):
+    from peasoup_tpu.tools.trace_report import main
+
+    path = str(tmp_path / "bad.json")
+    json.dump({"traceEvents": [
+        {"name": "x", "ph": "B", "ts": 0, "pid": 0, "tid": 0},
+    ]}, open(path, "w"))
+    assert main([path]) == 2
+    assert "unbalanced" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# driver integration: per-chunk spans with DM/trial attributes
+# --------------------------------------------------------------------------
+
+def test_chunked_driver_emits_per_chunk_spans(tutorial_fil):
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    get_tracer().reset()
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(
+        dm_start=0.0, dm_end=30.0, acc_start=-5.0, acc_end=5.0,
+        acc_pulse_width=64000.0, npdmp=0, limit=20,
+        dm_chunk=2, accel_block=2,
+    )
+    result = MeshPulsarSearch(fil, cfg).run()
+    assert len(result.candidates) > 0
+    recs = get_tracer().records()
+    chunks = [r for r in recs if r.name.startswith("Chunked-Search-")]
+    assert chunks, "chunked driver must open per-chunk spans"
+    for r in chunks:
+        assert r.attrs["chunk"] >= 0
+        assert r.attrs["n_dm_rows"] >= 0
+        if r.attrs["n_dm_rows"]:
+            assert r.attrs["dm_lo"] <= r.attrs["dm_hi"]
+            assert r.attrs["n_trials"] > 0
+    # chunk ids are distinct and dense from 0
+    ids = sorted(r.attrs["chunk"] for r in chunks)
+    assert ids == list(range(len(chunks)))
+    names = {r.name for r in recs}
+    assert {"Peak-Decode", "Distill"} <= names
+
+
+def test_measure_dedispersion_stage_reports_nonzero(tutorial_fil):
+    """The bench blind spot: the fused mesh path fuses dedispersion
+    into the search program and reported 0.0; the dedicated
+    measurement dispatch must return a real figure."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(tutorial_fil)
+    cfg = SearchConfig(dm_start=0.0, dm_end=30.0, npdmp=0, limit=20)
+    search = MeshPulsarSearch(fil, cfg)
+    get_tracer().reset()
+    dt = search.measure_dedispersion_stage()
+    assert dt > 0.0
+    recs = [r for r in get_tracer().records() if r.name == "Dedisperse"]
+    assert recs and recs[-1].attrs.get("measured") is True
+
+
+# --------------------------------------------------------------------------
+# e2e: tutorial CLI run covers the five pipeline stages
+# --------------------------------------------------------------------------
+
+FIVE_STAGES = {"Dedisperse", "DM-Loop", "Accel-Search", "Distill",
+               "Folding"}
+
+
+def test_tutorial_cli_trace_covers_five_stages(tutorial_fil, tmp_path):
+    import warnings
+
+    from peasoup_tpu.cli import main
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.tools.trace_report import (
+        critical_path,
+        rebuild_spans,
+    )
+
+    REGISTRY.reset()  # stage-timer counts must describe THIS run
+    outdir = str(tmp_path / "out")
+    trace_path = str(tmp_path / "trace.json")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main([
+            "-i", tutorial_fil, "-o", outdir,
+            "--dm_start", "0", "--dm_end", "60",
+            "--acc_start", "-5", "--acc_end", "5",
+            "--acc_pulse_width", "64000",
+            "--npdmp", "2", "--limit", "50",
+            "--single_device", "--trace_json", trace_path,
+        ])
+    assert rc == 0
+    doc = json.load(open(trace_path))
+    events = doc["traceEvents"]
+    _balance_check(events)
+    spans = rebuild_spans(events)
+    names = {s["name"] for s in spans}
+    assert FIVE_STAGES <= names, f"missing: {FIVE_STAGES - names}"
+    # per-trial attribution on the accel-search spans
+    accel = [s for s in spans if s["name"] == "Accel-Search"]
+    assert len(accel) >= 10  # one per (DM trial, accel chunk)
+    dms = {s["args"]["dm_trial"] for s in accel}
+    assert len(dms) > 1
+    for s in accel[:5]:
+        assert "dm" in s["args"] and "n_trials" in s["args"]
+    # spans nest: Accel-Search sits under DM-Loop
+    assert accel[0]["parent"] is not None
+    assert accel[0]["parent"]["name"] == "DM-Loop"
+    assert critical_path(spans), "critical path must be derivable"
+    # the run report carries the span table and a real dedispersion time
+    report = json.load(open(os.path.join(outdir, "run_report.json")))
+    assert "spans" in report
+    assert "DM-Loop" in report["spans"]
+    assert report["timers"]["dedispersion"] > 0.0
+    assert report["stage_timers"]["accel_search"]["count"] == len(accel)
