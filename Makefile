@@ -5,7 +5,7 @@ PY ?= python
 TUTORIAL ?= /root/reference/example_data/tutorial.fil
 SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 
-.PHONY: lint test bench trace-smoke
+.PHONY: lint test bench perf-gate trace-smoke
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.analysis
@@ -15,6 +15,13 @@ test:
 
 bench:
 	$(PY) bench.py
+
+# noise-aware perf regression gate over benchmarks/history.jsonl (+ the
+# legacy BENCH_r0*.json artifacts): fails when the newest record's gate
+# metric exceeds the trailing-window median by the threshold factor.
+# `python bench.py --gate` is the run-then-gate spelling for hardware CI.
+perf-gate:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.perf_report --gate
 
 # span-tracing smoke test: a tutorial run must write a parseable
 # Chrome trace whose span names cover the five pipeline stages

@@ -21,6 +21,14 @@ clocked by a dedicated dispatch outside the timed loop).
 the benchmark's spans (obs/trace.py), including a parity-checked pass
 on the chunked driver for its per-chunk ``Chunked-Search-<i>`` spans;
 ``--lint`` runs the peasoup-lint gate instead of the benchmark.
+
+Every successful run appends one structured record (git sha, device,
+timers, per-stage device time, roofline utilization, compile counts,
+parity verdict) to ``benchmarks/history.jsonl`` through the shared
+``obs/history.py`` writer; ``--gate`` then runs the noise-aware
+regression gate (``python -m peasoup_tpu.tools.perf_report --gate``)
+over the ledger and exits with its status.  ``--no-history`` skips
+the append (scratch experiments must not pollute the trend).
 """
 
 from __future__ import annotations
@@ -245,6 +253,37 @@ def main() -> None:
                 "ok" if not chunk_fails else "; ".join(chunk_fails)),
         }
 
+    # perf accounting (obs/costmodel.py): join the run's closed-form
+    # stage costs with the measured device time into per-stage
+    # utilization — the bench's new roofline columns
+    perf_cols = None
+    utilization = {}
+    try:
+        from peasoup_tpu.obs.costmodel import (
+            get_run_costs,
+            perf_section,
+            utilization_summary,
+        )
+        from peasoup_tpu.obs.report import device_summary
+
+        run_costs = get_run_costs()
+        if run_costs is not None:
+            snap_now = REGISTRY.snapshot()
+            perf = perf_section(
+                run_costs, snap_now["timers"], device_summary(),
+                snap_now["gauges"])
+            utilization = utilization_summary(perf)
+            perf_cols = {
+                name: {
+                    "gflops": round(row["flops"] / 1e9, 2),
+                    **({"utilization": row["utilization"]}
+                       if "utilization" in row else {}),
+                }
+                for name, row in perf["stages"].items()
+            }
+    except Exception as exc:  # perf accounting must never fail a bench
+        perf_cols = {"error": repr(exc)}
+
     out = {
         "metric": "tutorial_fil_e2e_wallclock",
         "value": round(elapsed, 4),
@@ -256,9 +295,38 @@ def main() -> None:
         "telemetry": telemetry,
         "parity": f"all {len(golden)} golden candidates matched",
     }
+    if perf_cols is not None:
+        out["perf"] = perf_cols
     if trace_info is not None:
         out["trace"] = trace_info
     print(json.dumps(out))
+
+    if "--no-history" not in sys.argv[1:]:
+        from peasoup_tpu.obs.history import (
+            append_history,
+            make_history_record,
+            stage_device_seconds,
+        )
+
+        append_history(make_history_record(
+            "bench",
+            metrics={"e2e_s": round(elapsed, 4),
+                     "median_s": round(median_s, 4),
+                     "vs_baseline": out["vs_baseline"]},
+            timers={k: v for k, v in timers.items()
+                    if isinstance(v, (int, float))},
+            stage_device_s=stage_device_seconds(REGISTRY.snapshot()),
+            utilization=utilization,
+            compile_counts={
+                "timed": telemetry["backend_compiles"],
+                "warmup": warmup_compiles,
+            },
+            parity=out["parity"],
+        ))
+    if "--gate" in sys.argv[1:]:
+        from peasoup_tpu.tools.perf_report import main as gate_main
+
+        sys.exit(gate_main(["--gate"]))
 
 
 if __name__ == "__main__":

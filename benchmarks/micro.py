@@ -30,12 +30,18 @@ import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-# v5e headline numbers for the utilisation column
-V5E_HBM_GBPS = 819.0
-
 
 def _gbps(nbytes, secs):
     return nbytes / secs / 1e9
+
+
+def _peak_gbps() -> float:
+    """Peak HBM bandwidth of the attached device for the utilisation
+    column — from the cost model's peak table (PSL007: the single
+    source of truth), not a hand-written headline number."""
+    from peasoup_tpu.obs.costmodel import device_peak
+
+    return device_peak()["bytes_per_s"] / 1e9
 
 
 def bench_fft(iters):
@@ -70,10 +76,13 @@ def bench_hsum(iters):
 
     from peasoup_tpu.ops import harmonic_sums
 
+    from peasoup_tpu.obs.costmodel import harmonics_cost
+
     n = 10_000_000
     spec = jax.device_put(
         np.random.default_rng(0).normal(size=n).astype(np.float32)
     )
+    peak_gbps = _peak_gbps()
     out = []
     for nh in (4, 5):
         def step(s, nh=nh):
@@ -81,12 +90,12 @@ def bench_hsum(iters):
             return s + 1e-12 * sum(h)
         t = time_op(step, spec, iters=iters)
         # nh levels read the spectrum at stretched indices + write sums
-        traffic = (2 * nh + 1) * n * 4
+        traffic = harmonics_cost(n, nh).bytes_total
         out.append({"metric": f"harmonic_sum_1e7_{nh}levels",
                     "value": round(t * 1e3, 3), "unit": "ms",
                     "GBps": round(_gbps(traffic, t), 1),
                     "hbm_util_pct": round(
-                        100 * _gbps(traffic, t) / V5E_HBM_GBPS, 1)})
+                        100 * _gbps(traffic, t) / peak_gbps, 1)})
     return out
 
 
@@ -118,12 +127,12 @@ def bench_resample(iters):
     t_gather = time_op(
         lambda v: resample2(v, accel, tsamp, max_shift=None), tim,
         iters=max(4, iters // 4))
-    traffic = 2 * n * 4
+    traffic = 2 * n * 4  # one read + one write pass over the series
     return [
         {"metric": "resample2_tables_2e23_accel500",
          "value": round(t_tab * 1e3, 3), "unit": "ms",
          "GBps": round(_gbps(traffic, t_tab), 1),
-         "hbm_util_pct": round(100 * _gbps(traffic, t_tab) / V5E_HBM_GBPS,
+         "hbm_util_pct": round(100 * _gbps(traffic, t_tab) / _peak_gbps(),
                                1)},
         {"metric": "resample2_gather_2e23_accel500",
          "value": round(t_gather * 1e3, 3), "unit": "ms"},
@@ -173,6 +182,16 @@ def main(argv=None):
                             "micro_results.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=1)
+    # same-schema ledger record as bench.py/production.py (the ad-hoc
+    # per-bench JSON above keeps its stdout/file shape unchanged)
+    from peasoup_tpu.obs.history import append_history, make_history_record
+
+    append_history(make_history_record(
+        "micro",
+        metrics={r["metric"]: r["value"] for r in results
+                 if isinstance(r.get("value"), (int, float))},
+        config={"which": which, "iters": iters},
+    ))
     return 0
 
 

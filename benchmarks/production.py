@@ -278,6 +278,28 @@ def main(argv=None):
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(f"wrote {path}")
+    # same-schema ledger record as bench.py/micro.py (the ad-hoc
+    # production_bench*.json above keeps its shape unchanged)
+    from peasoup_tpu.obs.history import append_history, make_history_record
+    from peasoup_tpu.obs.metrics import REGISTRY
+
+    metrics = {"search_total_s": round(t_search, 2),
+               "generate_s": round(t_gen, 2)}
+    if hit is not None:
+        metrics["recovered_snr"] = round(float(hit.snr), 2)
+        metrics["recovered_folded_snr"] = round(
+            float(hit.folded_snr or 0.0), 2)
+    from peasoup_tpu.obs.history import stage_device_seconds
+
+    append_history(make_history_record(
+        "production" + ("_quick" if quick else ""),
+        metrics=metrics,
+        timers={k: round(v, 3) for k, v in result.timers.items()},
+        stage_device_s=stage_device_seconds(REGISTRY.snapshot()),
+        parity="recovered" if hit is not None else "NOT RECOVERED",
+        config=out["config"],
+        extra={"resumed": resumed_rows > 0, "tuned": tuned},
+    ))
     return 0
 
 

@@ -155,7 +155,15 @@ def iter_source_files(paths: list[str] | None = None,
     report rather than crash."""
     root = root or repo_root()
     if not paths:
+        # the package plus the repo-root bench entry points: PSL007
+        # (cost-model authority) polices FLOP/byte constants in bench
+        # code too, and rules path-filter themselves so the package
+        # -only rules simply skip these files
         paths = [package_root()]
+        for extra in ("bench.py", "benchmarks"):
+            p = os.path.join(repo_root(), extra)
+            if os.path.exists(p):
+                paths.append(p)
     seen: set[str] = set()
     for p in paths:
         p = os.path.abspath(p)

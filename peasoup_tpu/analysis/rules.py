@@ -12,6 +12,9 @@ PSL005   raw ``ValueError``/``RuntimeError`` raise in ``search/`` or
 PSL006   raw ``METRICS.timer(...)`` / ``trace_range(...)`` call
          outside ``obs/`` (stage timing must go through the
          ``obs.trace.span`` API so every stage is span-traced)
+PSL007   hand-written FLOP/byte/bandwidth constant outside
+         ``obs/costmodel.py`` (the analytical cost model is the
+         single source of truth for perf accounting figures)
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -576,6 +579,83 @@ class SpanApiRule(Rule):
                 )
 
 
+# --------------------------------------------------------------------------
+# PSL007 — hand-written FLOP/byte constants outside obs/costmodel.py
+# --------------------------------------------------------------------------
+
+import re as _re
+
+#: CONSTANT_CASE names that smell like perf-accounting figures: peak
+#: flops, bandwidths, per-element byte/flop coefficients.  Matched
+#: against whole underscore-separated tokens so e.g. MAX_SPANS or
+#: N_BYTES_READ_IDX (an index, not a coefficient) stay clean.
+_PERF_CONST_TOKENS = _re.compile(
+    r"(?:^|_)(FLOPS?|[GT]FLOPS?|GBPS|GIBPS|BANDWIDTH|BYTES_PER|PEAK_BW)"
+    r"(?:_|$)"
+)
+
+
+def _numeric_literal(node: ast.AST) -> bool:
+    """True for a numeric constant or simple arithmetic of numeric
+    constants (``819.0``, ``1 << 30``, ``96 + 32``, ``8.3e9``)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float))
+    if isinstance(node, ast.BinOp):
+        return _numeric_literal(node.left) and _numeric_literal(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _numeric_literal(node.operand)
+    return False
+
+
+class CostModelAuthorityRule(Rule):
+    """Perf-accounting figures — peak FLOP/s, HBM bandwidths,
+    per-element byte/flop coefficients — live in ``obs/costmodel.py``
+    (its peak table and unit-cost functions) and NOWHERE else: a
+    hand-written ``V5E_HBM_GBPS = 819.0`` in a benchmark silently
+    diverges the moment the table is corrected, and two disagreeing
+    "peaks" make every utilization number untrustworthy.  Deliberate
+    exceptions (e.g. a constant describing a non-device quantity that
+    happens to match the name pattern) carry a
+    ``# psl: disable=PSL007 -- reason`` pragma."""
+
+    id = "PSL007"
+    title = "hand-written FLOP/byte constant outside obs/costmodel.py"
+
+    def applies(self, relpath: str) -> bool:
+        if relpath == "peasoup_tpu/obs/costmodel.py":
+            return False
+        return relpath.endswith(".py") and (
+            relpath.startswith("peasoup_tpu/")
+            or relpath == "bench.py"
+            or relpath.startswith("benchmarks/")
+        )
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None or not _numeric_literal(value):
+                continue
+            for target in targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                name = target.id
+                if name != name.upper():
+                    continue  # CONSTANT_CASE only: locals stay free
+                if _PERF_CONST_TOKENS.search(name):
+                    yield sf.violation(
+                        self.id, node,
+                        f"hand-written perf constant `{name}` — import "
+                        f"it from peasoup_tpu.obs.costmodel (peak "
+                        f"table / unit-cost functions) so the cost "
+                        f"model stays the single source of truth",
+                    )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
     NoHostSyncInJitRule(),
@@ -583,6 +663,7 @@ ALL_RULES: tuple[Rule, ...] = (
     NoTracedBranchRule(),
     TypedErrorsRule(),
     SpanApiRule(),
+    CostModelAuthorityRule(),
 )
 
 

@@ -20,7 +20,14 @@ counted, logged and reported per run, so this package provides:
 * :mod:`.trace` — hierarchical span tracing with per-chunk/per-trial
   attribution, HBM watermarks, Chrome trace-event (Perfetto) export
   and multihost merge; :func:`~peasoup_tpu.obs.trace.span` is the ONE
-  API pipeline stages time themselves with (lint rule PSL006).
+  API pipeline stages time themselves with (lint rule PSL006);
+* :mod:`.costmodel` — the analytical per-stage FLOP/byte cost model
+  and roofline utilization join (the SINGLE source of truth for
+  FLOP/byte constants, lint rule PSL007), feeding the report's
+  ``perf`` section;
+* :mod:`.history` — the bench history ledger
+  (``benchmarks/history.jsonl``) every benchmark entry point appends
+  to, read by ``python -m peasoup_tpu.tools.perf_report``.
 """
 
 from .metrics import REGISTRY, MetricsRegistry, install_compile_hook
@@ -33,10 +40,22 @@ from .trace import (
     span_table,
     write_merged_trace,
 )
+from .costmodel import (
+    PipelineGeometry,
+    StageCost,
+    device_peak,
+    perf_section,
+    pipeline_costs,
+    record_run_costs,
+)
+from .history import append_history, load_history, make_history_record
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "install_compile_hook",
     "EventLog", "configure_event_log", "get_event_log", "warn_event",
     "build_run_report", "format_stage_table", "write_run_report",
     "Tracer", "get_tracer", "span", "span_table", "write_merged_trace",
+    "PipelineGeometry", "StageCost", "device_peak", "perf_section",
+    "pipeline_costs", "record_run_costs",
+    "append_history", "load_history", "make_history_record",
 ]

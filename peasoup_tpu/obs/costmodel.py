@@ -1,0 +1,611 @@
+"""Analytical per-stage cost model + roofline utilization.
+
+The pipeline's geometry is fully determined by the ``SearchPlan``
+(nsamps, nchans, DM count, accel trials per DM, fft size, harmonic
+stages, fold bins/top-N), so every stage's FLOPs and bytes are
+computable in closed form — no profiling required.  This module is the
+SINGLE source of truth for those figures (lint rule PSL007 rejects
+hand-written FLOP/byte constants anywhere else): the span tree and the
+metrics registry join cost x measured device time into achieved
+FLOP/s, achieved B/s, arithmetic intensity and a roofline-style
+``utilization`` fraction against a per-device peak table, emitted as
+the ``perf`` section of ``run_report.json`` (see
+:func:`perf_section`), surfaced by the CLI ``--verbose`` table and by
+``bench.py``'s output/ledger columns.
+
+Methodology (Williams, Waterman & Patterson, "Roofline: an insightful
+visual performance model for multicore architectures", CACM 2009): for
+a stage with F flops and B bytes of HBM traffic on a device with peak
+compute P_f and peak stream bandwidth P_b,
+
+    attainable FLOP/s = min(P_f, (F/B) * P_b)
+    utilization       = (F / device_seconds) / attainable   (clamped to 1)
+
+The closed forms below are *model* costs with documented coefficients
+(e.g. a real FFT is counted as ``2.5 n log2 n`` flops); they are
+cross-checked against XLA's own ``cost_analysis()`` to a documented
+factor (:func:`crosscheck_registered_programs`,
+``tests/test_perf.py``), so a formula drifting away from the traced
+program fails a tier-1 test rather than silently mis-reporting.
+
+Five stages are modelled — the same five programs the jaxpr lint
+checker traces (``analysis/jaxpr_check.py:registered_programs``):
+
+=============  ===========================================================
+dedisperse     direct delay-sweep over (ndm, nchans, out_nsamps)
+spectrum       the per-DM whiten chain (rfft, running median, deredden,
+               interbin, stats, irfft) PLUS the per-accel-trial spectrum
+               formation (resample, rfft, interbin, normalise) — the
+               same ``form_interpolated`` code path both phases share
+harmonics      stretched-and-summed spectra, levels 1..nharms
+peaks          thresholded top-k extraction per (trial, harmonic level)
+fold           re-whiten + resample + one-hot fold + PDMP optimise per
+               folded candidate (npdmp upper bound)
+=============  ===========================================================
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+
+# --------------------------------------------------------------------------
+# per-device peak table
+# --------------------------------------------------------------------------
+
+#: Per-chip peaks: dense f32 FLOP/s and HBM stream bandwidth (B/s).
+#: TPU figures are the published per-chip numbers with the f32 peak
+#: taken as half the bf16 MXU peak (this pipeline is f32 end to end);
+#: the CPU fallback is a deliberately generous modern-server figure so
+#: CPU utilization reads as a small fraction, never a fake 100 %.
+PEAK_TABLE: dict[str, dict] = {
+    "TPU v2":      {"flops_per_s": 23.0e12,  "bytes_per_s": 700.0e9},
+    "TPU v3":      {"flops_per_s": 61.5e12,  "bytes_per_s": 900.0e9},
+    "TPU v4":      {"flops_per_s": 137.5e12, "bytes_per_s": 1228.0e9},
+    "TPU v5 lite": {"flops_per_s": 98.5e12,  "bytes_per_s": 819.0e9},
+    "TPU v5p":     {"flops_per_s": 229.5e12, "bytes_per_s": 2765.0e9},
+    "TPU v6 lite": {"flops_per_s": 459.0e12, "bytes_per_s": 1640.0e9},
+    "cpu":         {"flops_per_s": 1.0e12,   "bytes_per_s": 100.0e9},
+}
+
+_DEFAULT_PEAK_KIND = "cpu"
+
+
+def device_peak(kind: str | None = None, n_devices: int = 1) -> dict:
+    """Peak figures for ``kind`` (a jax ``device_kind`` string; matched
+    case-insensitively by table-key substring), scaled by the number of
+    participating devices.  Unknown kinds fall back to the CPU entry
+    with ``matched=False`` so consumers can flag the guess."""
+    if kind is None:
+        try:
+            import jax
+
+            kind = str(jax.devices()[0].device_kind)
+        except Exception:
+            kind = _DEFAULT_PEAK_KIND
+    norm = str(kind).lower()
+    entry, matched = None, False
+    for key, val in PEAK_TABLE.items():
+        if key.lower() in norm or norm in key.lower():
+            entry, matched = val, True
+            break
+    if entry is None:
+        entry = PEAK_TABLE[_DEFAULT_PEAK_KIND]
+    n = max(int(n_devices), 1)
+    return {
+        "kind": str(kind),
+        "matched": matched,
+        "n_devices": n,
+        "flops_per_s": entry["flops_per_s"] * n,
+        "bytes_per_s": entry["bytes_per_s"] * n,
+    }
+
+
+# --------------------------------------------------------------------------
+# stage cost primitive
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class StageCost:
+    """Closed-form work estimate for one stage (or one program call)."""
+
+    flops: float
+    bytes_read: float
+    bytes_written: float
+
+    @property
+    def bytes_total(self) -> float:
+        return self.bytes_read + self.bytes_written
+
+    @property
+    def intensity(self) -> float:
+        """Arithmetic intensity (flops per byte of traffic)."""
+        return self.flops / max(self.bytes_total, 1.0)
+
+    def dominant(self, peak: dict) -> str:
+        """Which roof binds on ``peak``: ``"compute"`` or ``"memory"``."""
+        t_f = self.flops / peak["flops_per_s"]
+        t_b = self.bytes_total / peak["bytes_per_s"]
+        return "compute" if t_f >= t_b else "memory"
+
+    def scaled(self, k: float) -> "StageCost":
+        return StageCost(self.flops * k, self.bytes_read * k,
+                         self.bytes_written * k)
+
+    def __add__(self, other: "StageCost") -> "StageCost":
+        return StageCost(self.flops + other.flops,
+                         self.bytes_read + other.bytes_read,
+                         self.bytes_written + other.bytes_written)
+
+
+ZERO_COST = StageCost(0.0, 0.0, 0.0)
+
+#: model coefficient: one real FFT of length n costs 2.5 n log2 n flops
+#: (the canonical 5 n log2 n for a complex transform, halved for r2c/c2r)
+_FFT_REAL_COEFF = 2.5
+
+#: f32 element size; the pipeline is f32 (c64 = two f32 lanes) end to end
+_F32 = 4
+
+
+def fft_real_flops(n: int) -> float:
+    """Model flops of ONE real transform (rfft or irfft) of length n."""
+    return _FFT_REAL_COEFF * n * math.log2(max(n, 2))
+
+
+# -- per-call unit costs ----------------------------------------------------
+
+def dedisperse_cost(n_dm: int, nchans: int, out_nsamps: int,
+                    in_itemsize: int = 4) -> StageCost:
+    """Direct delay sweep: one add per (DM row, channel, output sample).
+    Each row re-reads the band at shifted offsets; the input traffic is
+    counted at the stored sample width (u8 for packed filterbanks)."""
+    elems = float(n_dm) * nchans * out_nsamps
+    return StageCost(
+        flops=elems,
+        bytes_read=elems * in_itemsize,
+        bytes_written=float(n_dm) * out_nsamps * _F32,
+    )
+
+
+def whiten_cost(n: int) -> StageCost:
+    """One whiten_core call (rfft, power, scrunch-median cascade,
+    deredden, interbin, stats, irfft) on an n-sample series.  The
+    elementwise chain is ~30 flops per spectral bin (power 5, median
+    cascade ~8, complex divide 8, interbin 9)."""
+    nb = n // 2 + 1
+    return StageCost(
+        flops=2 * fft_real_flops(n) + 30.0 * nb,
+        # tim in + fseries/pspec/median passes (c64 + 3 f32 vectors)
+        bytes_read=n * _F32 + nb * (8 + 3 * _F32),
+        bytes_written=n * _F32 + nb * (8 + 3 * _F32),
+    )
+
+
+def accel_spectrum_cost(n: int) -> StageCost:
+    """One acceleration trial's spectrum formation: shift-select
+    resample (1 flop/sample), rfft, interbin (~9 flops/bin), normalise
+    (2 flops/bin)."""
+    nb = n // 2 + 1
+    return StageCost(
+        flops=n + fft_real_flops(n) + 11.0 * nb,
+        bytes_read=2 * n * _F32 + nb * 8,
+        bytes_written=n * _F32 + nb * (8 + _F32),
+    )
+
+
+def harmonics_cost(nbins: int, nharms: int) -> StageCost:
+    """One harmonic_sums call: level k adds 2^(k-1) stretched terms to
+    the previous level, so total adds are (2^nharms - 1) per bin; the
+    traffic is the micro-benchmark's (2*nh+1) passes — nh+1 reads
+    (previous level + stretched source) and nh writes."""
+    return StageCost(
+        flops=float((1 << nharms) - 1) * nbins,
+        bytes_read=float(nharms + 1) * nbins * _F32,
+        bytes_written=float(nharms) * nbins * _F32,
+    )
+
+
+def peaks_cost(nbins: int, capacity: int) -> StageCost:
+    """One extract_top_peaks call over one spectrum level: a threshold
+    compare per bin plus ~log2(capacity) compares per bin for the
+    top-k selection network."""
+    cap = max(int(capacity), 2)
+    return StageCost(
+        flops=nbins * (1.0 + math.log2(cap)),
+        bytes_read=float(nbins) * _F32,
+        bytes_written=float(cap) * 2 * _F32,  # idx + snr slots
+    )
+
+
+def fold_program_cost(n: int, nbins: int = 64, nints: int = 16) -> StageCost:
+    """One fold_time_series_core + optimise_device call (the registered
+    ``fold`` program): ~2 flops/sample for the one-hot fold matmul,
+    then the PDMP matched-filter search (`ops/fold.py:110-151`) — FFT
+    the subints along phase, apply ``nshifts = nbins`` per-subint phase
+    rotations, multiply by ``nbins - 1`` boxcar template transforms and
+    inverse-transform every (template, shift) combination."""
+    nshifts = nbins
+    ntempl = max(nbins - 1, 1)
+    comb = float(ntempl) * nshifts
+    opt = (float(nshifts) * nints * nbins * 8.0   # phase rotations (c64)
+           + comb * nbins * 8.0                   # template multiply-add
+           + comb * 2.0 * fft_real_flops(nbins))  # per-combination ifft
+    return StageCost(
+        flops=2.0 * n + opt,
+        bytes_read=n * _F32 + comb * nbins * 8,
+        bytes_written=float(nints) * nbins * _F32 + comb * nbins * 8,
+    )
+
+
+def fold_candidate_cost(n: int, nbins: int = 64,
+                        nints: int = 16) -> StageCost:
+    """One folded candidate end to end: re-whiten (2 real FFTs + the
+    median chain), resample, fold + optimise."""
+    return whiten_cost(n) + StageCost(n, n * _F32, n * _F32) \
+        + fold_program_cost(n, nbins, nints)
+
+
+# --------------------------------------------------------------------------
+# pipeline geometry -> per-stage totals
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PipelineGeometry:
+    """Everything the cost model needs, all derivable from the plan."""
+
+    n_dm: int
+    nchans: int
+    out_nsamps: int
+    in_itemsize: int
+    size: int             # fft length
+    nharmonics: int
+    peak_capacity: int
+    n_trials_total: int   # sum over DMs of that DM's accel-trial count
+    npdmp: int
+    fold_nsamps: int
+    fold_nbins: int
+    fold_nints: int
+
+    @classmethod
+    def from_search(cls, search, acc_lists=None) -> "PipelineGeometry":
+        """Build from a ``PulsarSearch``-like driver.  ``acc_lists``
+        (per-DM accel arrays) skips regenerating the trial grid when
+        the caller already holds it."""
+        from ..search.plan import (
+            FOLD_NBINS,
+            FOLD_NINTS,
+            prev_power_of_two,
+            trial_grid_geometry,
+        )
+
+        cfg = search.config
+        if acc_lists is not None:
+            n_trials = int(sum(len(a) for a in acc_lists))
+        else:
+            n_trials = trial_grid_geometry(
+                search.dm_list, search.acc_plan).n_trials_total
+        return cls(
+            n_dm=int(len(search.dm_list)),
+            nchans=int(search.fil.nchans),
+            out_nsamps=int(search.out_nsamps),
+            in_itemsize=1 if search.fil.header.nbits <= 8 else 4,
+            size=int(search.size),
+            nharmonics=int(cfg.nharmonics),
+            peak_capacity=int(cfg.peak_capacity),
+            n_trials_total=n_trials,
+            npdmp=int(cfg.npdmp),
+            fold_nsamps=prev_power_of_two(int(search.out_nsamps)),
+            fold_nbins=FOLD_NBINS,
+            fold_nints=FOLD_NINTS,
+        )
+
+    def to_json(self) -> dict:
+        return {k: int(getattr(self, k)) for k in (
+            "n_dm", "nchans", "out_nsamps", "in_itemsize", "size",
+            "nharmonics", "peak_capacity", "n_trials_total", "npdmp",
+            "fold_nsamps", "fold_nbins", "fold_nints")}
+
+
+#: stage order = pipeline order = the jaxpr checker's program registry
+STAGES = ("dedisperse", "spectrum", "harmonics", "peaks", "fold")
+
+
+def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
+    """Per-stage totals for one full search at ``geom``."""
+    nb = geom.size // 2 + 1
+    nlevels = geom.nharmonics + 1
+    spectrum = (whiten_cost(geom.size).scaled(geom.n_dm)
+                + accel_spectrum_cost(geom.size).scaled(
+                    geom.n_trials_total))
+    peaks = peaks_cost(nb, geom.peak_capacity).scaled(
+        nlevels * geom.n_trials_total)
+    return {
+        "dedisperse": dedisperse_cost(
+            geom.n_dm, geom.nchans, geom.out_nsamps, geom.in_itemsize),
+        "spectrum": spectrum,
+        "harmonics": harmonics_cost(nb, geom.nharmonics).scaled(
+            geom.n_trials_total),
+        "peaks": peaks,
+        "fold": fold_candidate_cost(
+            geom.fold_nsamps, geom.fold_nbins, geom.fold_nints
+        ).scaled(geom.npdmp),
+    }
+
+
+# --------------------------------------------------------------------------
+# per-run cost holder (the drivers record, the report reads)
+# --------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_RUN_COSTS: dict | None = None
+
+
+def record_run_costs(search, acc_lists=None) -> dict:
+    """Compute and stash this run's stage costs (called once per run by
+    each driver).  Also caches per-unit scalars on the search object so
+    span call sites can attach ``gflops`` attributes cheaply.  Returns
+    ``{"geometry": PipelineGeometry, "stages": {name: StageCost}}``."""
+    global _RUN_COSTS
+    geom = PipelineGeometry.from_search(search, acc_lists)
+    stages = pipeline_costs(geom)
+    costs = {"geometry": geom, "stages": stages}
+    # per-accel-trial search work (spectrum formation + harmonic sums +
+    # peak extraction) and per-DM-row work (whiten + dedisp row), in
+    # Gflops — the scalars Accel-Search / Chunked-Search spans attach
+    nb = geom.size // 2 + 1
+    per_trial = (accel_spectrum_cost(geom.size)
+                 + harmonics_cost(nb, geom.nharmonics)
+                 + peaks_cost(nb, geom.peak_capacity).scaled(
+                     geom.nharmonics + 1))
+    per_row = (whiten_cost(geom.size)
+               + dedisperse_cost(1, geom.nchans, geom.out_nsamps,
+                                 geom.in_itemsize))
+    search._stage_costs = costs
+    search._per_trial_gflops = per_trial.flops / 1e9
+    search._per_dmrow_gflops = per_row.flops / 1e9
+    with _lock:
+        _RUN_COSTS = costs
+    return costs
+
+
+def get_run_costs() -> dict | None:
+    with _lock:
+        return _RUN_COSTS
+
+
+def reset_run_costs() -> None:
+    global _RUN_COSTS
+    with _lock:
+        _RUN_COSTS = None
+
+
+# --------------------------------------------------------------------------
+# cost x measured time -> the run report's perf section
+# --------------------------------------------------------------------------
+
+#: registry stage-timer names whose device seconds make up the search
+#: pool (the fused/chunked programs have no internal stage boundaries)
+_SEARCH_POOL_TIMERS = ("accel_search", "fused_search", "chunked_search")
+
+#: stages with their own dedicated stage timer
+_MEASURED_TIMERS = {"dedisperse": "dedispersion", "fold": "folding"}
+
+#: stages that share the pooled search time when not separately
+#: measured, apportioned by modelled roofline time
+_POOLED_STAGES = ("spectrum", "harmonics", "peaks")
+
+
+def _timer_seconds(timers: dict, name: str) -> tuple[float, str] | None:
+    """(seconds, basis) for one stage timer: measured device seconds
+    preferred, host wall-clock as the documented upper-bound fallback.
+    None when the timer is absent or zero."""
+    rec = timers.get(name)
+    if not rec:
+        return None
+    dev = float(rec.get("device_s", 0.0))
+    if dev > 0.0:
+        return dev, "device"
+    host = float(rec.get("host_s", 0.0))
+    if host > 0.0:
+        return host, "host"
+    return None
+
+
+def _roofline_time(cost: StageCost, peak: dict) -> float:
+    """Modelled stage seconds on ``peak``: max of the compute and
+    memory roofs (the roofline lower bound)."""
+    return max(cost.flops / peak["flops_per_s"],
+               cost.bytes_total / peak["bytes_per_s"])
+
+
+def perf_section(run_costs: dict, timers: dict, device: dict,
+                 gauges: dict | None = None) -> dict:
+    """Join stage costs with measured stage timers into the
+    ``run_report.json`` ``perf`` section.
+
+    Stages with a dedicated timer (``dedispersion``, ``folding``) use
+    it directly (``attribution: "measured"``); the stages fused into
+    one search dispatch share the pooled search device time in
+    proportion to their modelled roofline seconds (``attribution:
+    "modeled-share"`` — by construction they then report the pool's
+    common utilization).  A stage with no seconds available keeps its
+    cost figures and simply omits the achieved/utilization keys — a
+    consumer never sees nulls.
+    """
+    geom: PipelineGeometry = run_costs["geometry"]
+    stages: dict[str, StageCost] = run_costs["stages"]
+    gauges = gauges or {}
+    kind = None
+    for d in device.get("devices", []):
+        kind = d.get("kind")
+        break
+    n_devices = int(gauges.get("search.n_devices", 1) or 1)
+    peak = device_peak(kind, n_devices)
+
+    # measured stages
+    seconds: dict[str, tuple[float, str, str]] = {}
+    pooled = list(_POOLED_STAGES)
+    for stage, timer in _MEASURED_TIMERS.items():
+        got = _timer_seconds(timers, timer)
+        if got is not None:
+            seconds[stage] = (got[0], got[1], "measured")
+        elif stage == "dedisperse":
+            pooled.insert(0, stage)  # fused into the search dispatch
+    # pooled stages share the search timers
+    pool_s, pool_basis = 0.0, "device"
+    for name in _SEARCH_POOL_TIMERS:
+        got = _timer_seconds(timers, name)
+        if got is not None:
+            pool_s += got[0]
+            if got[1] == "host":
+                pool_basis = "host"
+    if pool_s > 0.0:
+        t_model = {s: _roofline_time(stages[s], peak) for s in pooled}
+        total = sum(t_model.values())
+        if total > 0.0:
+            for s in pooled:
+                seconds[s] = (pool_s * t_model[s] / total, pool_basis,
+                              "modeled-share")
+
+    out_stages: dict[str, dict] = {}
+    for name in STAGES:
+        cost = stages[name]
+        row: dict = {
+            "flops": round(cost.flops),
+            "bytes_read": round(cost.bytes_read),
+            "bytes_written": round(cost.bytes_written),
+            "dominant": cost.dominant(peak),
+            "intensity_flops_per_byte": round(cost.intensity, 4),
+        }
+        got = seconds.get(name)
+        if got is not None and got[0] > 0.0 and cost.flops > 0.0:
+            secs, basis, attribution = got
+            achieved_f = cost.flops / secs
+            achieved_b = cost.bytes_total / secs
+            attainable = min(peak["flops_per_s"],
+                             cost.intensity * peak["bytes_per_s"])
+            row.update(
+                device_s=round(secs, 6),
+                basis=basis,
+                attribution=attribution,
+                achieved_flops_per_s=round(achieved_f, 1),
+                achieved_bytes_per_s=round(achieved_b, 1),
+                # clamped: >1 would mean the peak-table entry
+                # underestimates this device, not faster-than-roofline
+                utilization=round(min(1.0, achieved_f / attainable), 6),
+            )
+        out_stages[name] = row
+    total = StageCost(
+        sum(c.flops for c in stages.values()),
+        sum(c.bytes_read for c in stages.values()),
+        sum(c.bytes_written for c in stages.values()),
+    )
+    return {
+        "peak": {
+            "kind": peak["kind"],
+            "matched": peak["matched"],
+            "n_devices": peak["n_devices"],
+            "flops_per_s": peak["flops_per_s"],
+            "bytes_per_s": peak["bytes_per_s"],
+        },
+        "geometry": geom.to_json(),
+        "stages": out_stages,
+        "total": {
+            "flops": round(total.flops),
+            "bytes": round(total.bytes_total),
+            "intensity_flops_per_byte": round(total.intensity, 4),
+        },
+    }
+
+
+def utilization_summary(perf: dict) -> dict[str, float]:
+    """{stage: utilization} for the stages that have one (bench.py's
+    ledger column)."""
+    out = {}
+    for name, row in (perf or {}).get("stages", {}).items():
+        if "utilization" in row:
+            out[name] = row["utilization"]
+    return out
+
+
+# --------------------------------------------------------------------------
+# XLA cross-check
+# --------------------------------------------------------------------------
+
+#: documented agreement factor between the closed forms and XLA's own
+#: cost_analysis(): the model counts algorithmic flops (an FFT is
+#: 2.5 n log2 n) while XLA counts lowered HLO ops, so exact agreement
+#: is impossible — but a formula drifting beyond this factor from the
+#: traced program indicates the model no longer describes the code
+CROSSCHECK_FACTOR = 32.0
+
+
+def xla_cost_analysis(fn, args) -> dict | None:
+    """``jax.jit(fn).lower(*args).compile().cost_analysis()`` distilled
+    to ``{"flops", "bytes"}`` — or None when the backend/jax version
+    does not provide it."""
+    try:
+        import jax
+
+        compiled = jax.jit(fn).lower(*args).compile()
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    nbytes = float(ca.get("bytes accessed", 0.0) or 0.0)
+    return {"flops": flops, "bytes": nbytes}
+
+
+def _crosscheck_shapes() -> dict[str, StageCost]:
+    """Model costs at the SAME small shapes the jaxpr checker traces
+    (``analysis/jaxpr_check.py:registered_programs``) — keep the two
+    in sync; ``tests/test_perf.py`` asserts the name sets match."""
+    return {
+        # data (16 chans x 2048), delays (4 DMs, 16 chans), out 1024
+        "dedisperse": dedisperse_cost(4, 16, 1024, in_itemsize=4),
+        "spectrum": whiten_cost(2048),
+        "harmonics": harmonics_cost(1025, 4),
+        # capacity 32 over bins [1, 1000)
+        "peaks": peaks_cost(1025, 32),
+        "fold": fold_program_cost(16384, 64, 16),
+    }
+
+
+def crosscheck_registered_programs() -> list[dict]:
+    """Compare the closed-form model against XLA's cost_analysis for
+    each registered pipeline program at its lint-checker shape.
+
+    Returns one row per program: ``{program, model_flops, xla_flops,
+    ratio, ok}``.  ``xla_flops`` is None (and ``ok`` True) when the
+    backend provides no analysis or reports zero flops (FFTs lower to
+    custom calls XLA does not count) — the comparison is only
+    meaningful where XLA actually counted work.
+    """
+    from ..analysis.jaxpr_check import registered_programs
+
+    model = _crosscheck_shapes()
+    rows: list[dict] = []
+    for spec in registered_programs():
+        est = model[spec.name]
+        row = {"program": spec.name, "model_flops": est.flops,
+               "xla_flops": None, "ratio": None, "ok": True}
+        try:
+            fn, args = spec.build()
+            xla = xla_cost_analysis(fn, args)
+        except Exception:
+            xla = None
+        if xla is not None and xla["flops"] > 0.0:
+            ratio = est.flops / xla["flops"]
+            row.update(
+                xla_flops=xla["flops"], ratio=ratio,
+                ok=(1.0 / CROSSCHECK_FACTOR <= ratio
+                    <= CROSSCHECK_FACTOR),
+            )
+        rows.append(row)
+    return rows

@@ -1,0 +1,173 @@
+"""Bench history ledger: one JSONL record per benchmark run.
+
+The committed ``BENCH_r0*.json`` artifacts were write-only — nothing
+read or compared them, so a perf regression had to be spotted by a
+human diffing JSON.  Every benchmark entry point now appends one
+structured record to ``benchmarks/history.jsonl`` through the ONE
+writer here (``bench.py``, ``benchmarks/micro.py`` and
+``benchmarks/production.py`` all route through
+:func:`make_history_record` + :func:`append_history`, so the ledger
+has a single schema), and ``python -m peasoup_tpu.tools.perf_report``
+loads it for trend tables and the noise-aware regression gate.
+
+Record schema (``v`` = 1; consumers tolerate additions)::
+
+    v               int     record schema version
+    ts              str     ISO-8601 UTC timestamp
+    kind            str     "bench" | "micro" | "production" | ...
+    git             {sha, dirty}
+    device          {kind, backend, count}
+    mesh_shape      [int]   device mesh (absent for single-device)
+    metrics         {name: number}   headline figures (e2e_s, ...)
+    timers          {name: seconds}  driver wall-clock timers
+    stage_device_s  {stage: seconds} per-stage measured device time
+    utilization     {stage: fraction}  roofline utilization (costmodel)
+    compile_counts  {name: int}      jit compile statistics
+    parity          str     "ok" or the failure summary
+    config          {...}   benchmark configuration echo
+
+Ledger I/O never raises into a benchmark run: append/load failures
+warn and return best-effort results.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import time
+
+HISTORY_VERSION = 1
+
+#: ledger filename, relative to the repo's ``benchmarks/`` directory
+LEDGER_BASENAME = "history.jsonl"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_ledger_path() -> str:
+    return os.path.join(repo_root(), "benchmarks", LEDGER_BASENAME)
+
+
+def git_describe(cwd: str | None = None) -> dict:
+    """``{sha, dirty}`` of the working tree (best effort — a ledger
+    without provenance is still a ledger)."""
+    cwd = cwd or repo_root()
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, cwd=cwd, timeout=10,
+        ).stdout.strip() or "unknown"
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, cwd=cwd, timeout=10,
+        ).stdout.strip())
+    except Exception:
+        return {"sha": "unknown", "dirty": False}
+    return {"sha": sha, "dirty": dirty}
+
+
+def _device_fields() -> dict:
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "kind": str(devices[0].device_kind),
+            "backend": str(jax.default_backend()),
+            "count": len(devices),
+        }
+    except Exception:
+        return {"kind": "unknown", "backend": "unknown", "count": 0}
+
+
+def stage_device_seconds(snapshot: dict) -> dict:
+    """Per-stage measured device seconds out of a metrics-registry
+    snapshot (``obs.metrics.MetricsRegistry.snapshot``)."""
+    return {
+        name: round(rec.get("device_s", 0.0), 6)
+        for name, rec in snapshot.get("timers", {}).items()
+        if rec.get("device_s", 0.0) > 0.0
+    }
+
+
+def make_history_record(kind: str, metrics: dict, *, timers=None,
+                        stage_device_s=None, utilization=None,
+                        compile_counts=None, parity=None, config=None,
+                        mesh_shape=None, extra=None) -> dict:
+    """Assemble one ledger record; only the provided sections are
+    included (no nulls in the ledger)."""
+    rec: dict = {
+        "v": HISTORY_VERSION,
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "kind": str(kind),
+        "git": git_describe(),
+        "device": _device_fields(),
+        "metrics": {
+            k: v for k, v in (metrics or {}).items()
+            if isinstance(v, (int, float)) and v is not None
+        },
+    }
+    for key, val in (
+        ("timers", timers), ("stage_device_s", stage_device_s),
+        ("utilization", utilization), ("compile_counts", compile_counts),
+        ("parity", parity), ("config", config),
+        ("mesh_shape", mesh_shape),
+    ):
+        if val:
+            rec[key] = val
+    if extra:
+        rec.update(extra)
+    return rec
+
+
+def append_history(record: dict, path: str | None = None) -> str | None:
+    """Append one record to the ledger (creating it if absent).
+    Returns the path written, or None on failure (warned, not
+    raised — telemetry must never kill a benchmark run)."""
+    path = path or default_ledger_path()
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+    except OSError as exc:
+        import warnings
+
+        warnings.warn(f"could not append history record to "
+                      f"{path!r}: {exc}")
+        return None
+    return path
+
+
+def load_history(path: str | None = None,
+                 kinds=None) -> list[dict]:
+    """All ledger records in file order; corrupt lines are skipped (a
+    torn tail from a killed run must not poison the whole history).
+    ``kinds`` filters to the given record kinds."""
+    path = path or default_ledger_path()
+    out: list[dict] = []
+    if not os.path.exists(path):
+        return out
+    wanted = set(kinds) if kinds else None
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if wanted is None or rec.get("kind") in wanted:
+                    out.append(rec)
+    except OSError:
+        return out
+    return out

@@ -12,7 +12,8 @@ for the legacy toolchain).
 Report schema (top-level keys, all optional consumers should
 tolerate additions)::
 
-    version          int    report schema version
+    schema_version   int    report schema version (2: adds `perf`)
+    version          int    legacy alias of schema_version
     generated_utc    str    ISO-8601 UTC timestamp
     timers           {name: seconds}        driver wall-clock timers
     stage_timers     {name: {count, host_s, device_s}}
@@ -23,6 +24,15 @@ tolerate additions)::
     events           {kind: count}          event-log summary
     jit              {backend_compiles, compile_s, programs: {name: n}}
     device           {backend, jax_version, device_count, devices: []}
+    perf             per-stage cost model x measured device time
+                     (obs/costmodel.py): {peak, geometry, stages:
+                     {name: {flops, bytes_read, bytes_written,
+                     dominant, intensity_flops_per_byte, [device_s,
+                     basis, attribution, achieved_flops_per_s,
+                     achieved_bytes_per_s, utilization]}}, total}.
+                     The bracketed keys are OMITTED (never null) when
+                     no cost data or stage seconds exist — e.g. a
+                     bare-telemetry report with no search run.
     candidates       {count, folded, best_snr, best_folded_snr, ...}
     config           {key search parameters}
 """
@@ -33,7 +43,7 @@ import json
 import os
 import time
 
-REPORT_VERSION = 1
+REPORT_VERSION = 2
 
 
 def device_summary() -> dict:
@@ -107,6 +117,7 @@ def build_run_report(result=None, registry=None, events=None,
     snap = reg.snapshot()
     jit_timer = snap["timers"].get("jit_compile", {})
     report = {
+        "schema_version": REPORT_VERSION,
         "version": REPORT_VERSION,
         "generated_utc": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -134,6 +145,19 @@ def build_run_report(result=None, registry=None, events=None,
 
         report["spans"] = span_table()
     except Exception:  # pragma: no cover - tracing must never kill a run
+        pass
+    try:
+        from .costmodel import get_run_costs, perf_section
+
+        run_costs = get_run_costs()
+        if run_costs is not None:
+            # absent cost data (no search ran this process — e.g. the
+            # coincidencer, or a bare-telemetry report) simply omits
+            # the section; consumers never see nulls
+            report["perf"] = perf_section(
+                run_costs, report["stage_timers"], report["device"],
+                snap["gauges"])
+    except Exception:  # pragma: no cover - perf must never kill a run
         pass
     if result is not None:
         report["timers"] = {
@@ -191,6 +215,31 @@ def format_stage_table(report: dict) -> str:
             f"{name:<28}{rec['count']:>4} {rec['host_s']:>8.3f} "
             f"{rec['device_s']:>9.3f}"
         )
+    perf = report.get("perf")
+    if perf:
+        peak = perf.get("peak", {})
+        lines.append(
+            f"perf vs {peak.get('kind', '?')} x"
+            f"{peak.get('n_devices', 1)} "
+            f"({peak.get('flops_per_s', 0) / 1e12:.1f} TFLOP/s, "
+            f"{peak.get('bytes_per_s', 0) / 1e9:.0f} GB/s"
+            f"{'' if peak.get('matched') else ', unmatched kind'}):")
+        lines.append(
+            "stage          Gflop    GB  intens  achieved    util")
+        for name, row in perf.get("stages", {}).items():
+            ach = row.get("achieved_flops_per_s")
+            util = row.get("utilization")
+            gb = (row.get("bytes_read", 0)
+                  + row.get("bytes_written", 0)) / 1e9
+            lines.append(
+                f"{name:<12}{row.get('flops', 0) / 1e9:>8.2f}"
+                f"{gb:>6.2f}"
+                f"{row.get('intensity_flops_per_byte', 0.0):>8.2f}"
+                + (f"{ach / 1e9:>8.1f}G" if ach is not None
+                   else f"{'-':>9}")
+                + (f"{100 * util:>7.2f}%" if util is not None
+                   else f"{'-':>8}")
+            )
     jit = report.get("jit", {})
     if jit:
         lines.append(

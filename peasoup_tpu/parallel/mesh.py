@@ -1236,9 +1236,21 @@ class MeshPulsarSearch(PulsarSearch):
             cache[dm_tile] = fn
         with span("Dedisperse", metric="dedispersion",
                   n_rows=int(len(delays_rows)),
-                  dm_tile=int(dm_tile)) as sp:
+                  dm_tile=int(dm_tile),
+                  gflops=round(self._dedisp_rows_gflops(
+                      len(delays_rows)), 3)) as sp:
             return self._maybe_quantise(
                 sp.block(fn(jnp.asarray(delays_rows), *data_parts)))
+
+    def _dedisp_rows_gflops(self, n_rows: int) -> float:
+        """Modelled Gflops of an ``n_rows``-row dedispersion dispatch
+        (obs/costmodel.py — the span attribute trace viewers read)."""
+        from ..obs.costmodel import dedisperse_cost
+
+        return dedisperse_cost(
+            int(n_rows), self.fil.nchans, self.out_nsamps,
+            1 if self.fil.header.nbits <= 8 else 4,
+        ).flops / 1e9
 
     def measure_dedispersion_stage(self) -> float:
         """One warm + one timed dedispersion-only dispatch; returns the
@@ -1257,7 +1269,9 @@ class MeshPulsarSearch(PulsarSearch):
         np.asarray(warm[:1, :1])  # compile + execute untimed
         t0 = time.time()
         with span("Dedisperse", metric="dedispersion",
-                  n_dm_trials=len(self.dm_list), measured=True) as sp:
+                  n_dm_trials=len(self.dm_list), measured=True,
+                  gflops=round(self._dedisp_rows_gflops(
+                      len(self.dm_list)), 3)) as sp:
             trials = self.dedisperse_sharded()
             sp.block(trials)
         return time.time() - t0
@@ -1471,13 +1485,21 @@ class MeshPulsarSearch(PulsarSearch):
             # design — double-buffering); the wait shows up in the
             # fetch span of the same chunk.
             live = [int(r) for r in rows if int(r) < ndm]
+            n_trials_chunk = sum(len(acc_lists[r]) for r in live)
+            # modelled per-chunk work: each live trial's search cost
+            # plus each live row's dedisp + whiten (obs/costmodel.py)
+            gflops = (getattr(self, "_per_trial_gflops", 0.0)
+                      * n_trials_chunk
+                      + getattr(self, "_per_dmrow_gflops", 0.0)
+                      * len(live))
             with span(f"Chunked-Search-{ci}", chunk=int(ci),
                       n_dm_rows=len(live),
                       dm_lo=(float(self.dm_list[min(live)])
                              if live else None),
                       dm_hi=(float(self.dm_list[max(live)])
                              if live else None),
-                      n_trials=sum(len(acc_lists[r]) for r in live)):
+                      n_trials=n_trials_chunk,
+                      gflops=round(gflops, 3)):
                 return program(
                     *data_parts,
                     *sb_args,
@@ -1929,6 +1951,9 @@ class MeshPulsarSearch(PulsarSearch):
         ]
         namax = max(len(a) for a in acc_lists)
         n_trials_total = sum(len(a) for a in acc_lists)
+        from ..obs.costmodel import record_run_costs
+
+        run_costs = record_run_costs(self, acc_lists)["stages"]
 
         plan = self._plan_chunking(namax)
         if plan is not None:
@@ -2019,12 +2044,20 @@ class MeshPulsarSearch(PulsarSearch):
         METRICS.inc("runs.mesh_fused")
         while True:
             program = make_program(cap, compact_k)
+            # modelled work of everything fused into this one dispatch
+            # (dedispersion + whiten + per-trial spectra/harmonics/
+            # peaks) so the trace slice reads as achieved Gflop/s
+            fused_gflops = sum(
+                run_costs[s].flops
+                for s in ("dedisperse", "spectrum", "harmonics", "peaks")
+            ) / 1e9
             with span("Fused-Search", metric="fused_search",
                       n_dm_trials=ndm, n_trials=int(n_trials_total),
                       dm_lo=float(self.dm_list[0]),
                       dm_hi=float(self.dm_list[-1]),
                       capacity=int(cap), compact_k=int(compact_k),
                       hbm_budget_bytes=float(cfg.hbm_budget_gb * 1e9),
+                      gflops=round(fused_gflops, 3),
                       ) as sp:
                 packed, trials = program(*inputs)
                 # ONE gather over ICI/DCN -> host; ``trials`` stays on

@@ -59,7 +59,13 @@ from ..ops.fold import (
     optimise_device,
 )
 from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
-from .plan import AccelerationPlan, SearchConfig, prev_power_of_two
+from .plan import (
+    FOLD_NBINS,
+    FOLD_NINTS,
+    AccelerationPlan,
+    SearchConfig,
+    prev_power_of_two,
+)
 from .score import CandidateScorer
 
 
@@ -425,13 +431,22 @@ class PulsarSearch:
                         block=self.resample_block,
                     ))
                 )
+        # per-chunk modelled work (obs/costmodel.py), attached to the
+        # span so a trace viewer can read achieved Gflop/s off any
+        # Accel-Search slice; absent when no driver recorded costs
+        # (targeted mesh re-runs construct searches record_run_costs
+        # never saw)
+        trial_gflops = getattr(self, "_per_trial_gflops", None)
         while True:  # auto-escalate on peak-buffer overflow: no silent
             all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
             for c0 in range(0, padded, chunk):
+                n_live = int(min(chunk, n - c0))
                 with span("Accel-Search", metric="accel_search",
                           dm_trial=int(idx), dm=dm, chunk_start=int(c0),
-                          n_trials=int(min(chunk, n - c0)),
-                          capacity=int(cap)) as sp:
+                          n_trials=n_live,
+                          capacity=int(cap),
+                          **({"gflops": round(trial_gflops * n_live, 3)}
+                             if trial_gflops is not None else {})) as sp:
                     if self.resample_block is not None:
                         idxs, snrs, counts = search_accel_chunk(
                             tim_w, chunk_tables[c0], mean, std,
@@ -678,6 +693,7 @@ class PulsarSearch:
         return search_key(self.config.infilename, self.fil, self.config)
 
     def run(self) -> SearchResult:
+        from ..obs.costmodel import record_run_costs
         from ..obs.metrics import install_compile_hook
         from ..utils import ProgressBar
 
@@ -690,6 +706,7 @@ class PulsarSearch:
         METRICS.gauge("hbm.data_bytes", self._data_bytes())
         METRICS.gauge("search.n_dm_trials", len(self.dm_list))
         METRICS.gauge("search.fft_size", self.size)
+        costs = record_run_costs(self)["stages"]
 
         # consult the checkpoint BEFORE dedispersing: a fully-complete
         # resume only needs trials if folding will run
@@ -701,7 +718,10 @@ class PulsarSearch:
             t0 = time.time()
             with span("Dedisperse", metric="dedispersion",
                       n_dm_trials=len(self.dm_list),
-                      out_nsamps=int(self.out_nsamps)) as sp:
+                      out_nsamps=int(self.out_nsamps),
+                      gflops=round(costs["dedisperse"].flops / 1e9, 3),
+                      gbytes=round(
+                          costs["dedisperse"].bytes_total / 1e9, 3)) as sp:
                 trials = self.dedisperse()
                 sp.block(trials)
             timers["dedispersion"] = time.time() - t0
@@ -771,6 +791,7 @@ class PulsarSearch:
                 budget = int(cfg.hbm_budget_gb * 1e9)
                 resident = self._data_bytes() + trials.size * 4 + (2 << 30)
                 free = budget - resident
+                fold_costs = getattr(self, "_stage_costs", None)
                 if free < budget // 4:
                     # headroom is tight: free the search-phase
                     # executables' reserved arenas before folding — TPU
@@ -787,7 +808,11 @@ class PulsarSearch:
                     search_accel_chunk_legacy.clear_cache()
                     gc.collect()
                 with span("Folding", metric="folding",
-                          npdmp=int(cfg.npdmp)):
+                          npdmp=int(cfg.npdmp),
+                          **({"gflops": round(
+                              fold_costs["stages"]["fold"].flops / 1e9,
+                              3)}
+                             if fold_costs is not None else {})):
                     fold_candidates(
                         cands, trials, self.out_nsamps, hdr.tsamp,
                         cfg.npdmp,
@@ -910,8 +935,8 @@ def fold_candidates(
     trials_nsamps: int,
     tsamp: float,
     npdmp: int,
-    nbins: int = 64,
-    nints: int = 16,
+    nbins: int = FOLD_NBINS,
+    nints: int = FOLD_NINTS,
     min_period: float = FOLD_MIN_PERIOD,
     max_period: float = FOLD_MAX_PERIOD,
     boundary_5_freq: float = 0.05,

@@ -9,6 +9,14 @@ import numpy as np
 from ..errors import ConfigError
 
 
+#: fold profile geometry (`folder.hpp:337-442`): 64 phase bins x 16
+#: subintegrations.  Shared by the fold driver (search/pipeline.py)
+#: and the analytical cost model (obs/costmodel.py), so the perf
+#: accounting can never disagree with the program it describes.
+FOLD_NBINS = 64
+FOLD_NINTS = 16
+
+
 def prev_power_of_two(val: int) -> int:
     """Exact reference semantics (`include/utils/utils.hpp:12-18`):
     doubles n while 2n < val — the largest power of two strictly below
@@ -129,6 +137,46 @@ class SearchConfig:
     # hosts' spans into the one file process 0 writes.  Empty =
     # <outdir>/trace.json (CLI default)
     trace_json: str = ""
+
+    # -- geometry accessors (the cost model reads these; keeping them
+    # -- here means plan-derived figures have exactly one definition)
+
+    @property
+    def nlevels(self) -> int:
+        """Harmonic-spectrum levels searched per trial (the fundamental
+        plus ``nharmonics`` summed levels)."""
+        return self.nharmonics + 1
+
+    def fft_size_for(self, nsamps: int) -> int:
+        """The transform length this config uses on an ``nsamps``-sample
+        observation (explicit ``size`` or the reference's
+        prev-power-of-two rule)."""
+        return self.size or prev_power_of_two(nsamps)
+
+
+@dataclass(frozen=True)
+class TrialGridGeometry:
+    """Closed-form summary of the full DM x accel trial grid."""
+
+    n_dm: int
+    namax: int            # widest per-DM accel-trial count
+    n_trials_total: int   # sum over DMs of that DM's accel trials
+
+
+def trial_grid_geometry(dm_list, acc_plan,
+                        acc_lists=None) -> TrialGridGeometry:
+    """Grid geometry for ``dm_list`` under ``acc_plan``; pass the
+    per-DM ``acc_lists`` when the caller already generated them (the
+    mesh driver does) to skip regenerating the grid."""
+    if acc_lists is None:
+        acc_lists = [acc_plan.generate_accel_list(float(dm))
+                     for dm in dm_list]
+    counts = [len(a) for a in acc_lists]
+    return TrialGridGeometry(
+        n_dm=len(counts),
+        namax=max(counts) if counts else 0,
+        n_trials_total=int(sum(counts)),
+    )
 
 
 class AccelerationPlan:

@@ -1,0 +1,290 @@
+"""Perf trends + regression gate over the bench history ledger.
+
+Reads ``benchmarks/history.jsonl`` (``obs/history.py`` — appended by
+``bench.py`` and the ``benchmarks/`` harnesses) plus the legacy
+committed ``BENCH_r0*.json`` artifacts, prints per-metric trend tables
+with sparklines, and implements a noise-aware regression gate:
+
+    head  = median of the newest ``--head`` records' gate metric
+    base  = median of the ``--window`` records immediately before them
+    FAIL when head / base > --threshold   (lower-is-better metrics)
+
+Medians on both sides reject single-capture jitter (the remote-TPU
+tunnel adds 50-100 ms of per-fetch noise and occasional multi-second
+stalls); the threshold defaults to 1.4x so noise-level wobble never
+trips while a genuine 3x slowdown always does.
+
+Usage::
+
+    python -m peasoup_tpu.tools.perf_report              # trends
+    python -m peasoup_tpu.tools.perf_report --gate       # CI gate
+    python bench.py --gate                               # bench + gate
+    make perf-gate
+
+Exit status: 0 clean (or not enough history to judge), 1 regression,
+2 usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from ..obs.history import default_ledger_path, load_history, repo_root
+
+#: the gate's default headline metric (bench.py's best-of-N end-to-end
+#: wall-clock, seconds, lower is better)
+GATE_METRIC = "e2e_s"
+
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 24) -> str:
+    """Unicode block sparkline of ``values`` (newest right), resampled
+    to at most ``width`` columns."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # keep the newest `width` points — trends care about the tail
+        vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK_BLOCKS[0] * len(vals)
+    out = []
+    for v in vals:
+        idx = int((v - lo) / (hi - lo) * (len(SPARK_BLOCKS) - 1))
+        out.append(SPARK_BLOCKS[idx])
+    return "".join(out)
+
+
+def _median(vals: list[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+# --------------------------------------------------------------------------
+# record loading (ledger + legacy BENCH_r0*.json)
+# --------------------------------------------------------------------------
+
+def load_legacy_bench(pattern: str | None = None) -> list[dict]:
+    """The committed ``BENCH_r0*.json`` artifacts as pseudo-ledger
+    records (kind ``bench``, ``legacy: true``), ordered by filename —
+    they predate the ledger and seed its history."""
+    pattern = pattern or os.path.join(repo_root(), "BENCH_r0*.json")
+    out: list[dict] = []
+    for path in sorted(glob.glob(pattern)):
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict) or parsed.get("value") is None:
+            continue
+        metrics = {GATE_METRIC: float(parsed["value"])}
+        for key in ("median_s", "vs_baseline"):
+            if isinstance(parsed.get(key), (int, float)):
+                metrics[key] = float(parsed[key])
+        rec = {
+            "v": 0, "kind": "bench", "legacy": True,
+            "source": os.path.basename(path),
+            "metrics": metrics,
+        }
+        timers = parsed.get("timers")
+        if isinstance(timers, dict):
+            rec["timers"] = {
+                k: v for k, v in timers.items()
+                if isinstance(v, (int, float))
+            }
+        out.append(rec)
+    return out
+
+
+def collect_records(ledger: str | None, legacy_glob: str | None,
+                    kind: str = "bench") -> list[dict]:
+    """Legacy artifacts first (oldest), then ledger records in append
+    order — the gate's notion of time."""
+    records = load_legacy_bench(legacy_glob) if kind == "bench" else []
+    records += load_history(ledger or default_ledger_path(),
+                            kinds=(kind,))
+    return records
+
+
+def metric_series(records: list[dict]) -> dict[str, list[float]]:
+    """{metric: ordered values} over every numeric ``metrics`` entry."""
+    series: dict[str, list[float]] = {}
+    for rec in records:
+        for name, val in rec.get("metrics", {}).items():
+            if isinstance(val, (int, float)):
+                series.setdefault(name, []).append(float(val))
+    return series
+
+
+# --------------------------------------------------------------------------
+# output
+# --------------------------------------------------------------------------
+
+def trend_table(records: list[dict]) -> str:
+    series = metric_series(records)
+    if not series:
+        return "no records"
+    width = max(len("metric"), *(len(n) for n in series)) + 2
+    lines = [f"{'metric':<{width}}{'n':>4} {'min':>10} {'median':>10} "
+             f"{'last':>10}  trend"]
+    for name in sorted(series):
+        vals = series[name]
+        lines.append(
+            f"{name:<{width}}{len(vals):>4} {min(vals):>10.4g} "
+            f"{_median(vals):>10.4g} {vals[-1]:>10.4g}  "
+            f"{sparkline(vals)}"
+        )
+    return "\n".join(lines)
+
+
+def stage_table(records: list[dict]) -> str:
+    """Trailing per-stage device-time and utilization figures (from the
+    newest record that carries them)."""
+    for rec in reversed(records):
+        stages = rec.get("stage_device_s")
+        if stages:
+            util = rec.get("utilization", {})
+            lines = ["latest per-stage figures:"]
+            for name in sorted(stages, key=lambda k: -stages[k]):
+                u = util.get(name)
+                ustr = f"{100 * u:6.1f}%" if u is not None else "      -"
+                lines.append(
+                    f"  {name:<24}{stages[name]:>10.4f} s  util {ustr}")
+            return "\n".join(lines)
+    return ""
+
+
+# --------------------------------------------------------------------------
+# regression gate
+# --------------------------------------------------------------------------
+
+def regression_gate(records: list[dict], metric: str = GATE_METRIC,
+                    head: int = 1, window: int = 8,
+                    threshold: float = 1.4) -> tuple[int, str]:
+    """(exit_code, message).  0 = clean or not enough history; 1 =
+    regression (head median exceeds the trailing-window median by more
+    than ``threshold`` x)."""
+    vals = metric_series(records).get(metric, [])
+    if len(vals) < 2:
+        return 0, (f"gate: only {len(vals)} `{metric}` record(s) — "
+                   f"not enough history to judge (pass)")
+    head = max(1, int(head))
+    window = max(1, int(window))
+    head_vals = vals[-head:]
+    base_vals = vals[-(head + window):-head]
+    if not base_vals:
+        base_vals = vals[:-head]
+    head_med = _median(head_vals)
+    base_med = _median(base_vals)
+    if base_med <= 0:
+        return 0, f"gate: non-positive baseline for `{metric}` (pass)"
+    ratio = head_med / base_med
+    desc = (f"gate: {metric} head median {head_med:.4g} "
+            f"(n={len(head_vals)}) vs trailing median {base_med:.4g} "
+            f"(n={len(base_vals)}) -> {ratio:.2f}x "
+            f"(threshold {threshold:.2f}x)")
+    if ratio > threshold:
+        return 1, "REGRESSION " + desc
+    return 0, "OK " + desc
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m peasoup_tpu.tools.perf_report",
+        description="perf trends + regression gate over the bench "
+                    "history ledger (benchmarks/history.jsonl) and the "
+                    "legacy BENCH_r0*.json artifacts",
+    )
+    p.add_argument("--ledger", default=None,
+                   help=f"history ledger path (default: "
+                        f"{default_ledger_path()})")
+    p.add_argument("--legacy-glob", default=None,
+                   help="glob for the committed BENCH artifacts "
+                        "(default: <repo>/BENCH_r0*.json; pass an "
+                        "empty string to skip them)")
+    p.add_argument("--kind", default="bench",
+                   help="ledger record kind to report on "
+                        "(default: bench)")
+    p.add_argument("--metric", default=GATE_METRIC,
+                   help=f"gate metric, lower is better "
+                        f"(default: {GATE_METRIC})")
+    p.add_argument("--head", type=int, default=1,
+                   help="newest records whose median is gated "
+                        "(default: 1)")
+    p.add_argument("--window", type=int, default=8,
+                   help="trailing records forming the baseline median "
+                        "(default: 8)")
+    p.add_argument("--threshold", type=float, default=1.4,
+                   help="fail when head/base exceeds this ratio "
+                        "(default: 1.4)")
+    p.add_argument("--gate", action="store_true",
+                   help="run the regression gate (nonzero exit on "
+                        "regression)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit one JSON object instead of text")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    legacy = args.legacy_glob
+    if legacy == "":
+        legacy = os.path.join("/nonexistent", "none")  # skip legacy
+    try:
+        records = collect_records(args.ledger, legacy, kind=args.kind)
+    except OSError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    gate_code, gate_msg = 0, None
+    if args.gate:
+        gate_code, gate_msg = regression_gate(
+            records, metric=args.metric, head=args.head,
+            window=args.window, threshold=args.threshold)
+
+    if args.as_json:
+        doc = {
+            "records": len(records),
+            "metrics": {
+                name: {"n": len(vals), "min": min(vals),
+                       "median": _median(vals), "last": vals[-1]}
+                for name, vals in metric_series(records).items()
+            },
+        }
+        if args.gate:
+            doc["gate"] = {"ok": gate_code == 0, "message": gate_msg}
+        print(json.dumps(doc, indent=1, sort_keys=True))
+        return gate_code
+
+    n_legacy = sum(1 for r in records if r.get("legacy"))
+    print(f"{len(records)} `{args.kind}` record(s) "
+          f"({n_legacy} legacy BENCH artifact(s) + "
+          f"{len(records) - n_legacy} ledger)")
+    print()
+    print(trend_table(records))
+    st = stage_table(records)
+    if st:
+        print()
+        print(st)
+    if gate_msg:
+        print()
+        print(gate_msg)
+    return gate_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

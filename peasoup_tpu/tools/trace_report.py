@@ -12,12 +12,18 @@ the ``B``/``E`` phase pairs, and prints
 ``--require NAME...`` exits nonzero unless every named span is
 present — the ``make trace-smoke`` gate.
 
+``--compare A.json B.json`` prints the per-stage **self-time delta**
+table between two trace files (reusing the same forest rebuilder), so
+a before/after perf investigation is one command instead of manual
+Perfetto diffing.
+
 Usage::
 
     python -m peasoup_tpu.tools.trace_report outdir/trace.json
     python -m peasoup_tpu.tools.trace_report trace.json --top 20
     python -m peasoup_tpu.tools.trace_report trace.json \
         --require Dedisperse DM-Loop Accel-Search Distill Folding
+    python -m peasoup_tpu.tools.trace_report --compare before.json after.json
 """
 
 from __future__ import annotations
@@ -82,7 +88,9 @@ def rebuild_spans(events: list[dict]) -> list[dict]:
     return spans
 
 
-def self_time_table(spans: list[dict], top: int = 15) -> str:
+def aggregate_by_name(spans: list[dict]) -> dict[str, dict]:
+    """Per-name ``{count, total_ms, self_ms, device_ms}`` totals —
+    shared by the self-time table and ``--compare``."""
     agg: dict[str, dict] = {}
     for s in spans:
         rec = agg.setdefault(s["name"], {
@@ -92,6 +100,11 @@ def self_time_table(spans: list[dict], top: int = 15) -> str:
         rec["total_ms"] += s["dur_ms"]
         rec["self_ms"] += s["self_ms"]
         rec["device_ms"] += s["device_ms"]
+    return agg
+
+
+def self_time_table(spans: list[dict], top: int = 15) -> str:
+    agg = aggregate_by_name(spans)
     rows = sorted(agg.items(), key=lambda kv: -kv[1]["self_ms"])[:top]
     width = max([len("span")] + [len(name) for name, _ in rows]) + 2
     lines = [f"{'span':<{width}}{'n':>5} {'total_ms':>10} "
@@ -102,6 +115,49 @@ def self_time_table(spans: list[dict], top: int = 15) -> str:
             f"{rec['self_ms']:>10.2f} {rec['device_ms']:>10.2f}")
     if len(agg) > top:
         lines.append(f"... ({len(agg) - top} more span name(s))")
+    return "\n".join(lines)
+
+
+def compare_table(spans_a: list[dict], spans_b: list[dict],
+                  label_a: str = "A", label_b: str = "B",
+                  top: int = 0) -> str:
+    """Per-stage self-time delta between two traces, largest absolute
+    delta first.  B - A, so positive delta = B is slower there."""
+    agg_a = aggregate_by_name(spans_a)
+    agg_b = aggregate_by_name(spans_b)
+    names = sorted(set(agg_a) | set(agg_b))
+    zero = {"count": 0, "self_ms": 0.0, "device_ms": 0.0,
+            "total_ms": 0.0}
+    rows = []
+    for name in names:
+        a = agg_a.get(name, zero)
+        b = agg_b.get(name, zero)
+        delta = b["self_ms"] - a["self_ms"]
+        ratio = (b["self_ms"] / a["self_ms"]
+                 if a["self_ms"] > 0 else None)
+        rows.append((name, a, b, delta, ratio))
+    rows.sort(key=lambda r: -abs(r[3]))
+    if top:
+        rows = rows[:top]
+    width = max([len("span")] + [len(r[0]) for r in rows]) + 2
+    lines = [
+        f"self-time delta ({label_b} - {label_a}; positive = "
+        f"{label_b} slower):",
+        f"{'span':<{width}}{'n_A':>5} {'n_B':>5} {'self_A_ms':>11} "
+        f"{'self_B_ms':>11} {'delta_ms':>10} {'ratio':>7}",
+    ]
+    for name, a, b, delta, ratio in rows:
+        lines.append(
+            f"{name:<{width}}{a['count']:>5} {b['count']:>5} "
+            f"{a['self_ms']:>11.2f} {b['self_ms']:>11.2f} "
+            f"{delta:>+10.2f} "
+            + (f"{ratio:>6.2f}x" if ratio is not None else f"{'new':>7}"))
+    tot_a = sum(r[1]["self_ms"] for r in rows)
+    tot_b = sum(r[2]["self_ms"] for r in rows)
+    lines.append(
+        f"{'TOTAL':<{width}}{'':>5} {'':>5} {tot_a:>11.2f} "
+        f"{tot_b:>11.2f} {tot_b - tot_a:>+10.2f} "
+        + (f"{tot_b / tot_a:>6.2f}x" if tot_a > 0 else f"{'-':>7}"))
     return "\n".join(lines)
 
 
@@ -134,13 +190,33 @@ def main(argv=None) -> int:
         description="top-N self-time table + critical path of a "
                     "peasoup-tpu span trace (Chrome trace-event JSON)",
     )
-    p.add_argument("trace", help="trace JSON (--trace_json output)")
+    p.add_argument("trace", nargs="?", default=None,
+                   help="trace JSON (--trace_json output)")
     p.add_argument("--top", type=int, default=15,
                    help="rows in the self-time table (default 15)")
     p.add_argument("--require", nargs="+", default=None, metavar="NAME",
                    help="exit 1 unless every named span is present "
                         "(smoke-test gate)")
+    p.add_argument("--compare", nargs=2, default=None,
+                   metavar=("A.json", "B.json"),
+                   help="print the per-stage self-time delta table "
+                        "between two traces instead of summarising one")
     args = p.parse_args(argv)
+
+    if args.compare:
+        path_a, path_b = args.compare
+        try:
+            spans_a = rebuild_spans(load_events(path_a))
+            spans_b = rebuild_spans(load_events(path_b))
+        except (OSError, ValueError, KeyError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+        print(compare_table(
+            spans_a, spans_b,
+            label_a=path_a, label_b=path_b, top=args.top))
+        return 0
+    if args.trace is None:
+        p.error("a trace file (or --compare A.json B.json) is required")
 
     try:
         events = load_events(args.trace)

@@ -246,6 +246,57 @@ def test_psl006_pragma_escape(tmp_path):
     assert suppressed == 1
 
 
+def test_psl007_perf_constant_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        V5E_HBM_GBPS = 819.0
+        PEAK_BW = 1 << 30
+        COPY_BYTES_PER_SAMP = 96 + 32
+        FFT_FLOPS = 2.5e7
+    """, relpath="benchmarks/fixture.py")
+    assert [v.rule for v in vs] == ["PSL007"] * 4
+    assert all("costmodel" in v.message for v in vs)
+
+
+def test_psl007_applies_to_ops_and_bench_entry(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        DEDISP_FLOPS = 1.0e9
+    """, relpath="peasoup_tpu/ops/fixture.py")
+    assert [v.rule for v in vs] == ["PSL007"]
+    vs, _ = _lint_snippet(tmp_path, """
+        HBM_GBPS = 819.0
+    """, relpath="bench.py")
+    assert [v.rule for v in vs] == ["PSL007"]
+
+
+def test_psl007_clean_sites_not_flagged(tmp_path):
+    """Lowercase locals, non-perf CONSTANT_CASE names, and values
+    derived from the cost model (non-literal) are all clean."""
+    vs, _ = _lint_snippet(tmp_path, """
+        from peasoup_tpu.obs.costmodel import device_peak
+
+        MAX_SPANS = 100_000
+        BASELINE_TOTAL_S = 0.7699
+        peak_gbps = 819.0
+        DERIVED_GBPS = device_peak()["bytes_per_s"] / 1e9
+    """, relpath="benchmarks/fixture.py")
+    assert vs == []
+
+
+def test_psl007_costmodel_is_the_exempt_home(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        V5E_HBM_GBPS = 819.0
+    """, relpath="peasoup_tpu/obs/costmodel.py")
+    assert vs == []
+
+
+def test_psl007_pragma_escape(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        LINK_GBPS = 0.035  # psl: disable=PSL007 -- tunnel link budget, not a device peak
+    """, relpath="benchmarks/fixture.py")
+    assert vs == []
+    assert suppressed == 1
+
+
 # --------------------------------------------------------------------------
 # suppressions
 # --------------------------------------------------------------------------

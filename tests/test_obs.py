@@ -145,7 +145,8 @@ def test_build_report_and_stage_table():
     log = EventLog("", registry=reg)
     log.emit("peak_buffer_overflow", "x")
     report = build_run_report(registry=reg, events=log)
-    assert report["version"] == 1
+    assert report["version"] == 2  # PR 4: schema bump (adds `perf`)
+    assert report["schema_version"] == 2
     assert report["events"] == {"peak_buffer_overflow": 1}
     assert "dedispersion" in report["stage_timers"]
     assert {"count", "host_s", "device_s"} <= set(

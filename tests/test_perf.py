@@ -1,0 +1,395 @@
+"""Perf accounting tier-1 tests (ISSUE 4): the closed-form cost model
+vs XLA's own cost_analysis, roofline utilization on a synthetic run,
+the bench history ledger round-trip, the noise-aware regression gate,
+and trace_report --compare."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs import costmodel as cm
+from peasoup_tpu.obs.history import (
+    append_history,
+    load_history,
+    make_history_record,
+)
+from peasoup_tpu.tools import perf_report, trace_report
+
+# --------------------------------------------------------------------------
+# cost model closed forms
+# --------------------------------------------------------------------------
+
+def _geometry(**over):
+    base = dict(
+        n_dm=16, nchans=32, out_nsamps=4000, in_itemsize=1, size=2048,
+        nharmonics=4, peak_capacity=64, n_trials_total=48, npdmp=4,
+        fold_nsamps=2048, fold_nbins=64, fold_nints=16,
+    )
+    base.update(over)
+    return cm.PipelineGeometry(**base)
+
+
+def test_pipeline_costs_cover_all_five_stages():
+    costs = cm.pipeline_costs(_geometry())
+    assert set(costs) == set(cm.STAGES)
+    for name, cost in costs.items():
+        assert cost.flops > 0, name
+        assert cost.bytes_read > 0 and cost.bytes_written > 0, name
+        assert cost.intensity > 0, name
+
+
+def test_costs_scale_with_geometry():
+    """Doubling the trial grid doubles the per-trial stages; doubling
+    the DM count doubles dedispersion — the closed forms track the
+    plan, which is the whole point."""
+    a = cm.pipeline_costs(_geometry())
+    b = cm.pipeline_costs(_geometry(n_trials_total=96))
+    assert b["harmonics"].flops == pytest.approx(2 * a["harmonics"].flops)
+    assert b["peaks"].flops == pytest.approx(2 * a["peaks"].flops)
+    assert b["dedisperse"].flops == a["dedisperse"].flops
+    c = cm.pipeline_costs(_geometry(n_dm=32))
+    assert c["dedisperse"].flops == pytest.approx(
+        2 * a["dedisperse"].flops)
+
+
+def test_dominant_classification():
+    peak = {"flops_per_s": 1e12, "bytes_per_s": 100e9}
+    assert cm.StageCost(1e12, 1e9, 1e9).dominant(peak) == "compute"
+    assert cm.StageCost(1e6, 1e12, 1e12).dominant(peak) == "memory"
+
+
+def test_device_peak_lookup_and_fallback():
+    v5e = cm.device_peak("TPU v5 lite", n_devices=1)
+    assert v5e["matched"] is True
+    four = cm.device_peak("TPU v5 lite", n_devices=4)
+    assert four["flops_per_s"] == pytest.approx(4 * v5e["flops_per_s"])
+    unknown = cm.device_peak("FancyAccel 9000")
+    assert unknown["matched"] is False
+    assert unknown["flops_per_s"] > 0
+
+
+def test_geometry_accessors():
+    from peasoup_tpu.search.plan import (
+        AccelerationPlan,
+        SearchConfig,
+        trial_grid_geometry,
+    )
+
+    cfg = SearchConfig(nharmonics=4, size=0)
+    assert cfg.nlevels == 5
+    assert cfg.fft_size_for(5000) == 4096
+    assert SearchConfig(size=1 << 14).fft_size_for(5000) == 1 << 14
+
+    plan = AccelerationPlan(-5.0, 5.0, 1.10, 64000.0, 1 << 17,
+                            6.4e-5, 1510.0, -10.0)
+    dms = np.asarray([0.0, 50.0, 100.0], np.float32)
+    geom = trial_grid_geometry(dms, plan)
+    assert geom.n_dm == 3
+    assert geom.n_trials_total == sum(
+        len(plan.generate_accel_list(d)) for d in dms)
+    assert geom.namax >= 1
+    # precomputed acc_lists short-circuit agrees
+    lists = [plan.generate_accel_list(float(d)) for d in dms]
+    assert trial_grid_geometry(dms, plan, lists) == geom
+
+
+# --------------------------------------------------------------------------
+# closed form vs XLA cost_analysis
+# --------------------------------------------------------------------------
+
+def test_crosscheck_shapes_match_registered_programs():
+    """The cross-check's model shapes must track the jaxpr checker's
+    program registry — same five names."""
+    from peasoup_tpu.analysis.jaxpr_check import registered_programs
+
+    assert set(cm._crosscheck_shapes()) == {
+        s.name for s in registered_programs()}
+
+
+def test_crosscheck_agreement_within_documented_factor():
+    """Every registered program's closed-form flops agree with
+    ``jax.jit(...).lower().compile().cost_analysis()`` within
+    CROSSCHECK_FACTOR (programs where the backend reports no flop
+    count — FFT custom calls — are skipped by the check itself)."""
+    rows = cm.crosscheck_registered_programs()
+    assert {r["program"] for r in rows} == set(cm.STAGES)
+    if all(r["xla_flops"] is None for r in rows):
+        pytest.skip("cost_analysis unavailable on this jax/backend")
+    bad = [r for r in rows if not r["ok"]]
+    assert bad == [], f"model drifted from traced programs: {bad}"
+
+
+# --------------------------------------------------------------------------
+# utilization on a synthetic end-to-end run
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def synth_run_report(tmp_path_factory):
+    """One small host-loop search -> its run report (with perf)."""
+    from peasoup_tpu.io.sigproc import Filterbank, SigprocHeader
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.obs.report import build_run_report
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    rng = np.random.default_rng(0)
+    nsamps, nchans = 4096, 16
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    fil = Filterbank(header=hdr, data=data)
+    REGISTRY.reset()
+    cfg = SearchConfig(dm_start=0.0, dm_end=20.0, min_snr=6.0,
+                       npdmp=2, limit=10)
+    result = PulsarSearch(fil, cfg).run()
+    return build_run_report(result)
+
+
+def test_report_schema_version_bumped(synth_run_report):
+    assert synth_run_report["schema_version"] == 2
+    assert synth_run_report["version"] == 2
+
+
+def test_perf_section_all_five_stages(synth_run_report):
+    """ISSUE acceptance: per-stage flops, bytes, achieved FLOP/s and
+    utilization for all five pipeline stages."""
+    perf = synth_run_report["perf"]
+    stages = perf["stages"]
+    assert set(stages) == set(cm.STAGES)
+    for name, row in stages.items():
+        assert row["flops"] > 0, name
+        assert row["bytes_read"] > 0 and row["bytes_written"] > 0, name
+        assert row["dominant"] in ("compute", "memory"), name
+        assert row["achieved_flops_per_s"] > 0, name
+        assert 0.0 < row["utilization"] <= 1.0, name
+        assert row["attribution"] in ("measured", "modeled-share"), name
+    assert perf["peak"]["flops_per_s"] > 0
+    assert perf["geometry"]["n_dm"] >= 1
+    # no nulls anywhere in the section
+    assert "null" not in json.dumps(perf)
+
+
+def test_perf_section_absent_without_cost_data():
+    """A bare-telemetry report (no search ran -> no recorded costs)
+    omits the perf section entirely rather than emitting nulls."""
+    from peasoup_tpu.obs.metrics import MetricsRegistry
+    from peasoup_tpu.obs.report import build_run_report
+
+    saved = cm.get_run_costs()
+    try:
+        cm.reset_run_costs()
+        report = build_run_report(registry=MetricsRegistry())
+        assert "perf" not in report
+        assert "null" not in json.dumps(report.get("perf", {}))
+    finally:
+        if saved is not None:
+            cm._RUN_COSTS = saved
+
+
+def test_verbose_table_includes_perf(synth_run_report):
+    from peasoup_tpu.obs.report import format_stage_table
+
+    table = format_stage_table(synth_run_report)
+    assert "util" in table
+    assert "dedisperse" in table
+
+
+def test_span_gflops_attributes(synth_run_report):
+    """The drivers attach the modelled Gflops to their existing spans
+    so trace viewers can read achieved rates off any slice."""
+    from peasoup_tpu.obs.trace import get_tracer
+
+    by_name = {}
+    for rec in get_tracer().records():
+        by_name.setdefault(rec.name, []).append(rec)
+    assert any("gflops" in r.attrs for r in by_name.get("Dedisperse", []))
+    assert any("gflops" in r.attrs
+               for r in by_name.get("Accel-Search", []))
+
+
+# --------------------------------------------------------------------------
+# history ledger
+# --------------------------------------------------------------------------
+
+def test_history_append_load_round_trip(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    rec = make_history_record(
+        "bench", metrics={"e2e_s": 0.42, "skipme": None},
+        timers={"total": 0.5}, utilization={"spectrum": 0.12},
+        parity="ok")
+    assert rec["v"] == 1
+    assert "ts" in rec and "git" in rec and "device" in rec
+    assert "skipme" not in rec["metrics"]  # no nulls in the ledger
+    assert append_history(rec, path) == path
+    assert append_history(make_history_record(
+        "micro", metrics={"fft_ms": 1.0}), path) == path
+    # a torn tail (killed run) must not poison the history
+    with open(path, "a") as f:
+        f.write('{"v": 1, "kind": "bench", "metr')
+    loaded = load_history(path)
+    assert len(loaded) == 2
+    assert loaded[0]["metrics"]["e2e_s"] == 0.42
+    assert [r["kind"] for r in load_history(path, kinds=("micro",))] \
+        == ["micro"]
+    assert load_history(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_legacy_bench_artifacts_load(tmp_path):
+    legacy = tmp_path / "BENCH_r01.json"
+    legacy.write_text(json.dumps({
+        "n": 1, "rc": 0,
+        "parsed": {"metric": "tutorial_fil_e2e_wallclock",
+                   "value": 0.7087, "unit": "s",
+                   "timers": {"total": 0.71}},
+    }))
+    (tmp_path / "BENCH_r02.json").write_text("not json")
+    recs = perf_report.load_legacy_bench(str(tmp_path / "BENCH_r0*.json"))
+    assert len(recs) == 1
+    assert recs[0]["legacy"] is True
+    assert recs[0]["metrics"]["e2e_s"] == 0.7087
+
+
+# --------------------------------------------------------------------------
+# regression gate
+# --------------------------------------------------------------------------
+
+def _ledger_with(tmp_path, values, metric="e2e_s"):
+    path = str(tmp_path / "history.jsonl")
+    for v in values:
+        append_history(make_history_record(
+            "bench", metrics={metric: v}), path)
+    return path
+
+
+def test_gate_quiet_on_noise_jitter(tmp_path, capsys):
+    # +-5 % jitter around 1.0 s: far below the 1.4x threshold
+    rng = np.random.default_rng(7)
+    vals = list(1.0 + 0.05 * rng.uniform(-1, 1, size=10))
+    path = _ledger_with(tmp_path, vals)
+    rc = perf_report.main(
+        ["--ledger", path, "--legacy-glob", "", "--gate"])
+    assert rc == 0
+    assert "OK gate" in capsys.readouterr().out
+
+
+def test_gate_trips_on_injected_3x_regression(tmp_path, capsys):
+    """ISSUE acceptance: a synthetic 3x slowdown record appended to an
+    otherwise steady ledger makes the gate exit nonzero."""
+    rng = np.random.default_rng(7)
+    vals = list(1.0 + 0.05 * rng.uniform(-1, 1, size=10)) + [3.0]
+    path = _ledger_with(tmp_path, vals)
+    rc = perf_report.main(
+        ["--ledger", path, "--legacy-glob", "", "--gate"])
+    assert rc == 1
+    assert "REGRESSION" in capsys.readouterr().out
+
+
+def test_gate_passes_with_insufficient_history(tmp_path, capsys):
+    path = _ledger_with(tmp_path, [1.0])
+    rc = perf_report.main(
+        ["--ledger", path, "--legacy-glob", "", "--gate"])
+    assert rc == 0
+    assert "not enough history" in capsys.readouterr().out
+
+
+def test_gate_median_rejects_single_outlier_in_window(tmp_path):
+    """One historic outlier must not poison the baseline median."""
+    vals = [1.0, 1.02, 5.0, 0.98, 1.01, 1.0, 0.99, 1.03, 1.0]
+    code, msg = perf_report.regression_gate(
+        [{"metrics": {"e2e_s": v}} for v in vals])
+    assert code == 0, msg
+
+
+def test_gate_json_mode(tmp_path, capsys):
+    path = _ledger_with(tmp_path, [1.0, 1.0, 1.0, 3.1])
+    rc = perf_report.main(
+        ["--ledger", path, "--legacy-glob", "", "--gate", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["gate"]["ok"] is False
+    assert doc["metrics"]["e2e_s"]["n"] == 4
+
+
+def test_sparkline_shape():
+    s = perf_report.sparkline([1, 2, 3, 4])
+    assert len(s) == 4
+    assert s[0] == perf_report.SPARK_BLOCKS[0]
+    assert s[-1] == perf_report.SPARK_BLOCKS[-1]
+    assert perf_report.sparkline([2.0, 2.0]) == \
+        perf_report.SPARK_BLOCKS[0] * 2
+    assert perf_report.sparkline([]) == ""
+
+
+def test_trend_table_lists_metrics(tmp_path, capsys):
+    path = _ledger_with(tmp_path, [0.5, 0.4, 0.45])
+    rc = perf_report.main(["--ledger", path, "--legacy-glob", ""])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "e2e_s" in out
+    assert "3" in out  # record count
+
+
+# --------------------------------------------------------------------------
+# trace_report --compare
+# --------------------------------------------------------------------------
+
+def _write_trace(path, scale, extra_stage=False):
+    events, t = [], 0.0
+    stages = [("DM-Loop", 100.0 * scale), ("Folding", 30.0)]
+    if extra_stage:
+        stages.append(("Rednoise", 5.0))
+    for name, dur_ms in stages:
+        events.append({"ph": "B", "name": name, "ts": t, "pid": 0,
+                       "tid": 0, "args": {}})
+        events.append({"ph": "E", "name": name, "ts": t + dur_ms * 1e3,
+                       "pid": 0, "tid": 0})
+        t += dur_ms * 1e3 + 10
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_trace_compare_delta_table(tmp_path, capsys):
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    _write_trace(a, 1.0)
+    _write_trace(b, 2.0, extra_stage=True)
+    rc = trace_report.main(["--compare", a, b])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "DM-Loop" in out and "+100.00" in out and "2.00x" in out
+    assert "Folding" in out and "+0.00" in out
+    assert "Rednoise" in out and "new" in out  # B-only stage
+    assert "TOTAL" in out
+
+
+def test_trace_report_still_requires_a_trace(capsys):
+    with pytest.raises(SystemExit) as exc:
+        trace_report.main([])
+    assert exc.value.code == 2
+
+
+def test_trace_compare_rejects_bad_file(tmp_path, capsys):
+    a = str(tmp_path / "a.json")
+    _write_trace(a, 1.0)
+    rc = trace_report.main(
+        ["--compare", a, str(tmp_path / "missing.json")])
+    assert rc == 2
+
+
+# --------------------------------------------------------------------------
+# shared ledger writer (micro/production route through it)
+# --------------------------------------------------------------------------
+
+def test_benchmark_harnesses_use_shared_writer():
+    """The satellite fix: benchmarks/micro.py and production.py must
+    route their ledger records through obs.history (one schema), not
+    ad-hoc json.dump calls."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in ("micro.py", "production.py"):
+        src = open(os.path.join(root, "benchmarks", name)).read()
+        assert "make_history_record" in src, name
+        assert "append_history" in src, name
+    src = open(os.path.join(root, "bench.py")).read()
+    assert "make_history_record" in src and "append_history" in src
