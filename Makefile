@@ -4,8 +4,9 @@
 PY ?= python
 TUTORIAL ?= /root/reference/example_data/tutorial.fil
 SMOKE_DIR ?= /tmp/peasoup-trace-smoke
+SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
 
-.PHONY: lint test bench perf-gate trace-smoke
+.PHONY: lint test bench perf-gate trace-smoke serve-smoke
 
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.analysis
@@ -34,3 +35,12 @@ trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.trace_report \
 	    $(SMOKE_DIR)/trace.json \
 	    --require Dedisperse DM-Loop Accel-Search Distill Folding
+
+# survey-scheduler smoke test: spool 3 synthetic observations (one
+# truncated), drain a worker, assert 2 done + 1 quarantined + store
+# candidates + a serve throughput record in benchmarks/history.jsonl,
+# then crash a job mid-search and assert the retry resumes from its
+# per-job checkpoint instead of recomputing
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.serve_smoke \
+	    --dir $(SERVE_SMOKE_DIR)

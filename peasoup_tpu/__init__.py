@@ -20,6 +20,9 @@ Layout:
                machine-readable run_report.json
     analysis/  peasoup-lint: AST rule engine + jaxpr invariant checker
                (``python -m peasoup_tpu.analysis``)
+    serve/     survey scheduler: durable job spool, retrying workers
+               with observation prefetch, cross-run candidate store
+               (``python -m peasoup_tpu.serve``)
     errors     typed exception hierarchy (the reference's ErrorChecker)
 """
 

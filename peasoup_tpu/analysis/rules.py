@@ -15,6 +15,9 @@ PSL006   raw ``METRICS.timer(...)`` / ``trace_range(...)`` call
 PSL007   hand-written FLOP/byte/bandwidth constant outside
          ``obs/costmodel.py`` (the analytical cost model is the
          single source of truth for perf accounting figures)
+PSL008   bare ``time.sleep`` outside ``serve/retry.py`` (scheduler
+         waits must be bounded, classified and injectable — route
+         them through the retry layer's BackoffPolicy/pause)
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -656,6 +659,54 @@ class CostModelAuthorityRule(Rule):
                     )
 
 
+# --------------------------------------------------------------------------
+# PSL008 — bare time.sleep outside serve/retry.py
+# --------------------------------------------------------------------------
+
+class NoBareSleepRule(Rule):
+    """An ad-hoc ``time.sleep`` retry/poll loop is an unbounded,
+    unclassified, untestable wait: the survey scheduler's backoff
+    policy (``serve/retry.py``) is the one place waits are allowed to
+    happen, because there they are bounded by ``BackoffPolicy``,
+    attributed to a failure classification, and injectable in tests
+    (``pause(seconds, sleeper=...)``).  A sleep anywhere else either
+    belongs behind that API or carries a
+    ``# psl: disable=PSL008 -- reason`` pragma."""
+
+    id = "PSL008"
+    title = "bare time.sleep outside serve/retry.py"
+
+    def applies(self, relpath: str) -> bool:
+        if relpath == "peasoup_tpu/serve/retry.py":
+            return False
+        return relpath.endswith(".py") and (
+            relpath.startswith("peasoup_tpu/")
+            or relpath == "bench.py"
+            or relpath.startswith("benchmarks/")
+        )
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ImportFrom) and \
+                    node.module == "time" and \
+                    any(a.name == "sleep" for a in node.names):
+                yield sf.violation(
+                    self.id, node,
+                    "`from time import sleep` — scheduler waits go "
+                    "through peasoup_tpu.serve.retry (BackoffPolicy."
+                    "delay_for + pause) so they are bounded, "
+                    "classified and injectable in tests",
+                )
+            elif isinstance(node, ast.Call) and \
+                    _dotted(node.func) == "time.sleep":
+                yield sf.violation(
+                    self.id, node,
+                    "bare time.sleep() — use peasoup_tpu.serve.retry."
+                    "pause / BackoffPolicy so the wait is bounded, "
+                    "classified and injectable in tests",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
     NoHostSyncInJitRule(),
@@ -664,6 +715,7 @@ ALL_RULES: tuple[Rule, ...] = (
     TypedErrorsRule(),
     SpanApiRule(),
     CostModelAuthorityRule(),
+    NoBareSleepRule(),
 )
 
 

@@ -122,6 +122,10 @@ def read_sigproc_header(f) -> SigprocHeader:
             # cannot skip an unknown binary value, so fail loudly instead.
             raise ValueError(f"unknown SIGPROC header parameter: {key!r}")
     hdr.size = f.tell() - start
+    if hdr.nchans <= 0 or hdr.nbits <= 0:
+        raise InputFileError(
+            f"invalid SIGPROC header: nchans={hdr.nchans}, "
+            f"nbits={hdr.nbits} (both must be positive)")
     if hdr.nsamples == 0:
         # Infer from file size (header.hpp:394-401)
         pos = f.tell()
@@ -209,12 +213,26 @@ class TimeSeries:
 
 
 def read_filterbank(filename: str) -> Filterbank:
-    """Load a whole SIGPROC filterbank into RAM (filterbank.hpp:218-240)."""
+    """Load a whole SIGPROC filterbank into RAM (filterbank.hpp:218-240).
+
+    A truncated file — the header promises more samples than the bytes
+    that follow — raises :class:`InputFileError` WITH the byte counts,
+    instead of surfacing as a numpy reshape error deep inside unpack.
+    The survey scheduler's retry layer (serve/retry.py) classifies
+    exactly this error as quarantine-immediately.
+    """
     with open(filename, "rb") as f:
         hdr = read_sigproc_header(f)
         nbytes = hdr.nsamples * hdr.nbits * hdr.nchans // 8
         f.seek(hdr.size)
-        raw = np.frombuffer(f.read(nbytes), dtype=np.uint8)
+        buf = f.read(nbytes)
+        if len(buf) < nbytes:
+            raise InputFileError(
+                f"truncated filterbank {filename!r}: header promises "
+                f"{hdr.nsamples} samples x {hdr.nchans} chans at "
+                f"{hdr.nbits}-bit = {nbytes} data bytes, but only "
+                f"{len(buf)} bytes follow the {hdr.size}-byte header")
+        raw = np.frombuffer(buf, dtype=np.uint8)
     if hdr.nbits == 32:
         data = raw.view(np.float32).reshape(hdr.nsamples, hdr.nchans)
     else:

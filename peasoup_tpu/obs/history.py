@@ -14,7 +14,7 @@ Record schema (``v`` = 1; consumers tolerate additions)::
 
     v               int     record schema version
     ts              str     ISO-8601 UTC timestamp
-    kind            str     "bench" | "micro" | "production" | ...
+    kind            str     "bench" | "micro" | "production" | "serve"
     git             {sha, dirty}
     device          {kind, backend, count}
     mesh_shape      [int]   device mesh (absent for single-device)
@@ -25,6 +25,12 @@ Record schema (``v`` = 1; consumers tolerate additions)::
     compile_counts  {name: int}      jit compile statistics
     parity          str     "ok" or the failure summary
     config          {...}   benchmark configuration echo
+
+``kind == "serve"`` records are appended by the survey worker's drain
+loop (``serve/worker.py``) with metrics ``jobs_claimed``,
+``jobs_succeeded``, ``jobs_failed``, ``elapsed_s`` and
+``jobs_per_hour`` — the survey-throughput headline the perf tooling
+trends alongside the per-run benchmark figures.
 
 Ledger I/O never raises into a benchmark run: append/load failures
 warn and return best-effort results.

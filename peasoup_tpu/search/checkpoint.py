@@ -7,9 +7,12 @@ per-DM candidate lists every ``interval`` trials and the mesh driver
 checkpoints once after its (single-dispatch) search, so a re-run with
 the same input and configuration resumes instead of recomputing.
 
-The checkpoint key ties the file to the exact search: input path,
-filterbank geometry, and every ``SearchConfig`` field.  A key mismatch
-invalidates the checkpoint with a warning (the search runs afresh).
+The checkpoint key ties the file to the exact search: observation
+CONTENT identity (header fields + data geometry, NOT the input path —
+a survey spool must be relocatable without invalidating every resume,
+serve/queue.py), and every result-affecting ``SearchConfig`` field.
+A key mismatch invalidates the checkpoint with a warning (the search
+runs afresh).
 """
 
 from __future__ import annotations
@@ -23,22 +26,30 @@ import numpy as np
 from ..data.candidates import Candidate
 from ..errors import CheckpointError
 from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
 
 # v3: append-only JSONL — header line then one line per completed DM
 # row, so each save is O(rows added) not O(all rows accumulated)
 # (v2 re-serialised the whole dict per save: O(ndm^2/interval) I/O
 # over a run; v1 was pickle — dropped because unpickling a user-named
-# file executes arbitrary code on a substituted checkpoint)
-_FORMAT_VERSION = 3
+# file executes arbitrary code on a substituted checkpoint).
+# v4: the key's input identity is the header/geometry fingerprint, not
+# the absolute path — moving or renaming the observation (or the whole
+# spool) no longer discards a resume; paths are advisory header fields
+_FORMAT_VERSION = 4
 
 
 # presentation/runtime knobs that do not change the search's results
 # (note: compact_capacity and max_num_threads DO stay in the key — both
-# can alter the mesh driver's candidate set via buffer truncation)
+# can alter the mesh driver's candidate set via buffer truncation).
+# Sidecar PATHS (kill/zap/dm_file) are non-identity like the input
+# path: their CONTENT enters the key via the digests below, so editing
+# a sidecar still invalidates but relocating it does not.
 _NON_IDENTITY_FIELDS = {
     "verbose", "progress_bar", "checkpoint_file", "checkpoint_interval",
     "outdir", "accel_chunk", "dump_dir", "measure_stages", "tune_file",
-    "events_log", "metrics_json",
+    "events_log", "metrics_json", "infilename", "killfilename",
+    "zapfilename", "dm_file",
 }
 
 
@@ -55,11 +66,32 @@ def _file_digest(path: str) -> str:
         return "<unreadable>"
 
 
-def search_key(infile: str, fil, config) -> str:
-    """Stable identity of a search (input + geometry + parameters).
+def observation_fingerprint(fil) -> str:
+    """Content identity of an observation: sha256 over every SIGPROC
+    header field plus the loaded data geometry.  Two copies of the
+    same filterbank fingerprint identically wherever they live; any
+    header or geometry difference (tsamp, fch1, nbits, sample count,
+    even source_name) separates them."""
+    import hashlib
 
-    Kill/zap sidecar files enter by CONTENT hash, not just path, so
-    editing them between crash and resume invalidates the checkpoint.
+    h = hashlib.sha256()
+    for k, v in sorted(fil.header.to_dict().items()):
+        h.update(f"{k}={v!r};".encode())
+    h.update(f"nsamps={fil.nsamps};nchans={fil.nchans}".encode())
+    return h.hexdigest()
+
+
+def search_key(infile: str, fil, config) -> str:
+    """Stable identity of a search (observation content + geometry +
+    parameters).
+
+    The input enters by header/geometry FINGERPRINT, not by path:
+    relocating a spool directory (or the observation itself) must not
+    invalidate every resume (``infile`` is kept in the signature as
+    an advisory-only argument for callers and the checkpoint header).
+    Kill/zap/dm-list sidecar files likewise enter by CONTENT hash, so
+    editing one between crash and resume invalidates the checkpoint
+    but moving it does not.
     """
     hdr = fil.header
     cfg_items = sorted(
@@ -72,7 +104,7 @@ def search_key(infile: str, fil, config) -> str:
         if k not in _NON_IDENTITY_FIELDS
     )
     return repr((
-        _FORMAT_VERSION, os.path.abspath(infile or config.infilename),
+        _FORMAT_VERSION, observation_fingerprint(fil),
         fil.nsamps, fil.nchans, hdr.nbits, float(hdr.tsamp),
         float(hdr.fch1), float(hdr.foff), cfg_items,
         _file_digest(config.killfilename),
@@ -129,10 +161,15 @@ class SearchCheckpoint:
     JSON, not pickle: the path is user-named, and unpickling a
     corrupted or substituted file would execute arbitrary code."""
 
-    def __init__(self, path: str, key: str, interval: int = 8):
+    def __init__(self, path: str, key: str, interval: int = 8,
+                 advisory: dict | None = None):
         self.path = path
         self.key = key
         self.interval = max(int(interval), 1)
+        #: informational header fields (e.g. the input path at save
+        #: time) — written alongside version/key, NEVER compared on
+        #: load: the key carries the content identity
+        self.advisory = dict(advisory or {})
         self._since_save = 0
         self._written: set[int] = set()
         self._resuming = False  # load() found a valid same-key file
@@ -210,6 +247,10 @@ class SearchCheckpoint:
             good_bytes += len(line.encode("utf-8"))
         self._written = set(out)
         self._resuming = True
+        # resume observability: the survey worker's smoke/serve tests
+        # assert a re-claimed job resumed instead of recomputing
+        METRICS.inc("checkpoint.resumes")
+        METRICS.inc("checkpoint.rows_resumed", len(out))
         return out
 
     def _append_rows(self, cands_by_dm: dict) -> None:
@@ -219,8 +260,8 @@ class SearchCheckpoint:
         mode = "a" if (self._resuming or self._written) else "w"
         with open(self.path, mode) as f:
             if mode == "w":
-                json.dump({"version": _FORMAT_VERSION, "key": self.key},
-                          f)
+                json.dump({"version": _FORMAT_VERSION, "key": self.key,
+                           **self.advisory}, f)
                 f.write("\n")
             for k in new:
                 json.dump({"dm_idx": int(k),

@@ -682,6 +682,7 @@ class PulsarSearch:
             cfg.checkpoint_file,
             search_key(cfg.infilename, self.fil, cfg),
             cfg.checkpoint_interval,
+            advisory={"input": cfg.infilename},
         )
         return ckpt, (ckpt.load() or {})
 

@@ -1,0 +1,47 @@
+"""Survey scheduler: multi-observation job queue + worker + store.
+
+The reference pipeline is one-shot — ``src/pipeline_multi.cu:33-81``
+dispenses DM trials to GPU workers inside one process, which handles
+exactly one filterbank and exits.  A real survey queues thousands of
+observations against a fixed device slice and has to survive corrupt
+beams, flaky runs and worker crashes.  This package is that layer:
+
+* :mod:`~peasoup_tpu.serve.queue` — durable on-disk job spool
+  (``pending/running/done/failed`` with atomic-rename claims, safe
+  for multiple worker processes on one machine);
+* :mod:`~peasoup_tpu.serve.worker` — long-running driver that claims
+  jobs by priority, runs the existing search pipeline on each,
+  overlaps the next observation's read+unpack with the current
+  search, and buckets filterbank geometry so jitted programs are
+  reused across jobs;
+* :mod:`~peasoup_tpu.serve.retry` — failure classification, bounded
+  exponential backoff, per-job timeout (and the ONE sanctioned
+  ``time.sleep`` site — lint rule PSL008);
+* :mod:`~peasoup_tpu.serve.store` — append-only cross-run candidate
+  store with survey-level dedup/coincidence queries;
+* :mod:`~peasoup_tpu.serve.cli` — ``python -m peasoup_tpu.serve``
+  with ``submit`` / ``worker`` / ``status`` / ``requeue`` verbs.
+"""
+
+from .queue import JobRecord, JobSpool
+from .retry import (
+    QUARANTINE,
+    RETRY,
+    BackoffPolicy,
+    JobTimeoutError,
+    classify_failure,
+)
+from .store import CandidateStore
+from .worker import SurveyWorker
+
+__all__ = [
+    "JobRecord",
+    "JobSpool",
+    "BackoffPolicy",
+    "JobTimeoutError",
+    "classify_failure",
+    "QUARANTINE",
+    "RETRY",
+    "CandidateStore",
+    "SurveyWorker",
+]

@@ -1,0 +1,200 @@
+"""``python -m peasoup_tpu.serve`` — the survey scheduler CLI.
+
+Verbs::
+
+    submit  <files...> [--priority N] [--set key=value ...]
+    worker  [--drain] [--max-jobs N] [--poll S] [--single_device] ...
+    status  [--jobs]
+    requeue <job_ids...> | --running | --failed
+
+All verbs take ``--spool DIR`` (default ``./jobs``): the durable spool
+directory described in serve/queue.py.  ``submit`` enqueues
+observations; ``worker`` claims and runs them (``--drain`` exits when
+the queue empties, otherwise it polls forever); ``status`` prints the
+queue + store state; ``requeue`` recovers jobs from a crashed worker
+(``running/``) or retries quarantined ones (``failed/``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def _parse_override(text: str) -> tuple[str, object]:
+    """``key=value`` with the value coerced like the main CLI would:
+    int, then float, then bool literals, else string."""
+    if "=" not in text:
+        from ..errors import ConfigError
+
+        raise ConfigError(f"--set expects key=value, got {text!r}")
+    key, raw = text.split("=", 1)
+    for cast in (int, float):
+        try:
+            return key, cast(raw)
+        except ValueError:
+            pass
+    if raw.lower() in ("true", "false"):
+        return key, raw.lower() == "true"
+    return key, raw
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-serve",
+        description="Peasoup-TPU - survey scheduler (job spool + "
+                    "workers + candidate store)",
+    )
+    p.add_argument("--spool", default="./jobs",
+                   help="spool directory (pending/running/done/failed)")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    ps = sub.add_parser("submit", help="enqueue observations")
+    ps.add_argument("inputs", nargs="+", help="filterbank files")
+    ps.add_argument("--priority", type=int, default=0,
+                    help="higher claims first (FIFO within a band)")
+    ps.add_argument("--set", dest="overrides", action="append",
+                    default=[], metavar="KEY=VALUE",
+                    help="SearchConfig override (repeatable), e.g. "
+                         "--set dm_end=120 --set npdmp=8")
+
+    pw = sub.add_parser("worker", help="claim and run jobs")
+    pw.add_argument("--drain", action="store_true",
+                    help="exit when the queue is empty (default: "
+                         "poll forever)")
+    pw.add_argument("--max-jobs", type=int, default=None,
+                    help="stop after claiming this many jobs")
+    pw.add_argument("--poll", type=float, default=5.0,
+                    help="idle poll interval in seconds (no --drain)")
+    pw.add_argument("--timeout", type=float, default=0.0,
+                    help="per-job wall-clock budget in seconds "
+                         "(0 = unlimited)")
+    pw.add_argument("--max-attempts", type=int, default=3,
+                    help="bounded retries before a job is failed")
+    pw.add_argument("--backoff-base", type=float, default=1.0,
+                    help="first-retry backoff in seconds (doubles "
+                         "per attempt, capped at 60)")
+    pw.add_argument("--single_device", action="store_true",
+                    help="host-loop driver instead of the mesh")
+    pw.add_argument("-t", "--num_threads", type=int, default=14,
+                    dest="max_num_threads",
+                    help="device cap for the mesh driver")
+    pw.add_argument("--no-prefetch", action="store_true",
+                    help="disable next-observation read overlap")
+    pw.add_argument("--history", default=None,
+                    help="throughput ledger path (default: the repo "
+                         "benchmarks/history.jsonl)")
+
+    pt = sub.add_parser("status", help="queue + store summary")
+    pt.add_argument("--jobs", action="store_true",
+                    help="list individual jobs per state")
+
+    pr = sub.add_parser("requeue", help="move jobs back to pending")
+    pr.add_argument("job_ids", nargs="*", help="specific job ids")
+    pr.add_argument("--running", action="store_true",
+                    help="requeue every running job (crashed worker "
+                         "recovery)")
+    pr.add_argument("--failed", action="store_true",
+                    help="requeue every failed job (operator retry)")
+    return p
+
+
+def cmd_submit(spool, args) -> int:
+    overrides = dict(_parse_override(o) for o in args.overrides)
+    for path in args.inputs:
+        rec = spool.submit(path, overrides, priority=args.priority)
+        print(f"submitted {rec.job_id}  priority={rec.priority}  "
+              f"{rec.input}")
+    return 0
+
+
+def cmd_worker(spool, args) -> int:
+    from ..obs.events import configure_event_log
+    from ..utils import enable_compile_cache
+    from .retry import BackoffPolicy
+    from .worker import SurveyWorker
+
+    enable_compile_cache()
+    configure_event_log(os.path.join(spool.root, "worker-events.jsonl"))
+    worker = SurveyWorker(
+        spool,
+        backoff=BackoffPolicy(max_attempts=args.max_attempts,
+                              base_s=args.backoff_base),
+        timeout_s=args.timeout,
+        single_device=args.single_device,
+        max_devices=args.max_num_threads,
+        prefetch=not args.no_prefetch,
+        history_path=args.history,
+    )
+    summary = worker.drain(max_jobs=args.max_jobs,
+                           wait=not args.drain, poll_s=args.poll)
+    print(f"worker {worker.worker_id}: {summary['succeeded']}/"
+          f"{summary['claimed']} jobs ok in {summary['elapsed_s']}s "
+          f"({summary['jobs_per_hour']} jobs/h, "
+          f"{summary['geometry_buckets']} geometry bucket(s))")
+    return 0 if summary["failed"] == 0 else 1
+
+
+def cmd_status(spool, args) -> int:
+    from .store import CandidateStore
+
+    counts = spool.counts()
+    print("state     jobs")
+    for state, n in counts.items():
+        print(f"{state:<9}{n:>5}")
+    store = CandidateStore(
+        os.path.join(spool.root, "candidates.jsonl"))
+    print(f"store     {store.count():>5} candidates from "
+          f"{len(store.sources())} observation(s)")
+    pending = spool.pending_jobs()
+    if pending:
+        oldest = time.time() - pending[-1].submitted_utc
+        print(f"oldest pending: {oldest:.0f}s")
+    if args.jobs:
+        for state in counts:
+            for rec in spool.jobs(state):
+                extra = ""
+                if rec.failures:
+                    last = rec.failures[-1]
+                    extra = (f"  [{last.get('classification')}] "
+                             f"{last.get('error', '')[:60]}")
+                print(f"{state:<9}{rec.job_id}  prio={rec.priority} "
+                      f"attempts={rec.attempts}  {rec.input}{extra}")
+    return 0
+
+
+def cmd_requeue(spool, args) -> int:
+    ids = list(args.job_ids)
+    if args.running:
+        ids += [r.job_id for r in spool.jobs("running")]
+    if args.failed:
+        ids += [r.job_id for r in spool.jobs("failed")]
+    if not ids:
+        print("nothing to requeue (give job ids, --running or "
+              "--failed)", file=sys.stderr)
+        return 1
+    for job_id in ids:
+        rec = spool.requeue(job_id)
+        print(f"requeued {rec.job_id}  attempts={rec.attempts}  "
+              f"{rec.input}")
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(
+        sys.argv[1:] if argv is None else argv)
+    from .queue import JobSpool
+
+    spool = JobSpool(args.spool)
+    return {
+        "submit": cmd_submit,
+        "worker": cmd_worker,
+        "status": cmd_status,
+        "requeue": cmd_requeue,
+    }[args.verb](spool, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,257 @@
+"""Durable on-disk job spool for survey scheduling.
+
+One job = one observation: an input filterbank path plus its
+``SearchConfig`` overrides, a priority and an attempt count.  Layout
+(one JSON record file per job under the spool root)::
+
+    <spool>/pending/<job_id>.json    submitted, claimable
+    <spool>/running/<job_id>.json    claimed by a worker
+    <spool>/done/<job_id>.json       finished, result summary attached
+    <spool>/failed/<job_id>.json     quarantined or retry-exhausted
+    <spool>/work/<job_id>/           per-job scratch: checkpoint file,
+                                     output directory, failure reports
+    <spool>/candidates.jsonl         cross-run candidate store
+                                     (serve/store.py default path)
+
+A job changes state by ``os.rename`` of its record file — atomic on
+POSIX — so any number of worker processes on one machine can claim
+from the same spool with no lock service: exactly one rename wins,
+the losers get ``FileNotFoundError`` and try the next candidate.
+This is the reference's pthread-mutex trial dispenser
+(`pipeline_multi.cu:33-46`) lifted to observation granularity, with
+the queue surviving process death.  Record *contents* are always
+rewritten in place (tmp + ``os.replace``) BEFORE the state rename, so
+a reader never sees a torn or stale record in the new state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+
+from ..errors import ConfigError
+from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
+
+#: spool subdirectories, in lifecycle order
+STATES = ("pending", "running", "done", "failed")
+
+_RECORD_VERSION = 1
+
+
+@dataclass
+class JobRecord:
+    """One observation job (the JSON record's in-memory face)."""
+
+    job_id: str
+    input: str
+    priority: int = 0
+    overrides: dict = field(default_factory=dict)
+    attempts: int = 0
+    submitted_utc: float = 0.0
+    claimed_utc: float = 0.0
+    finished_utc: float = 0.0
+    worker: str = ""
+    #: one entry per failed attempt: {utc, attempt, classification,
+    #: error, traceback, run_report}
+    failures: list = field(default_factory=list)
+    #: success summary (candidate counts, outdir) set by mark_done
+    summary: dict = field(default_factory=dict)
+    v: int = _RECORD_VERSION
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "JobRecord":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in obj.items() if k in known})
+
+
+def _new_job_id() -> str:
+    """Unique, roughly submit-ordered id (ns timestamp + random tail:
+    two submits in the same nanosecond still cannot collide)."""
+    return f"{time.time_ns():016x}-{os.urandom(3).hex()}"
+
+
+class JobSpool:
+    """Priority job queue over the directory layout above."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        for state in STATES:
+            os.makedirs(os.path.join(self.root, state), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "work"), exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def _path(self, state: str, job_id: str) -> str:
+        return os.path.join(self.root, state, f"{job_id}.json")
+
+    def work_dir(self, job_id: str) -> str:
+        """Per-job scratch directory (checkpoint, outputs, reports)."""
+        d = os.path.join(self.root, "work", job_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    # -- record I/O --------------------------------------------------------
+
+    def _write(self, path: str, rec: JobRecord) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(rec.to_json() + "\n")
+        os.replace(tmp, path)
+
+    def _read(self, path: str) -> JobRecord | None:
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+            return JobRecord.from_obj(obj)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, TypeError) as exc:
+            warn_event(
+                "job_record_corrupt",
+                f"unreadable job record {path!r}: {exc}",
+                path=path, error=str(exc),
+            )
+            return None
+
+    # -- submit / claim ----------------------------------------------------
+
+    def submit(self, input_path: str, overrides: dict | None = None,
+               priority: int = 0) -> JobRecord:
+        """Enqueue one observation; returns the pending record."""
+        rec = JobRecord(
+            job_id=_new_job_id(),
+            input=os.path.abspath(input_path),
+            priority=int(priority),
+            overrides=dict(overrides or {}),
+            submitted_utc=time.time(),
+        )
+        self._write(self._path("pending", rec.job_id), rec)
+        METRICS.inc("scheduler.submitted")
+        return rec
+
+    def pending_jobs(self) -> list[JobRecord]:
+        """Claimable jobs, best-first: priority descending, then
+        submit time (FIFO within a priority band)."""
+        out = []
+        pend = os.path.join(self.root, "pending")
+        for name in os.listdir(pend):
+            if not name.endswith(".json"):
+                continue
+            rec = self._read(os.path.join(pend, name))
+            if rec is not None:
+                out.append(rec)
+        out.sort(key=lambda r: (-r.priority, r.submitted_utc, r.job_id))
+        return out
+
+    def peek(self) -> JobRecord | None:
+        """Best pending job WITHOUT claiming it (the worker's prefetch
+        hint; another worker may still win the claim)."""
+        jobs = self.pending_jobs()
+        return jobs[0] if jobs else None
+
+    def claim(self, worker: str = "") -> JobRecord | None:
+        """Claim the best pending job via atomic rename, or None.
+
+        Safe against concurrent claimers: the rename is the arbiter,
+        a lost race just moves on to the next candidate.
+        """
+        for rec in self.pending_jobs():
+            src = self._path("pending", rec.job_id)
+            dst = self._path("running", rec.job_id)
+            try:
+                os.rename(src, dst)
+            except FileNotFoundError:
+                continue  # another worker won this one
+            rec.worker = worker
+            rec.claimed_utc = time.time()
+            rec.attempts += 1
+            self._write(dst, rec)
+            METRICS.inc("scheduler.claimed")
+            METRICS.observe(
+                "queue_wait", rec.claimed_utc - rec.submitted_utc)
+            return rec
+        return None
+
+    # -- state transitions (record rewritten BEFORE the rename) ------------
+
+    def _transition(self, rec: JobRecord, src_state: str,
+                    dst_state: str) -> None:
+        src = self._path(src_state, rec.job_id)
+        if not os.path.exists(src):
+            raise ConfigError(
+                f"job {rec.job_id} is not in {src_state}/ (spool "
+                f"{self.root})")
+        self._write(src, rec)
+        os.rename(src, self._path(dst_state, rec.job_id))
+
+    def update(self, rec: JobRecord, state: str = "running") -> None:
+        """Rewrite a record in place (attempt metadata, failure log)."""
+        self._write(self._path(state, rec.job_id), rec)
+
+    def mark_done(self, rec: JobRecord, summary: dict | None = None) -> None:
+        rec.finished_utc = time.time()
+        if summary:
+            rec.summary = dict(summary)
+        self._transition(rec, "running", "done")
+
+    def mark_failed(self, rec: JobRecord) -> None:
+        """running -> failed (the failure log on the record says why:
+        quarantined input vs exhausted retries)."""
+        rec.finished_utc = time.time()
+        self._transition(rec, "running", "failed")
+
+    def release(self, rec: JobRecord) -> None:
+        """running -> pending for a bounded retry (attempt count and
+        failure log travel with the record)."""
+        self._transition(rec, "running", "pending")
+
+    def requeue(self, job_id: str) -> JobRecord:
+        """Recover a job from ``running/`` (crashed worker) or
+        ``failed/`` (operator retry) back to ``pending/``."""
+        for state in ("running", "failed"):
+            path = self._path(state, job_id)
+            rec = self._read(path)
+            if rec is not None:
+                rec.worker = ""
+                self._transition(rec, state, "pending")
+                METRICS.inc("scheduler.requeued")
+                return rec
+        raise ConfigError(
+            f"job {job_id!r} not found in running/ or failed/ "
+            f"(spool {self.root})")
+
+    # -- inspection --------------------------------------------------------
+
+    def get(self, job_id: str) -> tuple[str, JobRecord] | None:
+        for state in STATES:
+            rec = self._read(self._path(state, job_id))
+            if rec is not None:
+                return state, rec
+        return None
+
+    def jobs(self, state: str) -> list[JobRecord]:
+        if state not in STATES:
+            raise ConfigError(
+                f"unknown spool state {state!r}; use one of {STATES}")
+        d = os.path.join(self.root, state)
+        out = []
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".json"):
+                rec = self._read(os.path.join(d, name))
+                if rec is not None:
+                    out.append(rec)
+        return out
+
+    def counts(self) -> dict[str, int]:
+        return {
+            state: sum(
+                1 for n in os.listdir(os.path.join(self.root, state))
+                if n.endswith(".json"))
+            for state in STATES
+        }

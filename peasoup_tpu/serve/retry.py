@@ -1,0 +1,123 @@
+"""Failure classification, bounded backoff, per-job timeout.
+
+One corrupt beam must never stall a survey queue: a failure is either
+**quarantine** (deterministic — the same input + config will fail the
+same way every time: malformed filterbank, bad overrides, out-of-
+domain parameters, un-fittable HBM budget) and goes straight to
+``failed/``, or **retry** (possibly transient — a flaky device, a
+preempted slice, an interrupted fetch) and goes back to ``pending/``
+after an exponential-backoff delay, up to ``max_attempts``.
+
+This module is also the ONE place in the codebase allowed to call
+``time.sleep`` (lint rule PSL008): every scheduler wait routes through
+:func:`pause` / :class:`BackoffPolicy`, so waits are bounded,
+classified, and injectable in tests (pass a fake ``sleeper``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..errors import (
+    ConfigError,
+    DomainError,
+    HBMBudgetError,
+    InputFileError,
+    PeasoupError,
+)
+
+#: classification labels stored on the job's failure log
+QUARANTINE = "quarantine"
+RETRY = "retry"
+
+
+class JobTimeoutError(PeasoupError, RuntimeError):
+    """A job exceeded its per-job wall-clock budget."""
+
+
+def classify_failure(exc: BaseException) -> str:
+    """``QUARANTINE`` for deterministic failures, ``RETRY`` otherwise.
+
+    Deterministic means re-running the identical job cannot succeed:
+    * :class:`InputFileError` — truncated/malformed filterbank or
+      sidecar (io/sigproc.py raises this with the byte counts);
+    * :class:`ConfigError` / :class:`DomainError` — the job's
+      overrides are invalid or numerically out of domain;
+    * :class:`HBMBudgetError` — the search cannot fit the configured
+      budget even after chunking;
+    * a missing/unreadable input path.
+
+    Everything else — including :class:`JobTimeoutError` and raw
+    ``RuntimeError`` from a flaky device — is worth a bounded retry.
+    New failure classes: add the mapping here WITH a test in
+    ``tests/test_serve.py`` (see CONTRIBUTING "Failure
+    classification").
+    """
+    if isinstance(exc, (InputFileError, ConfigError, DomainError,
+                        HBMBudgetError)):
+        return QUARANTINE
+    if isinstance(exc, (FileNotFoundError, IsADirectoryError,
+                        NotADirectoryError, PermissionError)):
+        return QUARANTINE
+    return RETRY
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Bounded exponential backoff: attempt ``k`` (1-based) waits
+    ``min(base_s * factor**(k-1), max_s)`` before re-queueing."""
+
+    max_attempts: int = 3
+    base_s: float = 1.0
+    factor: float = 2.0
+    max_s: float = 60.0
+
+    def delay_for(self, attempt: int) -> float:
+        k = max(int(attempt), 1)
+        return float(min(self.base_s * self.factor ** (k - 1), self.max_s))
+
+    def exhausted(self, attempt: int) -> bool:
+        return int(attempt) >= self.max_attempts
+
+
+def pause(seconds: float, sleeper=None) -> None:
+    """The one sanctioned wait (PSL008).  ``sleeper`` is injectable so
+    tests assert on delays instead of serving them."""
+    if seconds and seconds > 0:
+        (sleeper or time.sleep)(float(seconds))
+
+
+def run_with_timeout(fn, timeout_s: float, label: str = "job"):
+    """Run ``fn()`` with a wall-clock budget.
+
+    ``timeout_s <= 0`` runs inline (no thread).  On timeout a
+    :class:`JobTimeoutError` is raised — classified as RETRY — and the
+    worker thread is abandoned as a daemon (a blocked XLA dispatch
+    cannot be interrupted from Python; the abandoned attempt finishes
+    or dies with the process, and the job record has already moved
+    on).  Exceptions from ``fn`` propagate unchanged.
+    """
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box: dict = {}
+
+    def _target():
+        try:
+            box["result"] = fn()
+        except BaseException as exc:  # propagated to the caller below
+            box["error"] = exc
+
+    t = threading.Thread(target=_target, daemon=True,
+                         name=f"serve-{label}")
+    t.start()
+    t.join(float(timeout_s))
+    if t.is_alive():
+        raise JobTimeoutError(
+            f"{label} exceeded its {timeout_s:.1f}s budget (the "
+            f"attempt thread is abandoned; the job is eligible for "
+            f"retry)")
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")

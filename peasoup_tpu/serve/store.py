@@ -1,0 +1,172 @@
+"""Cross-run candidate store: append-only JSONL + survey queries.
+
+Each job's *distilled* candidates (the per-observation dedup already
+done by the search's distiller chain) are appended here as flat JSON
+records, one line each, so a survey accumulates one queryable ledger
+across thousands of observations — the role PRESTO-style survey
+processing fills downstream of each beam.  Queries reuse the search's
+own matching machinery (``search/distill.py``, the same fractional-
+harmonic and frequency-ratio predicates ``search/coincidence.py``
+builds its beam matching on): :meth:`CandidateStore.query` finds
+records harmonically related to a frequency, and
+:meth:`CandidateStore.coincident_groups` groups detections of the
+same signal across *different* observations — the survey-level
+coincidence pass (a pulsar repeats across epochs; RFI repeats across
+everything).
+
+Record schema (``v`` = 1; consumers tolerate additions)::
+
+    v          int    record schema version
+    job_id     str    spool job that produced the record
+    source     str    input filterbank path (the observation)
+    utc        float  ingest time (unix seconds)
+    dm, acc, freq, snr, folded_snr, nh, period   candidate fields
+
+Store I/O follows the ledger rules (obs/history.py): appends are one
+atomic line write; corrupt/torn lines are skipped on load so a killed
+worker cannot poison the survey.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+STORE_VERSION = 1
+
+
+def _record_from_candidate(job_id: str, source: str, cand,
+                           utc: float) -> dict:
+    return {
+        "v": STORE_VERSION,
+        "job_id": str(job_id),
+        "source": str(source),
+        "utc": round(float(utc), 3),
+        "dm": round(float(cand.dm), 6),
+        "acc": round(float(cand.acc), 6),
+        "freq": float(cand.freq),
+        "snr": round(float(cand.snr), 4),
+        "folded_snr": round(float(cand.folded_snr), 4),
+        "nh": int(cand.nh),
+        "period": (1.0 / float(cand.freq)) if cand.freq else 0.0,
+    }
+
+
+class CandidateStore:
+    """Append-only JSONL candidate ledger with survey-level queries."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # -- ingest ------------------------------------------------------------
+
+    def ingest(self, job_id: str, source: str, candidates,
+               utc: float | None = None) -> int:
+        """Append one job's distilled candidates; returns the count."""
+        utc = time.time() if utc is None else utc
+        recs = [
+            _record_from_candidate(job_id, source, c, utc)
+            for c in candidates
+        ]
+        if not recs:
+            return 0
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return len(recs)
+
+    # -- load / filter -----------------------------------------------------
+
+    def records(self, source: str | None = None,
+                min_snr: float | None = None) -> list[dict]:
+        """All records in file order; corrupt lines skipped."""
+        out: list[dict] = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a killed worker
+                if not isinstance(rec, dict) or "freq" not in rec:
+                    continue
+                if source is not None and rec.get("source") != source:
+                    continue
+                if min_snr is not None and \
+                        rec.get("snr", 0.0) < min_snr:
+                    continue
+                out.append(rec)
+        return out
+
+    def count(self) -> int:
+        return len(self.records())
+
+    def sources(self) -> list[str]:
+        """Distinct observations that contributed records."""
+        return sorted({r.get("source", "") for r in self.records()})
+
+    # -- survey queries ----------------------------------------------------
+
+    def query(self, freq: float, freq_tol: float = 1e-4,
+              max_harm: int = 1) -> list[dict]:
+        """Records harmonically related to ``freq`` across the survey.
+
+        The same fractional-ratio predicate as the search's
+        ``HarmonicDistiller``: a record at ``f`` matches when
+        ``k*f / (j*freq)`` lies within ``1 ± freq_tol`` for some
+        integer ``j, k <= max_harm`` (``max_harm=1`` is a plain
+        frequency-ratio match).
+        """
+        recs = self.records()
+        if not recs:
+            return []
+        freqs = np.array([r["freq"] for r in recs], np.float64)
+        # numerator and denominator harmonics both range 1..max_harm
+        hh = np.arange(1, int(max_harm) + 1, dtype=np.float64)
+        # ratio[i, k, j] = hh[k] * f_i / (hh[j] * freq)
+        ratio = (hh[None, :, None] * freqs[:, None, None]
+                 / (hh[None, None, :] * float(freq)))
+        ok = ((ratio > 1 - freq_tol) & (ratio < 1 + freq_tol)).any(
+            axis=(1, 2))
+        return [r for r, hit in zip(recs, ok) if hit]
+
+    def coincident_groups(self, freq_tol: float = 1e-4,
+                          min_sources: int = 2) -> list[list[dict]]:
+        """Groups of records matching in frequency across at least
+        ``min_sources`` DISTINCT observations, strongest first.
+
+        Reuses ``search/distill.py``'s ``DMDistiller`` greedy
+        SNR-sorted matching (frequency ratio within tolerance
+        regardless of DM) — the candidate-level analogue of the beam
+        coincidencer — so store matching can never drift from the
+        in-run distillation semantics.
+        """
+        from ..data.candidates import Candidate
+        from ..search.distill import DMDistiller
+
+        recs = self.records()
+        if not recs:
+            return []
+        cands = [
+            Candidate(dm=r.get("dm", 0.0), snr=r.get("snr", 0.0),
+                      freq=r["freq"])
+            for r in recs
+        ]
+        by_id = {id(c): r for c, r in zip(cands, recs)}
+        fundamentals = DMDistiller(freq_tol, True).distill(cands)
+        groups: list[list[dict]] = []
+        for fund in fundamentals:
+            family = [by_id[id(c)] for c in fund.collect()]
+            if len({r["source"] for r in family}) >= min_sources:
+                groups.append(family)
+        return groups

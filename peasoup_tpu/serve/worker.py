@@ -1,0 +1,382 @@
+"""Long-running survey worker: claim -> search -> ingest, repeated.
+
+The driver that turns the single-shot pipeline into a service.  Per
+job it
+
+1. claims the best pending job (priority, then FIFO) from the spool;
+2. reads the observation — from the prefetch slot when the previous
+   iteration already fetched it (see below) — and builds the existing
+   :class:`~peasoup_tpu.search.pipeline.PulsarSearch` /
+   :class:`~peasoup_tpu.parallel.mesh.MeshPulsarSearch` on it;
+3. kicks a background read+unpack of the NEXT pending observation, so
+   host I/O overlaps the current job's device search — the
+   ``utils/hostfetch``-style double buffering of the chunked driver,
+   lifted to observation granularity;
+4. runs the search under a ``Job-<id>`` root span, writes the usual
+   per-run artefacts (overview.xml, run_report.json) into the job's
+   work directory, and ingests the distilled candidates into the
+   cross-run store;
+5. on failure, classifies (serve/retry.py): quarantine straight to
+   ``failed/``, transient back to ``pending/`` after backoff, with
+   the captured run report + traceback on the job record either way.
+
+Program reuse across jobs: jitted programs are keyed by array shapes,
+so the worker buckets each observation's geometry to the plan shapes
+— observations whose sample counts share a power-of-two FFT size are
+LOSSLESSLY trimmed to ``size + max_delay + 1`` samples (the search
+reads nothing beyond that: trials use the first ``size`` columns and
+the fold's power-of-two length is preserved by the ``+ 1``), so every
+job in the bucket replays the already-compiled programs instead of
+paying a per-observation XLA compile.
+
+Per-job checkpointing: each job gets a checkpoint file in its work
+directory, so a worker killed mid-job resumes that job's completed DM
+rows on the next claim instead of recomputing from scratch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import replace
+
+from ..errors import ConfigError
+from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
+from ..obs.trace import span
+from .queue import JobRecord, JobSpool
+from .retry import (
+    QUARANTINE,
+    BackoffPolicy,
+    classify_failure,
+    pause,
+    run_with_timeout,
+)
+from .store import CandidateStore
+
+
+class ObservationPrefetcher:
+    """Single-slot background filterbank reader (double buffering at
+    observation granularity).
+
+    ``start(path)`` spawns a daemon thread reading + unpacking the
+    file while the caller's search occupies the devices; ``take(path)``
+    joins and hands the :class:`Filterbank` over — or returns None on
+    a slot miss (a different job won the claim) or a read error (the
+    claimer's own synchronous read then raises the real, classifiable
+    exception in job context).
+    """
+
+    def __init__(self):
+        self._thread: threading.Thread | None = None
+        self._path: str | None = None
+        self._result = None
+        self._error: BaseException | None = None
+
+    def start(self, path: str) -> None:
+        if self._path == path:
+            return  # already in flight (or landed) for this path
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join()  # reads are short next to a search
+        self._path = path
+        self._result = None
+        self._error = None
+
+        def _read():
+            from ..io.sigproc import read_filterbank
+
+            try:
+                self._result = read_filterbank(path)
+            except BaseException as exc:
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=_read, daemon=True, name="serve-prefetch")
+        self._thread.start()
+
+    def take(self, path: str):
+        if self._path != path:
+            METRICS.inc("scheduler.prefetch_misses")
+            return None
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        result, error = self._result, self._error
+        self._path = self._result = self._error = None
+        if error is not None or result is None:
+            METRICS.inc("scheduler.prefetch_misses")
+            return None
+        METRICS.inc("scheduler.prefetch_hits")
+        return result
+
+
+class SurveyWorker:
+    """Claims and runs spool jobs until the queue drains (or a job
+    budget is reached).
+
+    ``run_job_fn`` is injectable for tests: it replaces the real
+    search (:meth:`_run_job`) but keeps the whole claim / classify /
+    retry / quarantine machinery live.  ``sleeper`` routes backoff
+    waits (serve/retry.py) to a fake in tests.
+    """
+
+    def __init__(self, spool: JobSpool, store: CandidateStore | None = None,
+                 *, base_config=None, backoff: BackoffPolicy | None = None,
+                 timeout_s: float = 0.0, single_device: bool = False,
+                 max_devices: int | None = None, worker_id: str = "",
+                 prefetch: bool = True, run_job_fn=None,
+                 history_path: str | None = None, sleeper=None):
+        self.spool = spool
+        self.store = store if store is not None else CandidateStore(
+            os.path.join(spool.root, "candidates.jsonl"))
+        self.base_config = base_config
+        self.backoff = backoff if backoff is not None else BackoffPolicy()
+        self.timeout_s = float(timeout_s)
+        self.single_device = single_device
+        self.max_devices = max_devices
+        self.worker_id = worker_id or f"worker-{os.getpid()}"
+        self.prefetch = prefetch
+        self.run_job_fn = run_job_fn
+        self.history_path = history_path
+        self.sleeper = sleeper
+        self._prefetcher = ObservationPrefetcher()
+        #: geometry bucket -> jobs served (program-reuse accounting)
+        self.geometries: dict[tuple, int] = {}
+
+    # -- config / geometry -------------------------------------------------
+
+    def _job_config(self, job: JobRecord):
+        """Base config + the job's overrides + per-job spool paths."""
+        from ..search.plan import SearchConfig
+
+        cfg = (replace(self.base_config) if self.base_config is not None
+               else SearchConfig())
+        for key, val in (job.overrides or {}).items():
+            if not hasattr(cfg, key):
+                raise ConfigError(
+                    f"job {job.job_id}: unknown SearchConfig override "
+                    f"{key!r}")
+            setattr(cfg, key, val)
+        cfg.infilename = job.input
+        work = self.spool.work_dir(job.job_id)
+        cfg.outdir = os.path.join(work, "out")
+        if not cfg.checkpoint_file:
+            # crash-resume: a re-claimed job resumes its completed DM
+            # rows instead of recomputing (search/checkpoint.py keys
+            # on header content, so the spool can even be relocated)
+            cfg.checkpoint_file = os.path.join(work, "search.ckpt")
+        return cfg
+
+    def _build_search(self, fil, cfg):
+        """Construct the search, bucketing geometry for program reuse
+        (lossless trim — see module docstring)."""
+        if self.single_device:
+            from ..search.pipeline import PulsarSearch
+
+            make = lambda f: PulsarSearch(f, cfg)
+        else:
+            from ..parallel.mesh import MeshPulsarSearch
+
+            make = lambda f: MeshPulsarSearch(
+                f, cfg, max_devices=self.max_devices)
+        search = make(fil)
+        keep = search.size + search.max_delay + 1
+        if fil.nsamps > keep:
+            from ..io.sigproc import Filterbank
+
+            cfg.size = search.size  # pin: the trim must not shrink it
+            hdr = replace(fil.header, nsamples=keep)
+            fil = Filterbank(header=hdr, data=fil.data[:keep])
+            search = make(fil)
+            METRICS.inc("scheduler.geometry_trimmed")
+        gkey = (fil.nchans, fil.header.nbits, search.size,
+                int(search.out_nsamps), len(search.dm_list))
+        if gkey in self.geometries:
+            METRICS.inc("scheduler.plan_reuse")
+        self.geometries[gkey] = self.geometries.get(gkey, 0) + 1
+        return fil, search
+
+    # -- one job -----------------------------------------------------------
+
+    def _run_job(self, job: JobRecord) -> dict:
+        from ..cli import write_search_output
+        from ..io.sigproc import read_filterbank
+        from ..obs.events import configure_event_log
+
+        cfg = self._job_config(job)
+        configure_event_log(
+            os.path.join(self.spool.work_dir(job.job_id),
+                         "events.jsonl"))
+        fil = self._prefetcher.take(job.input) if self.prefetch else None
+        if fil is None:
+            with span("Observation-Read", metric="obs_read",
+                      input=job.input):
+                fil = read_filterbank(job.input)
+        fil, search = self._build_search(fil, cfg)
+        # overlap the NEXT observation's read+unpack with this search
+        if self.prefetch:
+            nxt = self.spool.peek()
+            if nxt is not None:
+                self._prefetcher.start(nxt.input)
+        result = search.run()
+        write_search_output(result, cfg.outdir)
+        ingested = self.store.ingest(
+            job.job_id, job.input, result.candidates)
+        best = max((float(c.snr) for c in result.candidates),
+                   default=0.0)
+        return {
+            "candidates": len(result.candidates),
+            "ingested": ingested,
+            "best_snr": round(best, 4),
+            "outdir": cfg.outdir,
+            "timers": {k: round(float(v), 3)
+                       for k, v in result.timers.items()},
+        }
+
+    def _capture_failure_report(self, job: JobRecord) -> str:
+        """Snapshot the run's telemetry (stage timers, counters,
+        events up to the crash) next to the job; best effort."""
+        path = os.path.join(
+            self.spool.work_dir(job.job_id),
+            f"run_report.attempt{job.attempts}.json")
+        try:
+            from ..obs.report import write_run_report
+
+            write_run_report(path)
+        except Exception:
+            return ""
+        return path
+
+    def _handle_failure(self, job: JobRecord, exc: BaseException) -> None:
+        kind = classify_failure(exc)
+        job.failures.append({
+            "utc": round(time.time(), 3),
+            "attempt": job.attempts,
+            "classification": kind,
+            "error": f"{type(exc).__name__}: {exc}",
+            "traceback": traceback.format_exc(),
+            "run_report": self._capture_failure_report(job),
+        })
+        if kind == QUARANTINE:
+            warn_event(
+                "job_quarantined",
+                f"job {job.job_id} quarantined (attempt "
+                f"{job.attempts}): {exc}",
+                job_id=job.job_id, input=job.input,
+                error=str(exc),
+            )
+            METRICS.inc("scheduler.quarantined")
+            self.spool.mark_failed(job)
+        elif self.backoff.exhausted(job.attempts):
+            warn_event(
+                "job_retries_exhausted",
+                f"job {job.job_id} failed {job.attempts} attempts; "
+                f"giving up: {exc}",
+                job_id=job.job_id, input=job.input,
+                attempts=job.attempts, error=str(exc),
+            )
+            METRICS.inc("scheduler.exhausted")
+            self.spool.mark_failed(job)
+        else:
+            delay = self.backoff.delay_for(job.attempts)
+            warn_event(
+                "job_retry",
+                f"job {job.job_id} attempt {job.attempts} failed "
+                f"({type(exc).__name__}); re-queueing with "
+                f"{delay:.1f}s backoff",
+                job_id=job.job_id, attempt=job.attempts,
+                delay_s=delay, error=str(exc),
+            )
+            METRICS.inc("scheduler.retried")
+            self.spool.release(job)
+            pause(delay, self.sleeper)
+
+    def run_one(self, job: JobRecord) -> bool:
+        """Run one claimed job through the retry machinery; True on
+        success."""
+        runner = self.run_job_fn or self._run_job
+        with span(f"Job-{job.job_id}", metric="job",
+                  job_id=job.job_id, input=job.input,
+                  attempt=job.attempts, priority=job.priority):
+            try:
+                summary = run_with_timeout(
+                    lambda: runner(job), self.timeout_s,
+                    label=f"job {job.job_id}")
+            except Exception as exc:
+                self._handle_failure(job, exc)
+                return False
+        self.spool.mark_done(job, summary if isinstance(summary, dict)
+                             else {})
+        METRICS.inc("scheduler.succeeded")
+        return True
+
+    # -- the drain loop ----------------------------------------------------
+
+    def drain(self, max_jobs: int | None = None, wait: bool = False,
+              poll_s: float = 5.0) -> dict:
+        """Claim and run jobs until the queue is empty (or ``wait``
+        to poll for more), appending one throughput record to the
+        bench history ledger (obs/history.py, kind ``serve``)."""
+        from ..obs.metrics import install_compile_hook
+
+        install_compile_hook()
+        t0 = time.time()
+        claimed = succeeded = 0
+        while max_jobs is None or claimed < max_jobs:
+            job = self.spool.claim(self.worker_id)
+            if job is None:
+                if not wait:
+                    break
+                pause(poll_s, self.sleeper)
+                continue
+            claimed += 1
+            if self.run_one(job):
+                succeeded += 1
+        elapsed = time.time() - t0
+        jobs_per_hour = (succeeded / (elapsed / 3600.0)
+                         if elapsed > 0 else 0.0)
+        METRICS.gauge("scheduler.jobs_per_hour", jobs_per_hour)
+        summary = {
+            "claimed": claimed,
+            "succeeded": succeeded,
+            "failed": claimed - succeeded,
+            "elapsed_s": round(elapsed, 3),
+            "jobs_per_hour": round(jobs_per_hour, 3),
+            "geometry_buckets": len(self.geometries),
+        }
+        self._append_throughput(summary)
+        return summary
+
+    def _append_throughput(self, summary: dict) -> None:
+        """One ledger record per drain (the survey-level counterpart
+        of bench.py's per-run records; jobs_per_hour is the headline
+        metric the README schema table documents)."""
+        if summary["claimed"] == 0:
+            return  # an empty poll is not a throughput sample
+        from ..obs.history import (
+            append_history,
+            make_history_record,
+            stage_device_seconds,
+        )
+
+        snap = METRICS.snapshot()
+        rec = make_history_record(
+            "serve",
+            {
+                "jobs_claimed": summary["claimed"],
+                "jobs_succeeded": summary["succeeded"],
+                "jobs_failed": summary["failed"],
+                "elapsed_s": summary["elapsed_s"],
+                "jobs_per_hour": summary["jobs_per_hour"],
+            },
+            stage_device_s=stage_device_seconds(snap),
+            config={
+                "spool": self.spool.root,
+                "worker": self.worker_id,
+                "single_device": self.single_device,
+                "geometry_buckets": summary["geometry_buckets"],
+            },
+        )
+        append_history(rec, self.history_path)

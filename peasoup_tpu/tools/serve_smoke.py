@@ -1,0 +1,213 @@
+"""Survey-scheduler smoke test (``make serve-smoke``).
+
+Phase 1 — drain: spool three synthetic observations (one deliberately
+truncated mid-data), run ``worker --drain``, and assert the terminal
+state the scheduler promises: two jobs in ``done/`` with their
+distilled candidates in the cross-run store, ONE quarantined job in
+``failed/`` carrying the :class:`InputFileError` byte counts, the
+scheduler counters consistent, and a ``serve`` throughput record
+(jobs/hour) appended to the bench history ledger.
+
+Phase 2 — crash-resume: submit a fourth observation, fail its first
+attempt mid-search after several checkpointed DM trials (a controlled
+stand-in for a killed worker), and assert the retry attempt RESUMES
+from the per-job checkpoint (``checkpoint.rows_resumed`` > 0) instead
+of recomputing, finishing the job in ``done/``.
+
+Exit status 0 only if every assertion holds — CI-gateable like
+``trace-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import warnings
+
+import numpy as np
+
+
+def _write_synthetic(path: str, nsamps: int = 4096, nchans: int = 16,
+                     seed: int = 0, truncate_bytes: int = 0) -> str:
+    """A small 8-bit filterbank with a pulse train; ``truncate_bytes``
+    chops the data section short of what the header (written WITH
+    nsamples, so the promise is explicit) declares."""
+    from peasoup_tpu.io.sigproc import (
+        SigprocHeader, write_sigproc_header,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    with open(path, "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        payload = data.tobytes()
+        if truncate_bytes:
+            payload = payload[:-truncate_bytes]
+        f.write(payload)
+    return path
+
+
+def _check(ok: bool, what: str, failures: list[str]) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        failures.append(what)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-serve-smoke",
+        description="Peasoup-TPU - survey scheduler smoke test",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-serve-smoke",
+                   help="scratch directory (wiped)")
+    p.add_argument("--history", default=None,
+                   help="history ledger to append to (default: the "
+                        "repo benchmarks/history.jsonl)")
+    args = p.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir)
+    spool_dir = os.path.join(args.dir, "jobs")
+
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve import (
+        BackoffPolicy, CandidateStore, JobSpool, SurveyWorker,
+    )
+
+    REGISTRY.reset()
+    spool = JobSpool(spool_dir)
+    fils = [
+        _write_synthetic(os.path.join(args.dir, f"obs{i}.fil"), seed=i)
+        for i in range(2)
+    ]
+    truncated = _write_synthetic(
+        os.path.join(args.dir, "obs_truncated.fil"), seed=2,
+        truncate_bytes=1024)
+    overrides = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0,
+                 "limit": 10}
+    for path in fils + [truncated]:
+        spool.submit(path, overrides)
+
+    failures: list[str] = []
+    worker = SurveyWorker(
+        spool, single_device=True,
+        backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+        history_path=args.history, sleeper=lambda s: None,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # quarantine warns by design
+        summary = worker.drain()
+
+    counts = spool.counts()
+    _check(counts["done"] == 2, "2 jobs in done/", failures)
+    _check(counts["failed"] == 1, "1 job in failed/", failures)
+    _check(counts["pending"] == counts["running"] == 0,
+           "queue fully drained", failures)
+
+    bad = spool.jobs("failed")
+    quarantined = bool(bad) and all(
+        f["classification"] == "quarantine"
+        and "truncated filterbank" in f["error"]
+        and "bytes" in f["error"]
+        for f in bad[0].failures
+    )
+    _check(quarantined,
+           "truncated observation quarantined with byte counts",
+           failures)
+    _check(bool(bad) and bad[0].attempts == 1,
+           "quarantine is immediate (no retries burned)", failures)
+
+    store = CandidateStore(os.path.join(spool_dir, "candidates.jsonl"))
+    n_store = store.count()
+    _check(n_store > 0 and len(store.sources()) == 2,
+           f"store holds {n_store} candidates from 2 observations",
+           failures)
+
+    counters = REGISTRY.snapshot()["counters"]
+    _check(counters.get("scheduler.claimed") == 3
+           and counters.get("scheduler.succeeded") == 2
+           and counters.get("scheduler.quarantined") == 1,
+           "scheduler counters: claimed=3 succeeded=2 quarantined=1",
+           failures)
+    _check(summary["jobs_per_hour"] > 0, "jobs/hour computed", failures)
+
+    from peasoup_tpu.obs.history import load_history
+
+    serve_recs = load_history(args.history, kinds=["serve"])
+    ok_rec = bool(serve_recs) and \
+        serve_recs[-1]["metrics"].get("jobs_per_hour", 0) > 0 and \
+        serve_recs[-1]["metrics"].get("jobs_succeeded") == 2
+    _check(ok_rec, "throughput record in benchmarks/history.jsonl",
+           failures)
+
+    # ---- phase 2: crash mid-job, requeue, resume via checkpoint ------
+    from peasoup_tpu.search.pipeline import PulsarSearch
+
+    REGISTRY.reset()
+    crash_fil = _write_synthetic(
+        os.path.join(args.dir, "obs_crash.fil"), seed=3)
+    spool.submit(crash_fil, {**overrides, "checkpoint_interval": 1})
+
+    orig = PulsarSearch.search_dm_trial
+    state = {"calls": 0, "resumed_calls": 0, "crashed": False}
+
+    def _crashing(self, trials, idx):
+        if not state["crashed"]:
+            state["calls"] += 1
+            if state["calls"] > 5:
+                state["crashed"] = True
+                raise RuntimeError("injected mid-job crash")
+        else:
+            state["resumed_calls"] += 1
+        return orig(self, trials, idx)
+
+    PulsarSearch.search_dm_trial = _crashing
+    try:
+        worker2 = SurveyWorker(
+            spool, single_device=True,
+            backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+            history_path=args.history, sleeper=lambda s: None,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            worker2.drain()
+    finally:
+        PulsarSearch.search_dm_trial = orig
+
+    counters = REGISTRY.snapshot()["counters"]
+    _check(spool.counts()["done"] == 3,
+           "crashed job retried to done/", failures)
+    _check(counters.get("scheduler.retried", 0) == 1,
+           "first attempt classified transient and re-queued",
+           failures)
+    resumed = counters.get("checkpoint.rows_resumed", 0)
+    _check(resumed >= 5,
+           f"retry resumed {resumed} checkpointed DM rows instead of "
+           f"recomputing", failures)
+
+    status = spool.get(spool.jobs("done")[-1].job_id)
+    report_ok = False
+    if status is not None:
+        outdir = status[1].summary.get("outdir", "")
+        report = os.path.join(outdir, "run_report.json")
+        if os.path.exists(report):
+            report_ok = json.load(open(report)).get(
+                "candidates", {}).get("count", 0) >= 0
+    _check(report_ok, "per-job run_report.json written", failures)
+
+    if failures:
+        print(f"\nserve-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("\nserve-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -110,6 +110,74 @@ def test_checkpoint_key_invalidation(tutorial_fil, tmp_path):
     assert search_key("", fil, cfg_d) != key_a
 
 
+def _synth_fil(path, tsamp=0.000256, nsamps=1024, nchans=8, seed=0):
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=tsamp,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    write_filterbank(str(path), Filterbank(header=hdr, data=data))
+    return str(path)
+
+
+def test_checkpoint_key_survives_relocation(tmp_path):
+    """Migration (v4 keys): the key is the observation's header/
+    geometry fingerprint, NOT its path — relocating a spool directory
+    (or the file itself) must not invalidate a resume."""
+    import shutil
+
+    dir_a = tmp_path / "spool_a"
+    dir_b = tmp_path / "relocated"
+    dir_a.mkdir()
+    dir_b.mkdir()
+    path_a = _synth_fil(dir_a / "obs.fil")
+    path_b = str(dir_b / "renamed.fil")
+    shutil.copy(path_a, path_b)
+
+    fil_a = read_filterbank(path_a)
+    fil_b = read_filterbank(path_b)
+    cfg_a = SearchConfig(infilename=path_a, **CFG)
+    cfg_b = SearchConfig(infilename=path_b, **CFG)
+    key_a = search_key(path_a, fil_a, cfg_a)
+    key_b = search_key(path_b, fil_b, cfg_b)
+    # the path (argument AND config field) is advisory only
+    assert key_a == key_b
+
+    # a checkpoint written against the original location loads after
+    # the move — the actual resume migration
+    ck = str(tmp_path / "moved.ckpt")
+    c = SearchCheckpoint(ck, key_a, advisory={"input": path_a})
+    c.save({0: []})
+    assert SearchCheckpoint(ck, key_b).load() == {0: []}
+
+    # content changes still invalidate: a different observation (other
+    # header geometry) must not alias the key
+    path_c = _synth_fil(dir_a / "other.fil", tsamp=0.000512)
+    fil_c = read_filterbank(path_c)
+    assert search_key(path_c, fil_c,
+                      SearchConfig(infilename=path_c, **CFG)) != key_a
+
+
+def test_checkpoint_header_carries_advisory_path(tmp_path):
+    """The input path is kept on the checkpoint header line for
+    operators, but never compared on load."""
+    import json
+
+    path = _synth_fil(tmp_path / "obs.fil")
+    fil = read_filterbank(path)
+    key = search_key(path, fil, SearchConfig(**CFG))
+    ck = str(tmp_path / "adv.ckpt")
+    c = SearchCheckpoint(ck, key, advisory={"input": path})
+    c.save({0: []})
+    with open(ck) as f:
+        header = json.loads(f.readline())
+    assert header["input"] == path
+    assert SearchCheckpoint(ck, key).load() == {0: []}
+
+
 def test_checkpoint_key_tracks_sidecar_contents(tutorial_fil, tmp_path):
     fil = read_filterbank(tutorial_fil)
     zap = tmp_path / "z.txt"

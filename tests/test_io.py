@@ -61,6 +61,50 @@ def test_header_roundtrip():
         assert getattr(parsed, key) == getattr(hdr, key)
 
 
+@pytest.mark.parametrize("nbits", [8, 32])
+def test_truncated_filterbank_raises_input_file_error(tmp_path, nbits):
+    """A short read must surface as a typed InputFileError WITH the
+    byte counts (the survey scheduler quarantines on it), not as a
+    numpy reshape error deep inside unpack."""
+    from peasoup_tpu.errors import InputFileError
+
+    rng = np.random.default_rng(3)
+    nsamps, nchans = 256, 8
+    if nbits == 32:
+        data = rng.normal(size=(nsamps, nchans)).astype(np.float32)
+    else:
+        data = rng.integers(0, 255, size=(nsamps, nchans),
+                            dtype=np.uint8)
+    hdr = SigprocHeader(tsamp=1e-4, fch1=1400.0, foff=-0.5,
+                        nchans=nchans, nbits=nbits, nifs=1,
+                        data_type=1, nsamples=nsamps)
+    path = str(tmp_path / "trunc.fil")
+    # header written WITH nsamples: the promise the data must honour
+    with open(path, "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        f.write(data.tobytes()[:-100])
+    with pytest.raises(InputFileError) as exc_info:
+        read_filterbank(path)
+    msg = str(exc_info.value)
+    expected = nsamps * nchans * nbits // 8
+    assert "truncated" in msg
+    assert str(expected) in msg            # promised byte count
+    assert str(expected - 100) in msg      # actual byte count
+
+
+def test_zero_nchans_header_rejected(tmp_path):
+    """nchans/nbits of 0 must be a typed error, not a ZeroDivision
+    in the nsamples inference."""
+    from peasoup_tpu.errors import InputFileError
+
+    hdr = SigprocHeader(tsamp=1e-4, fch1=1400.0, nchans=0, nbits=8)
+    buf = io.BytesIO()
+    write_sigproc_header(buf, hdr)
+    buf.seek(0)
+    with pytest.raises(InputFileError, match="nchans"):
+        read_sigproc_header(buf)
+
+
 def test_filterbank_roundtrip(tmp_path):
     rng = np.random.default_rng(2)
     data = rng.integers(0, 4, size=(512, 16), dtype=np.uint8)

@@ -297,6 +297,46 @@ def test_psl007_pragma_escape(tmp_path):
     assert suppressed == 1
 
 
+def test_psl008_bare_sleep_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import time
+
+        def poll():
+            while True:
+                time.sleep(5)
+    """, relpath="peasoup_tpu/serve/fixture.py")
+    assert [v.rule for v in vs] == ["PSL008"]
+    assert "BackoffPolicy" in vs[0].message or "retry" in vs[0].message
+
+
+def test_psl008_from_import_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        from time import sleep
+    """, relpath="peasoup_tpu/utils/fixture.py")
+    assert [v.rule for v in vs] == ["PSL008"]
+
+
+def test_psl008_retry_is_the_exempt_home(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import time
+
+        def pause(seconds):
+            time.sleep(seconds)
+    """, relpath="peasoup_tpu/serve/retry.py")
+    assert vs == []
+
+
+def test_psl008_pragma_escape(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        import time
+
+        def settle():
+            time.sleep(0.01)  # psl: disable=PSL008 -- hardware settle, not a retry loop
+    """, relpath="benchmarks/fixture.py")
+    assert vs == []
+    assert suppressed == 1
+
+
 # --------------------------------------------------------------------------
 # suppressions
 # --------------------------------------------------------------------------

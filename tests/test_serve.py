@@ -1,0 +1,467 @@
+"""Survey scheduler tests: spool atomicity, retry/quarantine, priority
+ordering, end-to-end drain with candidate-store assertions, crashed-
+worker recovery, and checkpoint resume across a retry."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.errors import ConfigError, InputFileError
+from peasoup_tpu.obs.metrics import REGISTRY
+from peasoup_tpu.serve import (
+    QUARANTINE,
+    RETRY,
+    BackoffPolicy,
+    CandidateStore,
+    JobSpool,
+    SurveyWorker,
+    classify_failure,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def _write_fil(path, nsamps=4096, nchans=16, seed=0, pulse=True):
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    if pulse:
+        data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    write_filterbank(str(path), Filterbank(header=hdr, data=data))
+    return str(path)
+
+
+def _write_truncated_fil(path, nsamps=4096, nchans=16, seed=0):
+    """Header promises ``nsamps`` but 1024 data bytes are missing."""
+    from peasoup_tpu.io.sigproc import (
+        SigprocHeader, write_sigproc_header,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    with open(str(path), "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        f.write(data.tobytes()[:-1024])
+    return str(path)
+
+
+#: fast search overrides shared by the end-to-end tests
+FAST = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0, "limit": 10}
+
+
+# --------------------------------------------------------------------------
+# spool mechanics
+# --------------------------------------------------------------------------
+
+def test_submit_claim_priority_order(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    lo = spool.submit("/tmp/lo.fil", priority=0)
+    hi = spool.submit("/tmp/hi.fil", priority=9)
+    mid = spool.submit("/tmp/mid.fil", priority=5)
+    lo2 = spool.submit("/tmp/lo2.fil", priority=0)
+    order = []
+    while True:
+        job = spool.claim("w")
+        if job is None:
+            break
+        order.append(job.job_id)
+        spool.mark_done(job)
+    # priority descending, FIFO within a band
+    assert order == [hi.job_id, mid.job_id, lo.job_id, lo2.job_id]
+
+
+def test_atomic_claim_under_concurrent_workers(tmp_path):
+    """Two workers hammering one spool: every job claimed exactly
+    once (the rename is the arbiter)."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    submitted = {spool.submit(f"/tmp/{i}.fil").job_id
+                 for i in range(24)}
+    claimed: dict[str, list] = {"a": [], "b": []}
+    barrier = threading.Barrier(2)
+
+    def _worker(name):
+        barrier.wait()
+        while True:
+            job = spool.claim(name)
+            if job is None:
+                return
+            claimed[name].append(job.job_id)
+
+    ts = [threading.Thread(target=_worker, args=(n,)) for n in "ab"]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    ids_a, ids_b = set(claimed["a"]), set(claimed["b"])
+    assert ids_a | ids_b == submitted
+    assert ids_a & ids_b == set()  # no double claim
+    assert spool.counts()["pending"] == 0
+    assert spool.counts()["running"] == 24
+
+
+def test_requeue_recovers_crashed_worker_job(tmp_path):
+    """A job stuck in running/ after a worker crash goes back to
+    pending via requeue, keeping its attempt count and record."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/x.fil", {"dm_end": 30.0}, priority=2)
+    job = spool.claim("doomed-worker")
+    assert job.attempts == 1
+    # the worker dies here; nothing releases the job
+    assert spool.counts()["running"] == 1
+    back = spool.requeue(job.job_id)
+    assert back.attempts == 1 and back.worker == ""
+    assert spool.counts() == {"pending": 1, "running": 0, "done": 0,
+                              "failed": 0}
+    again = spool.claim("w2")
+    assert again.job_id == rec.job_id
+    assert again.attempts == 2
+    assert again.overrides == {"dm_end": 30.0}
+    # unknown job ids are a typed error
+    with pytest.raises(ConfigError):
+        spool.requeue("no-such-job")
+
+
+def test_job_record_roundtrip_and_corrupt_record(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/x.fil", {"npdmp": 4}, priority=1)
+    state, loaded = spool.get(rec.job_id)
+    assert state == "pending"
+    assert loaded.overrides == {"npdmp": 4}
+    # corrupt record: warned and skipped, not a crash
+    bad = os.path.join(spool.root, "pending", "zzzz.json")
+    with open(bad, "w") as f:
+        f.write("{torn")
+    with pytest.warns(UserWarning, match="unreadable job record"):
+        jobs = spool.pending_jobs()
+    assert [j.job_id for j in jobs] == [rec.job_id]
+
+
+# --------------------------------------------------------------------------
+# retry / classification
+# --------------------------------------------------------------------------
+
+def test_classification_table():
+    assert classify_failure(InputFileError("bad")) == QUARANTINE
+    assert classify_failure(ConfigError("bad")) == QUARANTINE
+    assert classify_failure(FileNotFoundError("gone")) == QUARANTINE
+    assert classify_failure(RuntimeError("flaky")) == RETRY
+    assert classify_failure(OSError("io blip")) == RETRY
+    from peasoup_tpu.serve.retry import JobTimeoutError
+
+    assert classify_failure(JobTimeoutError("slow")) == RETRY
+
+
+def test_backoff_retry_then_exhaustion(tmp_path):
+    """A transiently-failing job is re-queued with exponential backoff
+    until max_attempts, then lands in failed/ with the full log."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/flaky.fil")
+    delays = []
+    worker = SurveyWorker(
+        spool,
+        backoff=BackoffPolicy(max_attempts=3, base_s=1.0, factor=2.0),
+        run_job_fn=lambda job: (_ for _ in ()).throw(
+            RuntimeError("flaky device")),
+        sleeper=delays.append,
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    with pytest.warns(UserWarning):
+        summary = worker.drain()
+    assert (summary["claimed"], summary["succeeded"],
+            summary["failed"]) == (3, 0, 3)
+    assert delays == [1.0, 2.0]  # backoff doubled, none after the last
+    counts = spool.counts()
+    assert counts["failed"] == 1 and counts["pending"] == 0
+    failed = spool.jobs("failed")[0]
+    assert failed.attempts == 3
+    assert [f["classification"] for f in failed.failures] == [RETRY] * 3
+    assert all("flaky device" in f["error"] for f in failed.failures)
+    assert all("RuntimeError" in f["traceback"]
+               for f in failed.failures)
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.retried"] == 2
+    assert counters["scheduler.exhausted"] == 1
+
+
+def test_quarantine_skips_retries(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/corrupt.fil")
+    delays = []
+    worker = SurveyWorker(
+        spool,
+        backoff=BackoffPolicy(max_attempts=5),
+        run_job_fn=lambda job: (_ for _ in ()).throw(
+            InputFileError("truncated filterbank: 64 of 128 bytes")),
+        sleeper=delays.append,
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    with pytest.warns(UserWarning, match="quarantined"):
+        worker.drain()
+    assert delays == []  # no backoff burned on a deterministic failure
+    failed = spool.jobs("failed")[0]
+    assert failed.attempts == 1
+    assert failed.failures[0]["classification"] == QUARANTINE
+    assert REGISTRY.snapshot()["counters"]["scheduler.quarantined"] == 1
+
+
+def test_per_job_timeout_classified_transient(tmp_path):
+    import time as _time
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/slow.fil")
+    worker = SurveyWorker(
+        spool, timeout_s=0.1,
+        backoff=BackoffPolicy(max_attempts=1),
+        run_job_fn=lambda job: _time.sleep(5.0),
+        sleeper=lambda s: None,
+        history_path=str(tmp_path / "h.jsonl"),
+    )
+    with pytest.warns(UserWarning):
+        worker.drain()
+    failed = spool.jobs("failed")[0]
+    assert failed.failures[0]["classification"] == RETRY
+    assert "budget" in failed.failures[0]["error"]
+
+
+# --------------------------------------------------------------------------
+# candidate store
+# --------------------------------------------------------------------------
+
+class _C:
+    def __init__(self, freq, snr, dm=10.0):
+        self.freq = freq
+        self.snr = snr
+        self.dm = dm
+        self.acc = 0.0
+        self.folded_snr = 0.0
+        self.nh = 0
+
+
+def test_store_ingest_query_and_coincidence(tmp_path):
+    store = CandidateStore(str(tmp_path / "cands.jsonl"))
+    # the same 10 Hz signal in two observations, plus unrelated noise
+    store.ingest("j1", "beamA.fil", [_C(10.0, 12.0), _C(3.3, 9.0)])
+    store.ingest("j2", "beamB.fil", [_C(10.0004, 11.0)])
+    store.ingest("j3", "beamC.fil", [_C(77.7, 9.5)])
+    assert store.count() == 4
+    assert store.sources() == ["beamA.fil", "beamB.fil", "beamC.fil"]
+
+    hits = store.query(10.0, freq_tol=1e-3)
+    assert sorted(r["source"] for r in hits) == ["beamA.fil",
+                                                "beamB.fil"]
+    # harmonic-aware: 20 Hz record matches a 10 Hz lookup at max_harm 2
+    store.ingest("j4", "beamD.fil", [_C(20.0, 8.0)])
+    hits = store.query(10.0, freq_tol=1e-3, max_harm=2)
+    assert "beamD.fil" in {r["source"] for r in hits}
+
+    groups = store.coincident_groups(freq_tol=1e-3, min_sources=2)
+    assert len(groups) == 1
+    grp = groups[0]
+    assert {r["source"] for r in grp} >= {"beamA.fil", "beamB.fil"}
+    # strongest detection leads the group (distiller ordering)
+    assert grp[0]["snr"] == 12.0
+
+
+def test_store_tolerates_torn_tail(tmp_path):
+    store = CandidateStore(str(tmp_path / "cands.jsonl"))
+    store.ingest("j1", "a.fil", [_C(5.0, 10.0)])
+    with open(store.path, "a") as f:
+        f.write('{"v": 1, "job_id": "torn"')  # killed mid-append
+    assert store.count() == 1
+
+
+# --------------------------------------------------------------------------
+# end-to-end: drain a real spool through the real pipeline
+# --------------------------------------------------------------------------
+
+def test_worker_drain_end_to_end(tmp_path):
+    """Three synthetic observations (one truncated) through the CLI
+    worker: 2 done with candidates in the store, 1 quarantined with
+    the byte counts, scheduler counters + throughput ledger record."""
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    ledger = str(tmp_path / "history.jsonl")
+    good1 = _write_fil(tmp_path / "obs1.fil", seed=1)
+    good2 = _write_fil(tmp_path / "obs2.fil", seed=2)
+    bad = _write_truncated_fil(tmp_path / "obs3.fil", seed=3)
+
+    rc = main(["--spool", spool_dir, "submit", good1, bad,
+               "--set", "dm_end=20.0", "--set", "min_snr=6.0",
+               "--set", "npdmp=0", "--set", "limit=10"])
+    assert rc == 0
+    rc = main(["--spool", spool_dir, "submit", good2, "--priority", "5",
+               "--set", "dm_end=20.0", "--set", "min_snr=6.0",
+               "--set", "npdmp=0", "--set", "limit=10"])
+    assert rc == 0
+
+    with pytest.warns(UserWarning, match="quarantined"):
+        rc = main(["--spool", spool_dir, "worker", "--drain",
+                   "--single_device", "--max-attempts", "2",
+                   "--backoff-base", "0", "--history", ledger])
+    assert rc == 1  # nonzero: a job failed
+
+    spool = JobSpool(spool_dir)
+    counts = spool.counts()
+    assert counts["done"] == 2 and counts["failed"] == 1
+    # the high-priority job ran first despite submitting last
+    done = sorted(spool.jobs("done"), key=lambda r: r.claimed_utc)
+    assert done[0].input == good2
+    for rec in done:
+        assert rec.summary["candidates"] >= 1
+        report = os.path.join(rec.summary["outdir"], "run_report.json")
+        assert json.load(open(report))["candidates"]["count"] >= 1
+
+    failed = spool.jobs("failed")[0]
+    assert failed.input == bad
+    assert failed.failures[0]["classification"] == QUARANTINE
+    assert "truncated filterbank" in failed.failures[0]["error"]
+    assert failed.attempts == 1  # quarantine is immediate
+
+    store = CandidateStore(os.path.join(spool_dir, "candidates.jsonl"))
+    assert store.count() >= 2
+    assert set(store.sources()) == {good1, good2}
+    for rec in store.records():
+        assert rec["job_id"] and rec["snr"] >= 6.0
+
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.submitted"] == 3
+    assert counters["scheduler.claimed"] == 3
+    assert counters["scheduler.succeeded"] == 2
+    assert counters["scheduler.quarantined"] == 1
+    # the second good observation was prefetched while the first ran
+    assert counters.get("scheduler.prefetch_hits", 0) >= 1
+    # identical geometry -> one plan bucket, programs reused
+    assert counters.get("scheduler.plan_reuse", 0) >= 1
+
+    from peasoup_tpu.obs.history import load_history
+
+    recs = load_history(ledger, kinds=["serve"])
+    assert len(recs) == 1
+    assert recs[0]["metrics"]["jobs_succeeded"] == 2
+    assert recs[0]["metrics"]["jobs_per_hour"] > 0
+    assert recs[0]["config"]["geometry_buckets"] >= 1
+
+    # status verb renders without blowing up
+    rc = main(["--spool", spool_dir, "status", "--jobs"])
+    assert rc == 0
+
+
+def test_crashed_job_resumes_from_checkpoint(tmp_path, monkeypatch):
+    """A job that dies mid-search is re-queued; the retry must RESUME
+    the checkpointed DM rows, not recompute them."""
+    from peasoup_tpu.search.pipeline import PulsarSearch
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    fil = _write_fil(tmp_path / "obs.fil", seed=7)
+    spool.submit(fil, {**FAST, "checkpoint_interval": 1})
+
+    orig = PulsarSearch.search_dm_trial
+    seen: dict[str, list] = {"first": [], "second": []}
+
+    def _crashing(self, trials, idx):
+        phase = "first" if not seen["second"] and \
+            len(seen["first"]) <= 5 else "second"
+        if phase == "first":
+            seen["first"].append(idx)
+            if len(seen["first"]) > 5:
+                raise RuntimeError("injected crash")
+        else:
+            seen["second"].append(idx)
+        return orig(self, trials, idx)
+
+    monkeypatch.setattr(PulsarSearch, "search_dm_trial", _crashing)
+    worker = SurveyWorker(
+        spool, single_device=True,
+        backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+        history_path=str(tmp_path / "h.jsonl"),
+        sleeper=lambda s: None,
+    )
+    with pytest.warns(UserWarning, match="re-queueing"):
+        summary = worker.drain()
+
+    assert summary["succeeded"] == 1
+    assert spool.counts()["done"] == 1
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.retried"] == 1
+    assert counters["checkpoint.rows_resumed"] >= 5
+    # the resumed attempt never re-searched the checkpointed rows
+    assert not set(seen["second"]) & set(seen["first"][:-1])
+
+
+def test_worker_rejects_unknown_override_as_quarantine(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    fil = _write_fil(tmp_path / "obs.fil")
+    spool.submit(fil, {"not_a_knob": 1})
+    worker = SurveyWorker(spool, single_device=True,
+                          sleeper=lambda s: None,
+                          history_path=str(tmp_path / "h.jsonl"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        worker.drain()
+    failed = spool.jobs("failed")[0]
+    assert failed.failures[0]["classification"] == QUARANTINE
+    assert "not_a_knob" in failed.failures[0]["error"]
+
+
+def test_geometry_bucketing_is_lossless(tmp_path):
+    """Two observations whose sample counts share an FFT bucket must
+    land in ONE geometry bucket, and trimming must not change the
+    candidates (same data prefix => same results as the full read)."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, 32, size=(4500, 16), dtype=np.uint8)
+    base[::16] += 60
+
+    def _write(path, nsamps):
+        from peasoup_tpu.io.sigproc import (
+            Filterbank, SigprocHeader, write_filterbank,
+        )
+
+        hdr = SigprocHeader(nbits=8, nchans=16, tsamp=0.000256,
+                            fch1=1510.0, foff=-10.0, nsamples=nsamps)
+        write_filterbank(str(path),
+                         Filterbank(header=hdr, data=base[:nsamps]))
+        return str(path)
+
+    a = _write(tmp_path / "a.fil", 4400)
+    b = _write(tmp_path / "b.fil", 4500)
+    spool = JobSpool(str(tmp_path / "jobs"))
+    for path in (a, b):
+        spool.submit(path, FAST)
+    worker = SurveyWorker(spool, single_device=True,
+                          prefetch=False, sleeper=lambda s: None,
+                          history_path=str(tmp_path / "h.jsonl"))
+    summary = worker.drain()
+    assert summary["succeeded"] == 2
+    assert summary["geometry_buckets"] == 1
+    assert REGISTRY.snapshot()["counters"]["scheduler.plan_reuse"] == 1
+
+    # parity: the trimmed search returns exactly the full search's
+    # candidates for observation a
+    cfg = SearchConfig(**FAST)
+    full = PulsarSearch(read_filterbank(a), cfg).run()
+    store = CandidateStore(os.path.join(spool.root,
+                                        "candidates.jsonl"))
+    got = [(round(r["freq"], 6), round(r["snr"], 3))
+           for r in store.records(source=a)]
+    want = [(round(float(c.freq), 6), round(float(c.snr), 3))
+            for c in full.candidates]
+    assert got == want
