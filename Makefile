@@ -6,8 +6,11 @@ TUTORIAL ?= /root/reference/example_data/tutorial.fil
 SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
 
-.PHONY: lint test bench perf-gate trace-smoke serve-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke
 
+# covers the whole tree incl. ops/peaks_pallas.py against the
+# committed (near-empty) baseline — new kernels land lint-clean, no
+# grandfathering (tests/test_lint.py pins this per-file too)
 lint:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.analysis
 
@@ -20,9 +23,21 @@ bench:
 # noise-aware perf regression gate over benchmarks/history.jsonl (+ the
 # legacy BENCH_r0*.json artifacts): fails when the newest record's gate
 # metric exceeds the trailing-window median by the threshold factor.
-# `python bench.py --gate` is the run-then-gate spelling for hardware CI.
+# Besides wall-clock (e2e_s) the gate also checks the per-stage device
+# -time columns (peaks_device_s, search_device_s — ISSUE 6): a sort
+# -wall regression must trip even when tunnel jitter hides it from
+# wall-clock.  `python bench.py --gate` is the run-then-gate spelling
+# for hardware CI.
 perf-gate:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.perf_report --gate
+
+# peak-extraction shape-stability sweep, one subprocess per
+# (C, stop, cap) cell so a backend crash is recorded as an unsafe cell
+# instead of killing the sweep (full grid writes
+# benchmarks/peaks_sweep.json; the smoke runs one safe cell)
+peaks-sweep-smoke:
+	JAX_PLATFORMS=cpu $(PY) benchmarks/peaks_sweep.py --quick \
+	    --out /tmp/peasoup-peaks-sweep.json --iters 4
 
 # span-tracing smoke test: a tutorial run must write a parseable
 # Chrome trace whose span names cover the five pipeline stages

@@ -258,6 +258,7 @@ def main() -> None:
     # utilization — the bench's new roofline columns
     perf_cols = None
     utilization = {}
+    stage_metrics = {}
     try:
         from peasoup_tpu.obs.costmodel import (
             get_run_costs,
@@ -273,6 +274,22 @@ def main() -> None:
                 run_costs, snap_now["timers"], device_summary(),
                 snap_now["gauges"])
             utilization = utilization_summary(perf)
+            # device-time columns for the perf gate (ISSUE 6): the
+            # peaks stage's (modelled-share) device seconds and the
+            # pooled search-dispatch device time — a sort-wall
+            # regression must trip the gate even when wall-clock
+            # hides it behind tunnel jitter
+            peaks_row = perf["stages"].get("peaks", {})
+            if isinstance(peaks_row.get("device_s"), (int, float)):
+                stage_metrics["peaks_device_s"] = peaks_row["device_s"]
+            search_dev = sum(
+                rec.get("device_s", 0.0)
+                for name, rec in snap_now["timers"].items()
+                if name in ("accel_search", "fused_search",
+                            "chunked_search")
+            )
+            if search_dev > 0.0:
+                stage_metrics["search_device_s"] = round(search_dev, 6)
             perf_cols = {
                 name: {
                     "gflops": round(row["flops"] / 1e9, 2),
@@ -312,7 +329,8 @@ def main() -> None:
             "bench",
             metrics={"e2e_s": round(elapsed, 4),
                      "median_s": round(median_s, 4),
-                     "vs_baseline": out["vs_baseline"]},
+                     "vs_baseline": out["vs_baseline"],
+                     **stage_metrics},
             timers={k: v for k, v in timers.items()
                     if isinstance(v, (int, float))},
             stage_device_s=stage_device_seconds(REGISTRY.snapshot()),

@@ -113,11 +113,10 @@ def bench_case(nchans, nsamps, dm_chunk=32):
     R2, cells2 = subband_stage2_layout(plan["per_cell"], L1, dm_tile2)
     assert (n_anchor_p - 1) * nsub * L1 + plan["shift_max"] < 2**31
     pad_to = max(
-        dedisperse_flat_pad_to(out_nsamps, md, slack_d, T, uint8=True),
+        dedisperse_flat_pad_to(out_nsamps, md, slack_d, T),
         # +1024: the sb kernel's per-kk aligned slices round its window
         # one alignment unit past the plain K*T formula
-        dedisperse_flat_pad_to(L1, md, slack_s + 1024, k_sub * T,
-                               uint8=True),
+        dedisperse_flat_pad_to(L1, md, slack_s + 1024, k_sub * T),
     )
     rng = np.random.default_rng(0)
     data = rng.integers(0, 64, (nchans, pad_to), dtype=np.uint8)

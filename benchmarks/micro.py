@@ -6,10 +6,19 @@
             (`src/harmonic_sum_test.cpp:13,35-36`)
 * resample: 2^23-point kernel-II resample at accel=500 m/s^2
             (`src/kernels.cu:335-362`) — host-table path vs raw gather
+* peaks:    thresholded peak extraction per lowering (sort /
+            two_stage / pallas compaction, ops/peaks.py), measured
+            BOTH standalone and inside a vmapped spectrum-forming
+            program — the in-program delta is the figure the tuner's
+            cost table wants (the r5 attribution gap: in-program
+            sorts serialise against surrounding fused ops and run
+            slower than standalone).  Optional third argv: a tune
+            sidecar path to record the measured costs into
+            (search/tuning.py ``extraction`` section)
 * copy:     HBM/VMEM copy bound (roll; the roofline all of the above
             are judged against)
 
-Run: python benchmarks/micro.py [fft|hsum|resample|copy|all] [iters]
+Run: python benchmarks/micro.py [fft|hsum|resample|copy|peaks|all] [iters]
 Prints one JSON line per benchmark and (for `all`) writes
 benchmarks/micro_results.json.
 
@@ -139,6 +148,82 @@ def bench_resample(iters):
     ]
 
 
+#: (searched prefix, capacity) cells the peaks bench measures — the
+#: tutorial's dominant harmonic-level shapes plus the small-cap cell
+#: where the narrow two-stage wins (benchmarks/peaks_sweep.json)
+PEAKS_CELLS = ((36909, 320), (65537, 320), (65537, 64))
+
+#: trial batch per measurement (vmapped, like the fused program)
+PEAKS_BATCH = 16
+
+
+def bench_peaks(iters, sidecar: str | None = None):
+    import jax
+    import jax.numpy as jnp
+    from benchmarks.timing import time_op
+
+    from peasoup_tpu.ops.peaks import EXTRACTION_METHODS, extract_top_peaks
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # interpret-mode pallas is a correctness vehicle, ~100x compiled:
+    # timing it would poison the tuner's cost table
+    methods = [m for m in EXTRACTION_METHODS if m != "pallas" or on_tpu]
+    rng = np.random.default_rng(0)
+    out = []
+    for stop, cap in PEAKS_CELLS:
+        n = stop + 111  # non-multiple-of-row-width tail on purpose
+        spec = np.abs(rng.normal(size=(PEAKS_BATCH, n))) * 3
+        spec[:, ::601] += 9.5  # sparse guaranteed hits
+        spec = jax.device_put(spec.astype(np.float32))
+        tim = jax.device_put(rng.normal(
+            size=(PEAKS_BATCH, 2 * (n - 1))).astype(np.float32))
+        for m in methods:
+            def extract(s, m=m, stop=stop, cap=cap):
+                return extract_top_peaks(s, 9.0, 100, stop, cap,
+                                         method=m)
+
+            # standalone: the extraction alone, vmapped over trials
+            def alone(s, m=m):
+                _i, sn, _c = jax.vmap(extract)(s)
+                return s + 1e-12 * jnp.sum(sn)
+
+            t_alone = time_op(alone, spec, iters=iters)
+
+            # in-program: spectrum formation (rfft + normalise) feeding
+            # the extraction, vs the same program with the extraction
+            # replaced by a cheap reduce — the DELTA attributes the
+            # extraction's cost inside a fused dispatch
+            def formed(t, with_extract, m=m):
+                sp = jnp.abs(jnp.fft.rfft(t, axis=-1)).astype(
+                    jnp.float32)[:, : spec.shape[1]]
+                if with_extract:
+                    _i, sn, _c = jax.vmap(extract)(sp)
+                    probe = jnp.sum(sn)
+                else:
+                    probe = jnp.sum(sp[:, :8])
+                return t + 1e-12 * probe
+
+            t_with = time_op(lambda t: formed(t, True), tim, iters=iters)
+            t_without = time_op(lambda t: formed(t, False), tim,
+                                iters=iters)
+            t_prog = max(t_with - t_without, 0.0)
+            per_call = t_prog / PEAKS_BATCH
+            out.append({
+                "metric": f"peaks_{m}_{stop}x{cap}_standalone",
+                "value": round(t_alone * 1e3, 4), "unit": "ms"})
+            out.append({
+                "metric": f"peaks_{m}_{stop}x{cap}_inprog",
+                "value": round(t_prog * 1e3, 4), "unit": "ms",
+                "per_spectrum_us": round(per_call * 1e6, 3)})
+            if sidecar:
+                from peasoup_tpu.search.tuning import update_extraction
+
+                update_extraction(
+                    sidecar, str(jax.devices()[0].device_kind), stop,
+                    cap, costs={m: per_call})
+    return out
+
+
 def bench_copy(iters):
     import jax
     import jax.numpy as jnp
@@ -157,13 +242,15 @@ def bench_copy(iters):
 
 
 BENCHES = {"fft": bench_fft, "hsum": bench_hsum,
-           "resample": bench_resample, "copy": bench_copy}
+           "resample": bench_resample, "peaks": bench_peaks,
+           "copy": bench_copy}
 
 
 def main(argv=None):
     args = argv if argv is not None else sys.argv[1:]
     which = args[0] if args else "all"
     iters = int(args[1]) if len(args) > 1 else 32
+    sidecar = args[2] if len(args) > 2 else None
     if which != "all" and which not in BENCHES:
         print(f"unknown benchmark '{which}'; choose from: "
               f"{', '.join(BENCHES)}, all", file=sys.stderr)
@@ -171,7 +258,9 @@ def main(argv=None):
     names = list(BENCHES) if which == "all" else [which]
     results = []
     for name in names:
-        for row in BENCHES[name](iters):
+        rows = (BENCHES[name](iters, sidecar=sidecar)
+                if name == "peaks" else BENCHES[name](iters))
+        for row in rows:
             results.append(row)
             print(json.dumps(row))
     if which == "all":

@@ -1,0 +1,253 @@
+"""Shape-stability + timing sweep for the peak-extraction lowerings.
+
+The r5 session measured the narrow-row two-stage top_k faster than the
+approx_max_k sorts at some shapes, but its C=64 variant at stop=65537
+CRASHED the v5e worker mid-sweep, killing the whole process — so the
+two-stage path stayed unshipped behind a PERF NOTE.  This tool is the
+sweep that unblocked it (ISSUE 6 tier A): every (C, stop, cap) cell
+runs in its OWN subprocess, so a backend crash is recorded as an
+unsafe cell instead of killing the sweep, and the committed artifact
+(``benchmarks/peaks_sweep.json``) is the safety table the tuner
+consults — :data:`peasoup_tpu.search.tuning.TWO_STAGE_UNSAFE` mirrors
+its unsafe cells, and ``resolve_peaks_methods`` never picks one.
+
+Each cell checks EXACTNESS first (two-stage — and pallas compaction,
+where available — against the single-top_k ground truth on random +
+adversarial one-hit-per-row patterns), then times all available
+lowerings with the scan-chained harness (``benchmarks/timing.py``).
+
+Usage::
+
+    python benchmarks/peaks_sweep.py                  # full grid
+    python benchmarks/peaks_sweep.py --quick          # 1-cell smoke
+    python benchmarks/peaks_sweep.py --out sweep.json --sidecar tune.json
+    python benchmarks/peaks_sweep.py --cell 128 36909 320   # one cell
+                                                      # (internal)
+
+Grid: C in {64, 128, 256} x stop in {9216, 18432, 36909, 65537,
+131072} x cap in {64, 256, 320, 1024, 2048} — the ISSUE-6 ranges.
+Cells marked unsafe in an existing artifact are SKIPPED (their
+verdict is carried forward) unless ``--include-unsafe``: re-running a
+known worker-killer needs an explicit ask.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROW_WIDTHS = (64, 128, 256)
+STOPS = (9216, 18432, 36909, 65537, 131072)
+CAPS = (64, 256, 320, 1024, 2048)
+
+DEFAULT_OUT = os.path.join(os.path.dirname(__file__), "peaks_sweep.json")
+
+#: per-cell subprocess budget: a hung backend counts as unsafe too
+CELL_TIMEOUT_S = 240
+
+
+def run_cell(row_width: int, stop: int, cap: int, iters: int) -> dict:
+    """Executed INSIDE the per-cell subprocess: exactness then timing.
+    Prints one JSON object on stdout."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks.timing import time_op
+    from peasoup_tpu.ops.peaks import extract_top_peaks
+    from peasoup_tpu.ops.peaks_pallas import (
+        pallas_peaks_supported,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rng = np.random.default_rng(7)
+    start = 100
+    thresh = 9.0
+
+    def truth(spec):
+        i = np.arange(len(spec))
+        m = (i >= start) & (i < stop) & (spec > thresh)
+        return i[m], int(m.sum())
+
+    def check(spec, method):
+        iv, sv, cv = extract_top_peaks(
+            jnp.asarray(spec), thresh, start, stop, cap, method=method,
+            row_width=row_width if method == "two_stage" else 0)
+        iv, sv = np.asarray(iv), np.asarray(sv)
+        hits, cnt = truth(spec)
+        got = np.sort(iv[iv >= 0])
+        if int(cv) != cnt:
+            return f"{method}: count {int(cv)} != {cnt}"
+        want = hits if cnt <= cap else None
+        if want is not None and not np.array_equal(got, want):
+            return f"{method}: hit set mismatch ({len(got)}/{len(want)})"
+        if not np.allclose(np.sort(sv[iv >= 0]),
+                           np.sort(spec[iv[iv >= 0]]), rtol=1e-6):
+            return f"{method}: (index, value) pairing broken"
+        return None
+
+    # adversarial patterns: dense random, one-hit-per-row (the case
+    # the row-selection proof has to cover), empty, over-capacity
+    specs = []
+    dense = np.abs(rng.normal(size=stop + 137)).astype(np.float32) * 3
+    dense[::515] += 9.5
+    specs.append(dense)
+    sparse = np.abs(rng.normal(size=stop + 137)).astype(np.float32)
+    sparse[::row_width + 1] += 11.0
+    specs.append(sparse)
+    flood = np.abs(rng.normal(size=stop + 137)).astype(np.float32) + 10.0
+    specs.append(flood)
+
+    methods = ["sort", "two_stage"]
+    if pallas_peaks_supported()[0]:
+        methods.append("pallas")
+    errors = []
+    for spec in specs:
+        for m in methods:
+            err = check(spec, m)
+            if err:
+                errors.append(err)
+    cell = {
+        "row_width": row_width, "stop": stop, "cap": cap,
+        "device": str(jax.devices()[0].device_kind),
+        "safe": not errors,
+        "exact": not errors,
+    }
+    if errors:
+        cell["errors"] = errors[:8]
+        return cell
+
+    spec_b = np.stack([dense[: stop + 137]] * 8)
+    spec_d = jax.device_put(jnp.asarray(spec_b))
+    times = {}
+    for m in methods:
+        if m == "pallas" and not on_tpu:
+            continue  # interpret timing would poison the table
+
+        def step(s, m=m):
+            _i, sn, _c = jax.vmap(
+                lambda v: extract_top_peaks(
+                    v, thresh, start, stop, cap, method=m,
+                    row_width=row_width if m == "two_stage" else 0)
+            )(s)
+            return s + 1e-12 * jnp.sum(sn)
+
+        times[m] = round(time_op(step, spec_d, iters=iters) * 1e3, 4)
+    cell["ms_per_batch8"] = times
+    return cell
+
+
+def cell_key(row_width: int, stop: int, cap: int) -> str:
+    return f"C{row_width}/stop{stop}/cap{cap}"
+
+
+def load_artifact(path: str) -> dict:
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default=DEFAULT_OUT)
+    p.add_argument("--sidecar", default="",
+                   help="tune sidecar to record safety/timing into "
+                        "(search/tuning.py extraction section)")
+    p.add_argument("--iters", type=int, default=16)
+    p.add_argument("--quick", action="store_true",
+                   help="one safe cell only (CI smoke)")
+    p.add_argument("--include-unsafe", action="store_true",
+                   help="re-run cells the existing artifact marks "
+                        "unsafe (may crash a TPU worker)")
+    p.add_argument("--cell", nargs=3, type=int, metavar=("C", "STOP",
+                                                         "CAP"),
+                   help="internal: run ONE cell in-process and print "
+                        "its JSON")
+    args = p.parse_args(argv)
+
+    if args.cell:
+        print(json.dumps(run_cell(*args.cell, iters=args.iters)))
+        return 0
+
+    grid = ([(128, 9216, 64)] if args.quick else
+            [(c, s, k) for c in ROW_WIDTHS for s in STOPS for k in CAPS])
+    prior = load_artifact(args.out).get("cells", {})
+    cells: dict[str, dict] = {}
+    for c, s, k in grid:
+        key = cell_key(c, s, k)
+        old = prior.get(key)
+        if (old is not None and old.get("safe") is False
+                and not args.include_unsafe):
+            # carry the unsafe verdict forward; re-running a known
+            # worker-killer needs --include-unsafe
+            old = dict(old)
+            old["skipped"] = "unsafe in prior artifact"
+            cells[key] = old
+            print(json.dumps({"cell": key, **old}))
+            continue
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cell", str(c), str(s), str(k),
+             "--iters", str(args.iters)],
+            capture_output=True, text=True, timeout=CELL_TIMEOUT_S * 2,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+        )
+        line = (proc.stdout.strip().splitlines() or [""])[-1]
+        try:
+            cell = json.loads(line)
+        except ValueError:
+            cell = None
+        if proc.returncode != 0 or cell is None:
+            # the subprocess died (the C=64/stop>=65537 v5e failure
+            # mode) — THAT is the datum the sweep exists to record
+            cell = {
+                "row_width": c, "stop": s, "cap": k, "safe": False,
+                "exact": False,
+                "errors": [f"subprocess rc={proc.returncode}: "
+                           + (proc.stderr or "")[-300:].strip()],
+            }
+        cells[key] = cell
+        print(json.dumps({"cell": key, **cell}))
+        if args.sidecar:
+            from peasoup_tpu.search.tuning import update_extraction
+
+            update_extraction(
+                args.sidecar, cell.get("device", "unknown"), s, k,
+                safe=bool(cell.get("safe")))
+
+    doc = {
+        "grid": {"row_widths": list(ROW_WIDTHS), "stops": list(STOPS),
+                 "caps": list(CAPS)},
+        "cells": cells,
+        "n_unsafe": sum(1 for v in cells.values() if not v.get("safe")),
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"wrote {args.out} ({len(cells)} cells, "
+          f"{doc['n_unsafe']} unsafe)")
+
+    # same-schema ledger record as every other benchmarks/ harness
+    from peasoup_tpu.obs.history import append_history, make_history_record
+
+    append_history(make_history_record(
+        "micro",
+        metrics={"peaks_sweep_cells": len(cells),
+                 "peaks_sweep_unsafe": doc["n_unsafe"]},
+        config={"quick": bool(args.quick), "iters": args.iters},
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

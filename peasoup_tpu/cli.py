@@ -76,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "of the same search size their peak buffers "
                         "from the recorded high-waters (no clipped-row "
                         "re-search, minimal transfers)")
+    p.add_argument("--peaks_method", default="auto", dest="peaks_method",
+                   choices=("auto", "sort", "two_stage", "pallas"),
+                   help="peak-extraction lowering: auto lets the tuner "
+                        "pick per (device kind, stop bucket, capacity) "
+                        "from measured costs; force sort (full device "
+                        "sorts), two_stage (row-reduced top_k) or "
+                        "pallas (O(survivors) threshold-compaction "
+                        "kernel) for A/B benchmarking — all three "
+                        "produce identical candidates")
     p.add_argument("--subband", default="never", dest="subband_dedisp",
                    choices=("auto", "always", "never"),
                    help="two-stage sub-band dedispersion (dedisp's "

@@ -207,13 +207,40 @@ def harmonics_cost(nbins: int, nharms: int) -> StageCost:
     )
 
 
-def peaks_cost(nbins: int, capacity: int) -> StageCost:
-    """One extract_top_peaks call over one spectrum level: a threshold
-    compare per bin plus ~log2(capacity) compares per bin for the
-    top-k selection network."""
+#: modelled two-stage row width (ops/peaks.py narrow default) and the
+#: compaction kernel's scatter lane chunk (ops/peaks_pallas.py)
+_TWO_STAGE_MODEL_WIDTH = 128
+_COMPACTION_SCATTER_CHUNK = 512
+
+
+def peaks_cost(nbins: int, capacity: int,
+               method: str = "sort") -> StageCost:
+    """One extract_top_peaks call over one spectrum level, per
+    extraction lowering (ops/peaks.py):
+
+    * ``sort`` — a threshold compare per bin plus ~log2(capacity)
+      compares per bin for the top-k selection network (what
+      approx_max_k's recall_target=1.0 sort costs);
+    * ``two_stage`` — mask + row-max reduce per bin, a log2(cap)
+      selection over the nbins/C row maxima, then the small top_k over
+      the cap*C gathered lanes;
+    * ``pallas`` — the threshold-compaction kernel: one compare + one
+      prefix-count add per bin streamed once from HBM, plus the
+      survivor scatter's one-hot select (capacity x lane-chunk) —
+      O(survivors), the whole point of the lowering.  Its roofline is
+      the memory roof: intensity ~2 flops/byte.
+    """
     cap = max(int(capacity), 2)
+    if method == "two_stage":
+        rows = max(float(nbins) / _TWO_STAGE_MODEL_WIDTH, 1.0)
+        flops = (2.0 * nbins + (rows + cap * _TWO_STAGE_MODEL_WIDTH)
+                 * math.log2(cap))
+    elif method == "pallas":
+        flops = 2.0 * nbins + float(cap) * _COMPACTION_SCATTER_CHUNK
+    else:
+        flops = nbins * (1.0 + math.log2(cap))
     return StageCost(
-        flops=nbins * (1.0 + math.log2(cap)),
+        flops=flops,
         bytes_read=float(nbins) * _F32,
         bytes_written=float(cap) * 2 * _F32,  # idx + snr slots
     )
@@ -267,6 +294,11 @@ class PipelineGeometry:
     fold_nsamps: int
     fold_nbins: int
     fold_nints: int
+    #: resolved peak-extraction lowering of the deepest harmonic level
+    #: (the largest searched prefix dominates the stage cost); selects
+    #: the peaks_cost formula so the roofline table reflects the
+    #: actual lowering, not always the sort
+    peaks_method: str = "sort"
 
     @classmethod
     def from_search(cls, search, acc_lists=None) -> "PipelineGeometry":
@@ -286,7 +318,16 @@ class PipelineGeometry:
         else:
             n_trials = trial_grid_geometry(
                 search.dm_list, search.acc_plan).n_trials_total
+        peaks_method = "sort"
+        try:
+            # the deepest level searches the largest prefix and
+            # dominates the modelled stage cost
+            peaks_method = search.peaks_methods_for(
+                int(cfg.peak_capacity))[-1]
+        except Exception:
+            pass
         return cls(
+            peaks_method=str(peaks_method),
             n_dm=int(len(search.dm_list)),
             nchans=int(search.fil.nchans),
             out_nsamps=int(search.out_nsamps),
@@ -302,10 +343,12 @@ class PipelineGeometry:
         )
 
     def to_json(self) -> dict:
-        return {k: int(getattr(self, k)) for k in (
+        out = {k: int(getattr(self, k)) for k in (
             "n_dm", "nchans", "out_nsamps", "in_itemsize", "size",
             "nharmonics", "peak_capacity", "n_trials_total", "npdmp",
             "fold_nsamps", "fold_nbins", "fold_nints")}
+        out["peaks_method"] = str(self.peaks_method)
+        return out
 
 
 #: stage order = pipeline order = the jaxpr checker's program registry
@@ -319,7 +362,8 @@ def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
     spectrum = (whiten_cost(geom.size).scaled(geom.n_dm)
                 + accel_spectrum_cost(geom.size).scaled(
                     geom.n_trials_total))
-    peaks = peaks_cost(nb, geom.peak_capacity).scaled(
+    peaks = peaks_cost(nb, geom.peak_capacity,
+                       geom.peaks_method).scaled(
         nlevels * geom.n_trials_total)
     return {
         "dedisperse": dedisperse_cost(
@@ -357,7 +401,8 @@ def record_run_costs(search, acc_lists=None) -> dict:
     nb = geom.size // 2 + 1
     per_trial = (accel_spectrum_cost(geom.size)
                  + harmonics_cost(nb, geom.nharmonics)
-                 + peaks_cost(nb, geom.peak_capacity).scaled(
+                 + peaks_cost(nb, geom.peak_capacity,
+                              geom.peaks_method).scaled(
                      geom.nharmonics + 1))
     per_row = (whiten_cost(geom.size)
                + dedisperse_cost(1, geom.nchans, geom.out_nsamps,

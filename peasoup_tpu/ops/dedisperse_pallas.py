@@ -604,14 +604,14 @@ def dedisperse_pallas_flat_subband(
 
 
 def dedisperse_flat_pad_to(out_nsamps: int, max_delay: int,
-                           window_slack: int, time_tile: int,
-                           uint8: bool = True) -> int:
+                           window_slack: int, time_tile: int) -> int:
     """Per-channel stride (samples, incl. padding) the flat kernel
     needs: every window DMA must stay in bounds and tile-aligned.
-    (``uint8`` is kept for API compatibility; the alignment is 1024
-    for every dtype — see the note in :func:`dedisperse_pallas_flat`.)
+    The alignment is 1024 for EVERY dtype (f32 flat buffers tile at
+    1024 in current Mosaic, same as u8) — the former ``uint8``
+    parameter never changed the result and was removed (ADVICE round
+    5) so callers cannot come to expect dtype-dependent padding.
     """
-    del uint8
     align = 1024
     T, S = time_tile, window_slack
     out_p = -(-out_nsamps // T) * T

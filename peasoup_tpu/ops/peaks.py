@@ -19,51 +19,125 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# two-stage extraction kicks in above this searched-prefix length; the
-# row width balances the row-reduction pass against the second top_k.
-# PERF NOTE (r5 trace finding, measured and NOT shipped): below the
+# two-stage extraction kicks in above this searched-prefix length on
+# the default ("auto") path; the row width balances the row-reduction
+# pass against the second top_k.
+# PERF NOTE (r6 — the sweep the r5 NOTE was blocked on is DONE, see
+# benchmarks/peaks_sweep.json + trace_summary_r6.md): below the
 # threshold the batched approx_max_k lowers to full SORTS inside
-# fused programs — ~64 ms of the tutorial search's ~100 ms device
-# time (5 levels, jax.profiler trace).  A narrower-row two-stage was
-# swept standalone (C in {64,128,256}, stop 9k..131k, cap 64..2048):
-# exact and mostly stable (one C=64 run killed the v5e worker), but
-# at the caps the tuned tutorial actually uses it is SLOWER than
-# approx_max_k (13.5 vs 9.5 ms at stop=65537 x 177 trials, cap=320;
-# it only wins at cap<=64).  The in-program sort cost may still
-# differ from standalone — attributing that gap needs per-op traces
-# of both formulations, left for a future round.
+# fused programs — ~64 ms of the r5 tutorial search's ~100 ms device
+# time.  The shape-stability sweep (C in {64,128,256} x stop 9k..131k
+# x cap 64..2048, each cell subprocess-isolated) confirmed the r5
+# crash is specific to C=64 at stop >= 65537 on v5e (Mosaic row count
+# >= 1024 with a 64-lane tile) — those cells are recorded unsafe in
+# the sweep artifact and the tuner never picks them; every C=128/256
+# cell is stable and exact.  Outcome, measured standalone AND
+# in-program (per-op traces of both formulations, closing the r5
+# attribution gap — in-program sort time is ~1.35x standalone because
+# the sorts serialise against the surrounding fused ops): the narrow
+# two-stage wins only at cap <= 64 (3.1 vs 9.5 ms at stop=65537 x
+# 177, cap=64), loses at the tutorial's tuned cap=320 (13.5 vs
+# 9.5 ms) — so it is landed behind the tuner for the cells where it
+# measured faster, while the Pallas threshold-compaction kernel
+# (ops/peaks_pallas.py, O(survivors) like the reference's Thrust
+# copy_if) wins EVERY swept cell on TPU (1.1 ms at stop=65537 x 177,
+# cap=320) and is the tuner's default there.  Method selection:
+# search/tuning.py:resolve_peaks_methods (measured-cost sidecar per
+# device kind / stop bucket / capacity); force one path with
+# SearchConfig.peaks_method / --peaks_method for A/B runs.
 _TWO_STAGE_MIN_SIZE = 1 << 17
 _TWO_STAGE_ROW_WIDTH = 512
+# narrow row width for two-stage below 2^17 (the sweep's stable
+# all-sizes pick; C=64 is faster still at tiny caps but unsafe at
+# stop >= 65537 on v5e — see benchmarks/peaks_sweep.json)
+_TWO_STAGE_NARROW_WIDTH = 128
+
+#: selectable extraction lowerings (search/tuning.py picks per
+#: (device kind, stop bucket, capacity); "auto" = the legacy
+#: size-based heuristic, used when no measured costs apply)
+EXTRACTION_METHODS = ("sort", "two_stage", "pallas")
+
+_pallas_fallback_warned = False
 
 
-def extract_above_threshold(
-    spectrum: jnp.ndarray,
-    thresh,
-    start_idx: int,
-    stop_idx: int,
-    capacity: int,
+def _resolve_method(method: str, stop_idx: int) -> str:
+    """Static (trace-time) method resolution.  "auto" keeps the legacy
+    heuristic bit-for-bit: two-stage above ``_TWO_STAGE_MIN_SIZE``,
+    sort (approx_max_k) below.  Tuned selection happens in the DRIVERS
+    (search/tuning.py) and arrives here as a concrete method."""
+    if method == "auto":
+        return "two_stage" if stop_idx > _TWO_STAGE_MIN_SIZE else "sort"
+    if method not in EXTRACTION_METHODS:
+        raise ValueError(
+            f"peaks method {method!r}: use one of "
+            f"{('auto',) + EXTRACTION_METHODS}")
+    return method
+
+
+def _two_stage_width(row_width: int, stop_idx: int) -> int:
+    """Row width for the two-stage path: caller-pinned, else the
+    legacy 512 above 2^17 and the sweep's narrow 128 below."""
+    if row_width:
+        return int(row_width)
+    return (_TWO_STAGE_ROW_WIDTH if stop_idx > _TWO_STAGE_MIN_SIZE
+            else _TWO_STAGE_NARROW_WIDTH)
+
+
+def _pallas_or_fallback(spectrum, thresh, start_idx, stop_idx, capacity):
+    """The pallas-compaction path, falling back to the score-based XLA
+    formulation (same ascending-index contract) where the kernel can
+    run neither compiled nor in interpret mode — so a forced
+    ``peaks_method="pallas"`` config stays runnable (and result-
+    equivalent) on any backend."""
+    from .peaks_pallas import (
+        extract_above_threshold_pallas,
+        pallas_peaks_interpret,
+        pallas_peaks_supported,
+    )
+
+    ok, reason = pallas_peaks_supported()
+    if ok:
+        return extract_above_threshold_pallas(
+            spectrum, thresh, start_idx, stop_idx, capacity,
+            interpret=pallas_peaks_interpret(),
+        )
+    global _pallas_fallback_warned
+    if not _pallas_fallback_warned:
+        _pallas_fallback_warned = True
+        from ..obs.events import warn_event
+
+        warn_event(
+            "peaks_pallas_fallback",
+            f"pallas peak compaction unavailable ({reason}); using the "
+            f"XLA score-based formulation (same contract)",
+            reason=reason,
+        )
+    return _extract_above_threshold_xla(
+        spectrum, thresh, start_idx, stop_idx, capacity,
+        two_stage=stop_idx > _TWO_STAGE_MIN_SIZE,
+        row_width=_TWO_STAGE_ROW_WIDTH,
+    )
+
+
+def _extract_above_threshold_xla(
+    spectrum, thresh, start_idx, stop_idx, capacity,
+    *, two_stage: bool, row_width: int,
 ):
-    """Compact the above-threshold bins of [start_idx, stop_idx).
-
-    Returns (idxs, snrs, count): the ``capacity`` smallest qualifying
-    bin indices in ascending order (padded with -1), their values, and
-    the true number of qualifying bins (may exceed ``capacity``).
-    """
+    """The XLA score-top_k formulations behind
+    :func:`extract_above_threshold` (``two_stage`` selects the
+    row-reduction variant; ``row_width`` is its C)."""
     size = spectrum.shape[0]
-    # bins >= stop_idx can never qualify: sort only the searched prefix
-    # (for low harmonic levels stop_idx << size, cutting the top_k cost)
-    stop_idx = min(stop_idx, size)
     spec = spectrum[:stop_idx]
     k_eff = min(capacity, stop_idx)
     sentinel = jnp.int32(-(size + 1))
-    if stop_idx > _TWO_STAGE_MIN_SIZE:
+    if two_stage:
         # two-stage extraction: a single lax.top_k over millions of
         # bins costs ~8 ms on v5e; selecting the top-`capacity` ROWS
         # first (by earliest qualifying index) cuts it to ~0.5 ms.
         # Exact because global index order is (row, col) lex order and
         # every selected row holds >= 1 hit: the first k_eff hits
         # always lie within the first k_eff hit-rows.
-        C = _TWO_STAGE_ROW_WIDTH
+        C = row_width
         R = -(-stop_idx // C)
         i = jnp.arange(R * C, dtype=jnp.int32)
         sp = jnp.pad(spec, (0, R * C - stop_idx))
@@ -89,12 +163,53 @@ def extract_above_threshold(
     return idxs, snrs.astype(jnp.float32), count
 
 
+def extract_above_threshold(
+    spectrum: jnp.ndarray,
+    thresh,
+    start_idx: int,
+    stop_idx: int,
+    capacity: int,
+    method: str = "auto",
+    row_width: int = 0,
+):
+    """Compact the above-threshold bins of [start_idx, stop_idx).
+
+    Returns (idxs, snrs, count): the ``capacity`` smallest qualifying
+    bin indices in ascending order (padded with -1), their values, and
+    the true number of qualifying bins (may exceed ``capacity``).
+
+    ``method`` selects the lowering — ``"sort"`` (one score top_k,
+    which XLA lowers to a full sort), ``"two_stage"`` (row-reduction
+    then a small top_k; ``row_width`` pins its C, 0 = tuned default),
+    or ``"pallas"`` (the O(survivors) threshold-compaction kernel,
+    ops/peaks_pallas.py).  All three return BIT-IDENTICAL results
+    (tests/test_ops.py pins this across the edge shapes); ``"auto"``
+    keeps the legacy size heuristic.
+    """
+    size = spectrum.shape[0]
+    # bins >= stop_idx can never qualify: sort only the searched prefix
+    # (for low harmonic levels stop_idx << size, cutting the top_k cost)
+    stop_idx = min(stop_idx, size)
+    start_idx = min(start_idx, stop_idx)
+    method = _resolve_method(method, stop_idx)
+    if method == "pallas":
+        return _pallas_or_fallback(
+            spectrum, thresh, start_idx, stop_idx, capacity)
+    return _extract_above_threshold_xla(
+        spectrum, thresh, start_idx, stop_idx, capacity,
+        two_stage=method == "two_stage" and stop_idx > 0,
+        row_width=_two_stage_width(row_width, stop_idx),
+    )
+
+
 def extract_top_peaks(
     spectrum: jnp.ndarray,
     thresh,
     start_idx: int,
     stop_idx: int,
     capacity: int,
+    method: str = "auto",
+    row_width: int = 0,
 ):
     """Value-ordered thresholded peak extraction (the hot-path variant).
 
@@ -123,10 +238,22 @@ def extract_top_peaks(
     excluded, the k selected rows' maxima would all exceed the k-th
     value — a contradiction).  NaNs never qualify (compare is False),
     matching the score-based path.
+
+    ``method``/``row_width``: see :func:`extract_above_threshold`.
+    The ``"pallas"`` lowering compacts in INDEX order — hit slots are
+    then ascending-index (not descending-SNR) and a clipped row keeps
+    the smallest-index subset; both deviations are invisible to the
+    drivers (every consumer sorts segments host-side before the peak
+    merge, and clipped rows are re-searched — the same argument as the
+    bullet list above).
     """
     size = spectrum.shape[0]
     stop_idx = min(stop_idx, size)
     start_idx = min(start_idx, stop_idx)
+    method = _resolve_method(method, stop_idx)
+    if method == "pallas":
+        return _pallas_or_fallback(
+            spectrum, thresh, start_idx, stop_idx, capacity)
     k_eff = min(capacity, stop_idx)
     neg = jnp.float32(-jnp.inf)
     spec = spectrum[:stop_idx]
@@ -138,9 +265,9 @@ def extract_top_peaks(
     else:
         masked = body
     count = jnp.sum(masked > thresh, dtype=jnp.int32)
-    C = _TWO_STAGE_ROW_WIDTH
+    C = _two_stage_width(row_width, stop_idx)
     R = -(-stop_idx // C)
-    if stop_idx > _TWO_STAGE_MIN_SIZE and k_eff < R:
+    if method == "two_stage" and k_eff < R and stop_idx > 0:
         # two-stage by value: top-k_eff rows by row-max provably
         # contain the k_eff largest values (see docstring)
         m2 = jnp.pad(masked, (0, R * C - stop_idx),
@@ -148,8 +275,16 @@ def extract_top_peaks(
         _, rows = jax.lax.top_k(jnp.max(m2, axis=1), k_eff)
         top, ti_local = jax.lax.top_k(m2[rows].reshape(-1), k_eff)
         ti = rows[ti_local // C] * C + ti_local % C
-    elif stop_idx > _TWO_STAGE_MIN_SIZE:
+    elif method == "two_stage" and stop_idx > _TWO_STAGE_MIN_SIZE:
         # k_eff >= R: row selection cannot help; exact single top_k
+        top, ti = jax.lax.top_k(masked, k_eff)
+    elif method == "two_stage":
+        # k_eff >= R below the legacy threshold: the narrow-row
+        # selection degenerates — keep the small-spectrum lowering
+        top, ti = jax.lax.approx_max_k(masked, k_eff, recall_target=1.0)
+    elif stop_idx > _TWO_STAGE_MIN_SIZE:
+        # "sort" on a large prefix: one exact top_k (approx_max_k's
+        # reduction path is tuned for <= 2^17 operands)
         top, ti = jax.lax.top_k(masked, k_eff)
     else:
         top, ti = jax.lax.approx_max_k(masked, k_eff, recall_target=1.0)

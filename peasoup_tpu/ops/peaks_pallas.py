@@ -1,0 +1,282 @@
+"""Pallas threshold-compaction peak extraction (the no-sort path).
+
+Reference semantics: `src/kernels.cu:384-416` — the CUDA build's peak
+extraction is a Thrust ``copy_if`` of above-threshold bins in index
+order, i.e. O(survivors), and never sorts.  The XLA lowerings this
+kernel replaces (``lax.approx_max_k`` with ``recall_target=1.0`` and
+``lax.top_k`` over index scores) are O(n log n) full sorts inside the
+fused search program — ~64 ms of the tutorial search's ~100 ms device
+time in the r5 trace (`benchmarks/trace_summary_r5.md`).
+
+Kernel shape (the ISSUE-6 compaction plan):
+
+1. **per-block masked count** — the grid walks the searched prefix in
+   lane-aligned blocks; each step counts its qualifying bins
+   (``start_idx <= i < stop_idx`` and ``value > thresh``) with one
+   vector compare + reduce;
+2. **exclusive prefix sum across blocks** — the TPU grid is sequential,
+   so a single SMEM scratch scalar carries the running qualifying
+   count: each block's scratch value on entry IS its exclusive prefix
+   (no separate scan pass, no inter-kernel round trip);
+3. **scatter** — only blocks that actually hold survivors (and whose
+   prefix is still below ``capacity``) compute within-block ranks (a
+   log2(block) shift-and-add inclusive scan — no sort) and materialise
+   the qualifying (index, value) pairs into the fixed-capacity output
+   via a lane-chunked one-hot select, plus the true-count scalar.
+
+Blocks with no survivors cost one compare+reduce over streamed data —
+the kernel is memory-bound O(n) + O(survivor_blocks * capacity)
+compute, matching the reference's copy_if complexity class instead of
+the sort's O(n log n).
+
+Contract: exactly :func:`peasoup_tpu.ops.peaks.extract_above_threshold`
+— the ``capacity`` smallest qualifying bin indices in ascending order,
+-1 padding, values paired, and the TRUE qualifying count (which may
+exceed ``capacity``; clipped rows are re-searched by every driver).
+
+CPU/testing: compiled Mosaic execution needs a TPU; elsewhere the
+kernel runs in interpret mode behind :func:`pallas_peaks_supported`, a
+run-the-real-kernel-once capability probe in the same style as
+``dedisperse_pallas.pallas_interpret_supported`` (which this kernel
+deliberately does NOT reuse: that probe fails on jax 0.4.37 for the
+dedispersion kernels' internal pjit/i64 boundary, a construct this
+kernel avoids by keeping every scalar strictly int32).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: lane-aligned spectrum block per grid step.  8192 f32 lanes = 32 KB
+#: per (double-buffered) load — big enough that per-step dispatch
+#: overhead amortises (a 2^17-bin level is 16 steps), small enough
+#: that the survivor scatter's transient one-hot tiles stay in VMEM.
+DEFAULT_BLOCK = 8192
+
+#: lane chunk of the survivor scatter: the one-hot select materialises
+#: (capacity_padded, _SCATTER_CHUNK) i32/f32 tiles, <= 2 MB at the
+#: sweep's largest capacity (2048).
+_SCATTER_CHUNK = 512
+
+
+def _inclusive_scan_lanes(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Inclusive prefix sum along the last (lane) axis of a (1, width)
+    int32 array via log2(width) shift-and-adds — Mosaic has no native
+    cumsum, and a triangular-matmul rank would cost O(width^2) VMEM."""
+    shift = 1
+    while shift < width:
+        shifted = jnp.pad(x, ((0, 0), (shift, 0)))[:, :width]
+        x = (x + shifted).astype(jnp.int32)
+        shift *= 2
+    return x
+
+
+def _compact_kernel(
+    spec_ref, idx_ref, snr_ref, cnt_ref, off_ref,
+    *, block, cap_p, capacity, thresh, start_idx, stop_idx,
+):
+    """One grid step = one spectrum block (see module docstring)."""
+    bi = pl.program_id(0)
+
+    @pl.when(bi == 0)
+    def _init():
+        idx_ref[...] = jnp.full_like(idx_ref, jnp.int32(-1))
+        snr_ref[...] = jnp.zeros_like(snr_ref)
+        cnt_ref[0, 0] = jnp.int32(0)
+        off_ref[0] = jnp.int32(0)
+
+    vals = spec_ref[...]  # (1, block) f32
+    gidx = (
+        jax.lax.broadcasted_iota(jnp.int32, (1, block), 1)
+        + (bi * jnp.int32(block))
+    ).astype(jnp.int32)
+    mask = (
+        (gidx >= jnp.int32(start_idx))
+        & (gidx < jnp.int32(stop_idx))
+        & (vals > jnp.float32(thresh))
+    )
+    blk_cnt = jnp.sum(mask.astype(jnp.int32)).astype(jnp.int32)
+    base = off_ref[0]
+    cnt_ref[0, 0] = (cnt_ref[0, 0] + blk_cnt).astype(jnp.int32)
+    off_ref[0] = (base + blk_cnt).astype(jnp.int32)
+
+    # survivors only, and only while the output still has open slots:
+    # once `base >= capacity` every later qualifying bin is beyond the
+    # k smallest — the block contributes nothing but its count
+    @pl.when((blk_cnt > 0) & (base < jnp.int32(capacity)))
+    def _scatter():
+        # destination slot of each qualifying lane = exclusive global
+        # prefix: block base + (within-block inclusive rank - 1)
+        ranks = _inclusive_scan_lanes(mask.astype(jnp.int32), block)
+        dest = jnp.where(
+            mask, base + ranks - jnp.int32(1), jnp.int32(-1)
+        ).astype(jnp.int32)
+        slots = jax.lax.broadcasted_iota(jnp.int32, (cap_p, 1), 0)
+        open_slot = slots < jnp.int32(capacity)
+        for c0 in range(0, block, _SCATTER_CHUNK):
+            d = dest[:, c0 : c0 + _SCATTER_CHUNK]  # (1, CHUNK)
+
+            @pl.when(jnp.any(d >= jnp.int32(0)))
+            def _chunk(d=d, c0=c0):
+                onehot = (d == slots) & open_slot  # (cap_p, CHUNK)
+                filled = jnp.any(onehot, axis=1, keepdims=True)
+                gi = jnp.sum(
+                    jnp.where(onehot, gidx[:, c0 : c0 + _SCATTER_CHUNK],
+                              jnp.int32(0)),
+                    axis=1, keepdims=True, dtype=jnp.int32)
+                gv = jnp.sum(
+                    jnp.where(onehot, vals[:, c0 : c0 + _SCATTER_CHUNK],
+                              jnp.float32(0.0)),
+                    axis=1, keepdims=True)
+                idx_ref[...] = jnp.where(
+                    filled.T, gi.T, idx_ref[...]).astype(jnp.int32)
+                snr_ref[...] = jnp.where(filled.T, gv.T, snr_ref[...])
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "thresh", "start_idx", "stop_idx", "capacity", "block",
+        "interpret",
+    ),
+)
+def extract_above_threshold_pallas(
+    spectrum: jnp.ndarray,
+    thresh,
+    start_idx: int,
+    stop_idx: int,
+    capacity: int,
+    *,
+    block: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+):
+    """Threshold-compaction peak extraction of ``[start_idx, stop_idx)``.
+
+    Returns (idxs, snrs, count) under the exact
+    ``extract_above_threshold`` contract: the ``capacity`` smallest
+    qualifying bin indices in ascending order (padded with -1), their
+    values, and the true qualifying count (may exceed ``capacity``).
+
+    Safe under ``jax.vmap`` (the hot paths vmap the extraction over
+    accel-trial batches): the batch lands as an extra leading grid
+    axis, the block axis stays innermost/sequential, and the SMEM
+    running-offset scratch resets at block 0 of every spectrum —
+    covered by the vmap parity test in ``tests/test_ops.py``.
+    """
+    size = spectrum.shape[0]
+    stop_idx = min(int(stop_idx), size)
+    start_idx = min(int(start_idx), stop_idx)
+    k_eff = min(int(capacity), stop_idx)
+    if stop_idx == 0 or k_eff == 0:
+        return (
+            jnp.full((capacity,), -1, jnp.int32),
+            jnp.zeros((capacity,), jnp.float32),
+            jnp.int32(0),
+        )
+    nblocks = -(-stop_idx // block)
+    pad = nblocks * block - stop_idx
+    spec = spectrum[:stop_idx].astype(jnp.float32)
+    if pad:
+        # padding bins fail the gidx < stop_idx mask whatever they hold
+        spec = jnp.pad(spec, (0, pad))
+    cap_p = -(-k_eff // 128) * 128  # lane-pad the output buffers
+    idxs, snrs, cnt = pl.pallas_call(
+        partial(
+            _compact_kernel,
+            block=block, cap_p=cap_p, capacity=k_eff,
+            thresh=float(thresh), start_idx=start_idx, stop_idx=stop_idx,
+        ),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((1, block), lambda i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, cap_p), lambda i: (0, 0)),
+            pl.BlockSpec((1, cap_p), lambda i: (0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, cap_p), jnp.int32),
+            jax.ShapeDtypeStruct((1, cap_p), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32)],
+        interpret=interpret,
+    )(spec.reshape(1, nblocks * block))
+    idxs = idxs.reshape(-1)[:k_eff]
+    snrs = snrs.reshape(-1)[:k_eff]
+    count = cnt.reshape(())
+    if k_eff < capacity:
+        idxs = jnp.pad(idxs, (0, capacity - k_eff), constant_values=-1)
+        snrs = jnp.pad(snrs, (0, capacity - k_eff))
+    return idxs, snrs, count
+
+
+_peaks_probe: tuple[bool, str] | None = None
+
+
+def pallas_peaks_supported() -> tuple[bool, str]:
+    """Capability probe: can this process run the compaction kernel?
+
+    On TPU the compiled Mosaic path is assumed good (it is exercised by
+    the hardware benchmark gate); elsewhere the REAL kernel runs once
+    in interpret mode at a tiny shape and the (ok, reason) verdict is
+    cached for the process — the same probe design as
+    ``dedisperse_pallas.pallas_interpret_supported``, but independent
+    of it: that probe's jax-0.4.37 failure is specific to the
+    dedispersion wrappers' internal pjit/i64 boundary, which this
+    kernel does not have.  Tests gate on the ``peaks_pallas_interpret``
+    fixture (``tests/conftest.py``) so broken interpret builds skip
+    with the reason instead of failing.
+    """
+    global _peaks_probe
+    if _peaks_probe is None:
+        try:
+            if jax.devices()[0].platform == "tpu":
+                _peaks_probe = (True, "compiled")
+                return _peaks_probe
+        except Exception:
+            pass
+        try:
+            from jax.core import trace_state_clean
+        except ImportError:  # moved in newer jax; default to probing
+            def trace_state_clean():
+                return True
+        if not trace_state_clean():
+            # first call arrived from INSIDE another program's trace
+            # (the drivers warm the probe eagerly, but a direct
+            # method="pallas" extract under a user jit can get here):
+            # the probe's concrete fetch cannot run mid-trace, so
+            # attempt the kernel inline without caching a verdict
+            return (True, "interpret-unprobed")
+        try:
+            import numpy as np
+
+            spec = np.zeros(512, np.float32)
+            spec[[3, 200, 450]] = 5.0
+            i, s, c = extract_above_threshold_pallas(
+                jnp.asarray(spec), 1.0, 0, 512, 8, block=256,
+                interpret=True,
+            )
+            i, c = np.asarray(i), int(c)
+            if c != 3 or list(i[:3]) != [3, 200, 450]:
+                raise AssertionError(
+                    f"probe mismatch: count={c} idxs={i[:4]}")
+            _peaks_probe = (True, "interpret")
+        except Exception as exc:  # noqa: BLE001 - reported via skip
+            _peaks_probe = (
+                False, f"{type(exc).__name__}: {str(exc).splitlines()[0]}")
+    return _peaks_probe
+
+
+def pallas_peaks_interpret() -> bool:
+    """True when the kernel must run in interpret mode (non-TPU)."""
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:
+        return True

@@ -85,6 +85,94 @@ def make_mesh(max_devices: int | None = None, axis: str = "dm") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def _onehot_select_rows(values, row_ids, n_rows: int,
+                        select_dtype=jnp.bfloat16):
+    """Row gather as a one-hot matmul: ``values[row_ids]`` computed on
+    the MXU (a ``jnp.take`` row gather measured 28 ms on v5e for the
+    kernel2 stage-2 selection this implements; the matmul is ~1 ms).
+
+    Exact by construction when the contraction keeps the f32 operand
+    at full precision: every one-hot entry is 0.0 or 1.0 (exact in
+    ``select_dtype``), so each output element is one f32 value times
+    1.0 plus zeros — ``assert_onehot_selection_exact`` proves the
+    bit-identity ON DEVICE before any driver trusts this path (ADVICE
+    round 5: the claim was only ever tested against a host float32
+    einsum)."""
+    onehot = (
+        row_ids[:, None] == jnp.arange(n_rows, dtype=jnp.int32)[None, :]
+    ).astype(select_dtype)
+    return jnp.einsum(
+        "rp,pl->rl", onehot, values,
+        precision=(lax.Precision.DEFAULT, lax.Precision.HIGHEST),
+        preferred_element_type=jnp.float32,
+    )
+
+
+_onehot_exact_checked: dict[tuple, bool] = {}
+
+
+def assert_onehot_selection_exact(select_dtype=jnp.bfloat16,
+                                  value_dtype=jnp.float32,
+                                  n_rows: int = 96,
+                                  row_len: int = 512) -> None:
+    """On-device proof that :func:`_onehot_select_rows` is bit-exact.
+
+    Runs the REAL einsum (same dtypes/precision as the kernel2 row
+    selection) on this process's default device over full-mantissa
+    random values — including exact-integer and subnormal-adjacent
+    magnitudes — and compares bitwise against ``jnp.take``.  Raises
+    ``DomainError`` on any mismatch: a backend where
+    ``Precision.HIGHEST`` is not an exact limb decomposition of the
+    f32 operand would otherwise silently break stage-2 bit-parity
+    with the direct sweep.  Cached per (backend, dtypes) — the check
+    costs one tiny dispatch, once per process.
+
+    ``value_dtype`` exists for the negative test: casting the VALUES
+    through an inexact dtype (e.g. bfloat16) truncates mantissas and
+    must trip the assert (tests/test_parallel.py).
+    """
+    from ..errors import DomainError
+
+    try:
+        backend = jax.devices()[0].platform
+    except Exception:
+        backend = "unknown"
+    key = (backend, jnp.dtype(select_dtype).name,
+           jnp.dtype(value_dtype).name, n_rows, row_len)
+    if _onehot_exact_checked.get(key):
+        return
+    rng = np.random.default_rng(1234)
+    # full f32 mantissas across magnitudes the dedispersed partials
+    # span; bf16-truncation of any of these changes the bits
+    vals32 = np.concatenate([
+        rng.normal(size=(n_rows - 2, row_len)).astype(np.float32)
+        * np.logspace(-6, 6, n_rows - 2, dtype=np.float32)[:, None],
+        np.full((1, row_len), np.float32(1.0 + 2.0 ** -23)),
+        rng.integers(0, 2 ** 23, (1, row_len)).astype(np.float32),
+    ])
+    row_ids = rng.integers(0, n_rows, size=2 * n_rows).astype(np.int32)
+    vals_d = jnp.asarray(vals32).astype(value_dtype)
+    sel = jax.jit(
+        partial(_onehot_select_rows, n_rows=n_rows,
+                select_dtype=select_dtype)
+    )(vals_d, jnp.asarray(row_ids))
+    want = np.asarray(vals32)[row_ids]
+    got = np.asarray(sel)
+    if got.dtype != want.dtype or not np.array_equal(
+            got.view(np.uint32), want.view(np.uint32)):
+        bad = int((got != want).sum())
+        raise DomainError(
+            f"one-hot row selection is NOT bit-exact on backend "
+            f"{backend!r} (select_dtype={jnp.dtype(select_dtype).name}, "
+            f"value_dtype={jnp.dtype(value_dtype).name}): {bad} of "
+            f"{got.size} elements differ from the jnp.take gather — "
+            f"the sub-band kernel2 path would silently break bit-"
+            f"parity; use dedisp_method='xla' for stage 2 or report "
+            f"the backend"
+        )
+    _onehot_exact_checked[key] = True
+
+
 from functools import lru_cache
 
 
@@ -180,6 +268,7 @@ def build_fused_search(
     block: int | None = None,
     dedisp_pallas: tuple | None = None,
     quantise: bool = False,
+    peaks_methods: tuple | None = None,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -287,13 +376,14 @@ def build_fused_search(
             search = lambda t, m, s, ui: search_one_accel(
                 t, (d0_u[ui], pos_u[ui], step_u[ui]), m, s, tsamp,
                 nharms, bounds, capacity, min_snr, max_shift, block,
+                peaks_methods,
             )
             idxs, snrs, counts = jax.vmap(search)(
                 tw_f, mean_f, std_f, uidx.reshape(-1))
         else:
             search = lambda t, m, s, a: search_one_accel_legacy(
                 t, jnp.nan_to_num(a), m, s, tsamp, nharms, bounds,
-                capacity, min_snr, max_shift,
+                capacity, min_snr, max_shift, peaks_methods,
             )
             idxs, snrs, counts = jax.vmap(search)(
                 tw_f, mean_f, std_f, accs_f)
@@ -353,6 +443,7 @@ def build_chunked_search(
     n_parts: int = 1,
     subband: tuple | None = None,
     quantise_nbits: int = 0,
+    peaks_methods: tuple | None = None,
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -471,16 +562,7 @@ def build_chunked_search(
                     time_tile=k2_T, chan_group=k2_G,
                     data_tail_ok=True,
                 )
-                onehot = (
-                    sb_shifts[:, None]
-                    == jnp.arange(k2_R2, dtype=jnp.int32)[None, :]
-                ).astype(jnp.bfloat16)
-                return jnp.einsum(
-                    "rp,pl->rl", onehot, out2,
-                    precision=(lax.Precision.DEFAULT,
-                               lax.Precision.HIGHEST),
-                    preferred_element_type=jnp.float32,
-                )
+                return _onehot_select_rows(out2, sb_shifts, k2_R2)
             return dedisperse_subband_flat(
                 anchor_delays, sb_assign, sb_shifts, out_nsamps,
                 bounds=sb_bounds, L1=sb_L1, stage1=stage1,
@@ -542,13 +624,14 @@ def build_chunked_search(
                         search = lambda ui: search_one_accel(
                             tw, (d0_u[ui], pos_u[ui], step_u[ui]), m, s,
                             tsamp, nharms, bounds, capacity, min_snr,
-                            max_shift, block,
+                            max_shift, block, peaks_methods,
                         )
                         i2, s2, c2 = jax.vmap(search)(u_blk)
                     else:
                         search = lambda a: search_one_accel_legacy(
                             tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
                             bounds, capacity, min_snr, max_shift,
+                            peaks_methods,
                         )
                         i2, s2, c2 = jax.vmap(search)(a_blk)
                     valid = ~jnp.isnan(a_blk)
@@ -1073,7 +1156,12 @@ class MeshPulsarSearch(PulsarSearch):
                 if (n_anchor_p * nsub * L1k < 2**31
                         and (n_anchor_p - 1) * nsub * L1k
                         + sbp["shift_max"] < 2**31):
-                    # int32 flat offsets hold: engage the kernel path
+                    # int32 flat offsets hold: engage the kernel path.
+                    # The path's final row selection is a bf16 one-hot
+                    # einsum — prove ON THIS DEVICE, once per process,
+                    # that it is bit-identical to a plain row gather
+                    # before trusting it with stage-2 output
+                    assert_onehot_selection_exact()
                     L1 = L1k
                     R2, cells2 = subband_stage2_layout(
                         sbp["per_cell"], L1, dm_tile2)
@@ -1083,7 +1171,6 @@ class MeshPulsarSearch(PulsarSearch):
             # round its window one alignment unit past the K*T formula
             pad_sub = dedisperse_flat_pad_to(
                 L1, self.max_delay, slack + align, k_sub * t_sub,
-                uint8=itemsize == 1,
             )
             # every flat part must hold whole sub-bands
             plan["part_align"] = max(2 * G, csub)
@@ -1362,6 +1449,7 @@ class MeshPulsarSearch(PulsarSearch):
         # observability: the benchmark's transfer model reads these
         self._chunk_buffer_shapes = (cap, compact_k)
         self._chunk_plan = plan
+        self.record_peaks_selection(cap)
         METRICS.gauge("chunk.dm_chunk", dm_chunk)
         METRICS.gauge("chunk.accel_block", plan["accel_block"])
         METRICS.gauge("chunk.peak_capacity", cap)
@@ -1420,6 +1508,7 @@ class MeshPulsarSearch(PulsarSearch):
                     self.fil.header.nbits
                     if cfg.trial_nbits == 8 else 0
                 ),
+                peaks_methods=self.peaks_methods_for(cap_),
             )
 
         n_chunks = ndm_local_p // dm_chunk
@@ -2013,6 +2102,7 @@ class MeshPulsarSearch(PulsarSearch):
         t0 = time.time()
         inputs = self._device_inputs(acc_lists, ndm_p, namax)
         cap0 = cap
+        self.record_peaks_selection(cap)
 
         def make_program(capacity, ck):
             return build_fused_search(
@@ -2039,6 +2129,7 @@ class MeshPulsarSearch(PulsarSearch):
                     dd_pallas["params"] if dd_pallas is not None else None
                 ),
                 quantise=cfg.trial_nbits == 8,
+                peaks_methods=self.peaks_methods_for(capacity),
             )
 
         METRICS.inc("runs.mesh_fused")

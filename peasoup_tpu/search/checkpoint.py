@@ -50,6 +50,9 @@ _NON_IDENTITY_FIELDS = {
     "outdir", "accel_chunk", "dump_dir", "measure_stages", "tune_file",
     "events_log", "metrics_json", "infilename", "killfilename",
     "zapfilename", "dm_file",
+    # extraction lowering: changes WHEN work happens, never which
+    # candidates are produced (ops/peaks.py) — like the buffer sizes
+    "peaks_method",
 }
 
 

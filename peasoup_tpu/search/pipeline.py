@@ -123,6 +123,17 @@ def dump_whiten_stages(dump_dir, idx, tim, birdies, widths, bin_width,
                 np.asarray(arr))
 
 
+def _pallas_mode() -> str | None:
+    """How the pallas peak-compaction kernel can run on this backend:
+    "compiled" on TPU, else None — interpret mode is never auto-picked
+    (it is the CPU test vehicle, ~100x the compiled kernel), so the
+    probe is not even run on the default path."""
+    try:
+        return "compiled" if jax.devices()[0].platform == "tpu" else None
+    except Exception:
+        return None
+
+
 def resample_block_for(n: int, max_shift: int) -> int | None:
     """Block size for the table-driven resampler: the largest power of
     two dividing ``n``, capped at 16384 (the measured sweet spot on
@@ -143,16 +154,24 @@ def resample_block_for(n: int, max_shift: int) -> int | None:
     return b
 
 
-def _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr):
+def _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr,
+                   methods=None):
     fs = jnp.fft.rfft(tim_r).astype(jnp.complex64)
     pspec = form_interpolated(fs)
     pspec = ((pspec - mean) / std).astype(jnp.float32)
     spectra = [pspec] + harmonic_sums(pspec, nharms)
     idxs, snrs, counts = [], [], []
-    # value-ordered extraction (slots descend by SNR, not bin index) —
-    # every consumer sorts segments host-side before the peak merge
-    for spec, (start, stop, _f) in zip(spectra, bounds):
-        i, s, c = extract_top_peaks(spec, min_snr, start, stop, capacity)
+    # value-ordered extraction (slots descend by SNR, not bin index;
+    # the pallas compaction lowering instead ascends by index) —
+    # every consumer sorts segments host-side before the peak merge.
+    # ``methods``: one concrete extraction lowering per harmonic
+    # level, resolved by search/tuning.py OUTSIDE the trace; None
+    # keeps ops/peaks.py's size heuristic
+    if methods is None:
+        methods = ("auto",) * len(bounds)
+    for spec, (start, stop, _f), meth in zip(spectra, bounds, methods):
+        i, s, c = extract_top_peaks(spec, min_snr, start, stop, capacity,
+                                    method=meth)
         idxs.append(i)
         snrs.append(s)
         counts.append(c)
@@ -160,54 +179,59 @@ def _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr):
 
 
 def search_one_accel(tim_w, rtab, mean, std, tsamp, nharms, bounds, capacity,
-                     min_snr, max_shift, block):
+                     min_snr, max_shift, block, methods=None):
     from ..ops.resample import resample2_from_tables
 
     d0, pos_t, step_t = rtab
     tim_r = resample2_from_tables(tim_w, d0, pos_t, step_t, max_shift,
                                   block=block)
-    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr)
+    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity,
+                          min_snr, methods)
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "tsamp", "nharms", "bounds", "capacity", "min_snr", "max_shift",
-        "block",
+        "block", "methods",
     ),
 )
 def search_accel_chunk(tim_w, rtabs, mean, std, tsamp, nharms, bounds,
-                       capacity, min_snr, max_shift, block):
+                       capacity, min_snr, max_shift, block, methods=None):
     """vmapped acceleration-trial batch: per-accel host-exact resample
     tables (d0[A,nb], pos[A,nb,m], step[A,nb,m]) -> peak buffers."""
     fn = lambda t: search_one_accel(
         tim_w, t, mean, std, tsamp, nharms, bounds, capacity, min_snr,
-        max_shift, block,
+        max_shift, block, methods,
     )
     return jax.vmap(fn)(rtabs)
 
 
 def search_one_accel_legacy(tim_w, accel, mean, std, tsamp, nharms, bounds,
-                            capacity, min_snr, max_shift=None):
+                            capacity, min_snr, max_shift=None,
+                            methods=None):
     """On-device index math fallback for fft sizes with no power-of-two
     factor (no host tables).  NB: on real TPU hardware the emulated-f64
     rint is inexact for a small fraction of indices; the table path is
     exact and preferred."""
     tim_r = resample2(tim_w, accel, tsamp, max_shift)
-    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity, min_snr)
+    return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity,
+                          min_snr, methods)
 
 
 @partial(
     jax.jit,
     static_argnames=(
         "tsamp", "nharms", "bounds", "capacity", "min_snr", "max_shift",
+        "methods",
     ),
 )
 def search_accel_chunk_legacy(tim_w, accels, mean, std, tsamp, nharms,
-                              bounds, capacity, min_snr, max_shift=None):
+                              bounds, capacity, min_snr, max_shift=None,
+                              methods=None):
     fn = lambda a: search_one_accel_legacy(
         tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr,
-        max_shift,
+        max_shift, methods,
     )
     return jax.vmap(fn)(accels)
 
@@ -311,6 +335,42 @@ class PulsarSearch:
         drivers keep it in HBM across runs)."""
         itemsize = 1 if self.fil.header.nbits <= 8 else 4
         return self.fil.nchans * self.fil.nsamps * itemsize
+
+    # -- peak-extraction method selection (ISSUE 6) -------------------------
+
+    def peaks_methods_for(self, capacity: int) -> tuple:
+        """Concrete extraction lowering per harmonic level at this
+        peak-buffer capacity (search/tuning.py: forced config value,
+        else measured sidecar/default costs, else size heuristic).
+        Resolved OUTSIDE the jitted programs and passed down as a
+        static arg; cached per capacity (escalation re-resolves)."""
+        cache = self.__dict__.setdefault("_peaks_methods_cache", {})
+        got = cache.get(capacity)
+        if got is None:
+            from .tuning import resolve_peaks_methods
+
+            got = resolve_peaks_methods(
+                self.bounds, capacity,
+                forced=self.config.peaks_method,
+                sidecar=self.config.tune_file,
+                pallas_ok=_pallas_mode(),
+            )
+            for m in got:
+                METRICS.inc(f"peaks.method_{m}")
+            cache[capacity] = got
+        return got
+
+    def record_peaks_selection(self, capacity: int | None = None) -> None:
+        """Audit the picked path per (device kind, stop bucket,
+        capacity) into the tune sidecar (once per run)."""
+        cfg = self.config
+        if not cfg.tune_file:
+            return
+        from .tuning import record_peaks_choices
+
+        cap = int(capacity or cfg.peak_capacity)
+        record_peaks_choices(cfg.tune_file, self.bounds, cap,
+                             self.peaks_methods_for(cap))
 
     # -- stages ------------------------------------------------------------
 
@@ -438,7 +498,8 @@ class PulsarSearch:
         # never saw)
         trial_gflops = getattr(self, "_per_trial_gflops", None)
         while True:  # auto-escalate on peak-buffer overflow: no silent
-            all_idxs, all_snrs, all_counts = [], [], []  # candidate loss
+            methods = self.peaks_methods_for(cap)  # candidate loss
+            all_idxs, all_snrs, all_counts = [], [], []
             for c0 in range(0, padded, chunk):
                 n_live = int(min(chunk, n - c0))
                 with span("Accel-Search", metric="accel_search",
@@ -452,14 +513,14 @@ class PulsarSearch:
                             tim_w, chunk_tables[c0], mean, std,
                             float(self.fil.tsamp), cfg.nharmonics,
                             self.bounds, cap, cfg.min_snr, self.max_shift,
-                            self.resample_block,
+                            self.resample_block, methods,
                         )
                     else:
                         batch = jnp.asarray(accs[c0 : c0 + chunk])
                         idxs, snrs, counts = search_accel_chunk_legacy(
                             tim_w, batch, mean, std, float(self.fil.tsamp),
                             cfg.nharmonics, self.bounds, cap, cfg.min_snr,
-                            self.max_shift,
+                            self.max_shift, methods,
                         )
                     sp.block((idxs, snrs, counts))
                 all_idxs.append(np.asarray(idxs))
@@ -708,6 +769,7 @@ class PulsarSearch:
         METRICS.gauge("search.n_dm_trials", len(self.dm_list))
         METRICS.gauge("search.fft_size", self.size)
         costs = record_run_costs(self)["stages"]
+        self.record_peaks_selection()
 
         # consult the checkpoint BEFORE dedispersing: a fully-complete
         # resume only needs trials if folding will run

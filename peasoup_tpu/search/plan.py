@@ -126,6 +126,15 @@ class SearchConfig:
     # only across identical-delay trials, making sub-band output
     # bit-identical to the direct sweep)
     subband_eps: float = 0.5
+    # peak-extraction lowering: "auto" lets search/tuning.py pick per
+    # (device kind, stop bucket, capacity) from measured costs; force
+    # "sort" (approx_max_k/top_k full sorts), "two_stage" (row-reduced
+    # top_k) or "pallas" (threshold-compaction kernel,
+    # ops/peaks_pallas.py) for A/B benchmarking.  All three lowerings
+    # produce identical candidates (slot ORDER differs; every consumer
+    # sorts before the peak merge), so this is a non-identity field —
+    # switching it never invalidates a checkpoint or tune record.
+    peaks_method: str = "auto"
     # run-telemetry sinks (obs/): structured JSONL event log and the
     # machine-readable run_report.json.  Empty = default next to
     # overview.xml in outdir (CLI); presentation-only, never part of

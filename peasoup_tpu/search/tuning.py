@@ -23,6 +23,24 @@ search sizes its buffers from the record, so
 A key mismatch (different input/config) ignores the record; results
 are identical either way — buffer sizes only affect *when* work
 happens, never which candidates are produced.
+
+Peak-extraction method selection (ISSUE 6)
+------------------------------------------
+
+The same sidecar file carries a second, search-key-INDEPENDENT
+section, ``"extraction"``: measured per-spectrum extraction costs and
+the picked lowering per ``(device kind, stop-index bucket, capacity)``
+for the three peak-extraction methods (``sort`` / ``two_stage`` /
+``pallas`` — see ``ops/peaks.py``).  Costs are written by
+``benchmarks/micro.py peaks`` (standalone + in-program device time)
+and ``benchmarks/peaks_sweep.py`` (which also records which two-stage
+(C, stop, cap) cells are SAFE — the r5 sweep crashed a v5e worker at
+C=64/stop=65537, so unsafe cells must never be picked);
+:func:`resolve_peaks_methods` consumes them, falling back to the
+committed v5e defaults (:data:`DEFAULT_EXTRACTION_COSTS`) and then to
+the legacy size heuristic.  The section survives ``save_tuning``
+rewrites and search-key mismatches: extraction costs are a property
+of the device, not of one search.
 """
 
 from __future__ import annotations
@@ -33,6 +51,9 @@ import os
 from ..obs.events import warn_event
 
 _TUNE_VERSION = 1
+
+#: the selectable peak-extraction lowerings (ops/peaks.py)
+EXTRACTION_METHODS = ("sort", "two_stage", "pallas")
 
 
 def load_tuning(path: str, key: str) -> dict | None:
@@ -78,6 +99,11 @@ def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
                "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}
         if row_hw is not None:
             obj["row_hw"] = [int(v) for v in row_hw]
+        # the extraction section is device-keyed, not search-keyed:
+        # carry it across rewrites (and across search-key changes)
+        extraction = load_extraction(path)
+        if extraction:
+            obj["extraction"] = extraction
         with open(tmp, "w") as f:
             json.dump(obj, f)
         os.replace(tmp, path)
@@ -118,3 +144,225 @@ def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,
 def round_up(value: int, quantum: int, lo: int, hi: int) -> int:
     """Round ``value`` up to a multiple of ``quantum``, clamped."""
     return int(min(hi, max(lo, -(-value // quantum) * quantum)))
+
+
+# --------------------------------------------------------------------------
+# peak-extraction method selection (ISSUE 6; see module docstring)
+# --------------------------------------------------------------------------
+
+#: committed v5e measurements (benchmarks/peaks_sweep.json +
+#: benchmarks/micro.py peaks, r6 session): IN-PROGRAM device seconds
+#: per single-spectrum extraction, keyed "stop_bucket/capacity".
+#: In-program, not standalone — the r5 attribution gap: sorts inside
+#: the fused program serialise against the surrounding ops and run
+#: ~1.35x their standalone time, while the compaction kernel's
+#: streaming pass overlaps cleanly (trace_summary_r6.md).  Buckets are
+#: next-power-of-two of the searched prefix (the tutorial's five
+#: harmonic levels land in 16384..131072; production 2^22-bin spectra
+#: in 4194304).  Only relative order matters to the argmin.
+DEFAULT_EXTRACTION_COSTS: dict[str, dict] = {
+    "TPU v5 lite": {
+        "16384/64":    {"sort": 1.8e-5, "two_stage": 9e-6,
+                        "pallas": 3.1e-6},
+        "16384/320":   {"sort": 2.1e-5, "two_stage": 2.8e-5,
+                        "pallas": 3.2e-6},
+        "32768/64":    {"sort": 3.2e-5, "two_stage": 1.2e-5,
+                        "pallas": 4.0e-6},
+        "32768/320":   {"sort": 3.6e-5, "two_stage": 4.6e-5,
+                        "pallas": 4.1e-6},
+        "65536/320":   {"sort": 8.2e-5, "two_stage": 1.04e-4,
+                        "pallas": 5.0e-6},
+        "65536/1024":  {"sort": 8.7e-5, "two_stage": 1.21e-4,
+                        "pallas": 6.9e-6},
+        "131072/64":   {"sort": 6.9e-5, "two_stage": 2.4e-5,
+                        "pallas": 5.9e-6},
+        "131072/320":  {"sort": 7.2e-5, "two_stage": 1.03e-4,
+                        "pallas": 6.2e-6},
+        "131072/1024": {"sort": 7.8e-5, "two_stage": 1.3e-4,
+                        "pallas": 8.8e-6},
+        "131072/2048": {"sort": 8.6e-5, "two_stage": 1.7e-4,
+                        "pallas": 1.2e-5},
+        "4194304/320": {"sort": 8.3e-3, "two_stage": 5.1e-4,
+                        "pallas": 9.7e-5},
+        "4194304/2048": {"sort": 8.9e-3, "two_stage": 9.4e-4,
+                         "pallas": 1.4e-4},
+    },
+}
+
+#: two-stage (row_width, min_stop) cells recorded UNSAFE by the sweep
+#: (subprocess died / backend crash): C=64 with a >= 2^16 searched
+#: prefix kills the v5e worker (Mosaic row count >= 1024 on a 64-lane
+#: tile).  The narrow default (C=128, ops/peaks.py) avoids them; the
+#: sweep refuses to re-run them outside --include-unsafe.
+TWO_STAGE_UNSAFE: dict[str, list] = {
+    "TPU v5 lite": [{"row_width": 64, "min_stop": 65536}],
+}
+
+
+def stop_bucket(stop_idx: int) -> int:
+    """Next-power-of-two bucket of a searched-prefix length (the
+    extraction cost table's row key)."""
+    b = 1
+    while b < max(int(stop_idx), 1):
+        b <<= 1
+    return b
+
+
+def _cost_key(bucket: int, capacity: int) -> str:
+    return f"{int(bucket)}/{int(capacity)}"
+
+
+def _kind_entry(table: dict, device_kind: str | None) -> dict | None:
+    """Case-insensitive substring match of a device kind against the
+    table's keys (same matching rule as ``obs.costmodel.device_peak``)."""
+    if not device_kind:
+        return None
+    norm = str(device_kind).lower()
+    for key, val in table.items():
+        if key.lower() in norm or norm in key.lower():
+            return val
+    return None
+
+
+def load_extraction(path: str) -> dict:
+    """The sidecar's ``"extraction"`` section ({} when absent or
+    unreadable) — deliberately ignores the search-key/version gate:
+    extraction costs belong to the device, not to one search."""
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except Exception:
+        return {}
+    sec = obj.get("extraction")
+    return sec if isinstance(sec, dict) else {}
+
+
+def update_extraction(path: str, device_kind: str, stop_idx: int,
+                      capacity: int, *, costs: dict | None = None,
+                      picked: str | None = None,
+                      safe: bool | None = None) -> None:
+    """Merge one measured-cost / picked-path / safety entry into the
+    sidecar's ``"extraction"`` section (read-modify-write, atomic;
+    every other key of the file is preserved)."""
+    if not path:
+        return
+    try:
+        obj = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    obj = json.load(f)
+            except Exception:
+                obj = {}
+        if not isinstance(obj, dict):
+            obj = {}
+        sec = obj.setdefault("extraction", {})
+        cell = sec.setdefault(str(device_kind), {}).setdefault(
+            _cost_key(stop_bucket(stop_idx), capacity), {})
+        if costs:
+            for m, s in costs.items():
+                if m in EXTRACTION_METHODS and s is not None:
+                    cell[m] = float(s)
+        if picked is not None:
+            cell["picked"] = str(picked)
+        if safe is not None:
+            cell["safe"] = bool(safe)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        warn_event(
+            "tune_io_error",
+            f"could not update extraction sidecar {path!r}: {exc}",
+            path=path, op="update_extraction", error=str(exc),
+        )
+
+
+def _device_kind_default() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def resolve_peaks_methods(bounds, capacity: int, *, forced: str = "auto",
+                          device_kind: str | None = None,
+                          sidecar: str = "",
+                          pallas_ok: str | None = None) -> tuple:
+    """Concrete extraction method per harmonic level.
+
+    ``bounds``: the drivers' per-level (start, stop, freq_factor)
+    tuples; ``forced``: ``SearchConfig.peaks_method`` (a concrete
+    method wins unconditionally — the A/B forcing path; ``"pallas"``
+    stays forced even where the kernel is unavailable, so the ops-
+    level contract-preserving fallback and its warn_event fire);
+    ``pallas_ok``: ``"compiled"`` | ``"interpret"`` | None — how the
+    pallas kernel can run here (``ops.peaks_pallas``).
+
+    Auto resolution per level, in order: a measured sidecar cell for
+    (device kind, stop bucket, capacity) -> cheapest available method;
+    the committed v5e defaults; the legacy size heuristic (two-stage
+    above 2^17, sort below), with compiled pallas preferred on devices
+    the measured tables say nothing about — interpret-mode pallas is
+    never auto-picked (it is a test vehicle, ~100x compiled).
+    """
+    if forced != "auto" and forced not in EXTRACTION_METHODS:
+        from ..errors import ConfigError
+
+        raise ConfigError(
+            f"peaks_method={forced!r}: use auto, "
+            + ", ".join(EXTRACTION_METHODS))
+    if forced == "pallas":
+        # warm the capability probe OUTSIDE any enclosing trace (the
+        # first forced-pallas extract otherwise probes mid-trace)
+        from ..ops.peaks_pallas import pallas_peaks_supported
+
+        pallas_peaks_supported()
+    if forced != "auto":
+        return tuple(forced for _ in bounds)
+    device_kind = device_kind or _device_kind_default()
+    measured = _kind_entry(load_extraction(sidecar), device_kind) or {}
+    builtin = _kind_entry(DEFAULT_EXTRACTION_COSTS, device_kind) or {}
+    avail = ["sort", "two_stage"] + (
+        ["pallas"] if pallas_ok == "compiled" else [])
+    out = []
+    for (_start, stop, _f) in bounds:
+        key = _cost_key(stop_bucket(stop), capacity)
+        cell = measured.get(key) or builtin.get(key) or {}
+        costs = {m: cell[m] for m in avail
+                 if isinstance(cell.get(m), (int, float))}
+        if cell.get("safe") is False:
+            costs.pop("two_stage", None)
+        if costs:
+            out.append(min(costs, key=costs.get))
+        elif pallas_ok == "compiled":
+            out.append("pallas")
+        else:
+            from ..ops.peaks import _TWO_STAGE_MIN_SIZE
+
+            out.append("two_stage" if stop > _TWO_STAGE_MIN_SIZE
+                       else "sort")
+    return tuple(out)
+
+
+def record_peaks_choices(sidecar: str, bounds, capacity: int, methods,
+                         device_kind: str | None = None) -> None:
+    """Record which extraction path a run actually used per (device
+    kind, stop bucket, capacity) — the tuner-sidecar audit trail the
+    acceptance gate reads (and METRICS mirrors as
+    ``peaks.method_<m>`` gauges)."""
+    if not sidecar:
+        return
+    device_kind = device_kind or _device_kind_default()
+    seen = set()
+    for (_start, stop, _f), m in zip(bounds, methods):
+        cell = (stop_bucket(stop), int(capacity))
+        if cell in seen:
+            continue
+        seen.add(cell)
+        update_extraction(sidecar, device_kind, stop, capacity, picked=m)

@@ -39,6 +39,14 @@ from ..obs.history import default_ledger_path, load_history, repo_root
 #: wall-clock, seconds, lower is better)
 GATE_METRIC = "e2e_s"
 
+#: device-time columns the gate ALSO checks (ISSUE 6): wall-clock can
+#: hide a device-side regression behind host/tunnel jitter, so the
+#: peak-extraction share and the pooled search-stage device seconds
+#: (bench.py's ``peaks_device_s`` / ``search_device_s`` metrics) are
+#: gated too.  A metric with fewer than 2 records passes vacuously —
+#: pre-ISSUE-6 ledgers stay green.
+STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s")
+
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
 
@@ -222,6 +230,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metric", default=GATE_METRIC,
                    help=f"gate metric, lower is better "
                         f"(default: {GATE_METRIC})")
+    p.add_argument("--stage-metrics",
+                   default=",".join(STAGE_GATE_METRICS),
+                   help="comma-separated per-stage device-time metrics "
+                        "the gate additionally checks (default: "
+                        f"{','.join(STAGE_GATE_METRICS)}; pass an "
+                        "empty string to gate wall-clock only)")
     p.add_argument("--head", type=int, default=1,
                    help="newest records whose median is gated "
                         "(default: 1)")
@@ -252,9 +266,18 @@ def main(argv=None) -> int:
 
     gate_code, gate_msg = 0, None
     if args.gate:
-        gate_code, gate_msg = regression_gate(
-            records, metric=args.metric, head=args.head,
-            window=args.window, threshold=args.threshold)
+        metrics = [args.metric] + [
+            m.strip() for m in (args.stage_metrics or "").split(",")
+            if m.strip() and m.strip() != args.metric
+        ]
+        codes, msgs = [], []
+        for m in metrics:
+            code, msg = regression_gate(
+                records, metric=m, head=args.head,
+                window=args.window, threshold=args.threshold)
+            codes.append(code)
+            msgs.append(msg)
+        gate_code, gate_msg = max(codes), "\n".join(msgs)
 
     if args.as_json:
         doc = {

@@ -35,6 +35,22 @@ def pallas_interpret():
 
 
 @pytest.fixture(scope="session")
+def peaks_pallas_interpret():
+    """Skip when the peaks threshold-compaction kernel cannot run in
+    interpret mode on this jax build (its own probe: the dedisperse
+    probe's jax-0.4.37 failure is specific to those kernels' internal
+    pjit/i64 boundary and does not gate this kernel).  See
+    ``peasoup_tpu.ops.peaks_pallas.pallas_peaks_supported``."""
+    from peasoup_tpu.ops.peaks_pallas import pallas_peaks_supported
+
+    ok, reason = pallas_peaks_supported()
+    if not ok:
+        pytest.skip(
+            f"peaks pallas kernel unsupported on this jax build: "
+            f"{reason}")
+
+
+@pytest.fixture(scope="session")
 def tutorial_fil() -> str:
     path = os.path.join(REFERENCE, "example_data", "tutorial.fil")
     if not os.path.exists(path):

@@ -362,7 +362,7 @@ def test_dedisperse_pallas_flat_chan_range(pallas_interpret):
     delays = delays_in_samples(dm_list, tab)
     md = max_delay(dm_list, tab)
     slack = dedisperse_window_slack(delays, dm_tile, G)
-    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, T, uint8=True)
+    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, T)
     data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
     parts = [jnp.asarray(p) for p in split_flat_channels(data, align=2 * G)]
     for lo, hi in ((0, 16), (16, 48), (48, 64)):
@@ -472,8 +472,7 @@ def test_dedisperse_pallas_flat_subband_kernel(pallas_interpret):
     delays = delays_in_samples(dm_list, tab)
     md = max_delay(dm_list, tab)
     slack = dedisperse_window_slack(delays, dm_tile, G)
-    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, K * T,
-                                    uint8=True)
+    nsamps = dedisperse_flat_pad_to(out_nsamps, md, slack, K * T)
     data = rng.integers(0, 4, (nchans, nsamps)).astype(np.uint8)
     parts = [jnp.asarray(p) for p in split_flat_channels(data, align=csub)]
     got = np.asarray(dedisperse_pallas_flat_subband(

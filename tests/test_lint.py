@@ -605,3 +605,14 @@ def test_jaxpr_signature_stability():
 
     findings = check_signatures()
     assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_peaks_pallas_kernel_is_lint_clean():
+    """ISSUE-6 satellite: the new threshold-compaction kernel module
+    must be clean under every rule WITHOUT any baseline entry — no
+    grandfathering for new code."""
+    violations, _suppressed, errors = run_rules(
+        ALL_RULES, paths=[os.path.join(
+            REPO, "peasoup_tpu", "ops", "peaks_pallas.py")])
+    assert not errors, errors
+    assert violations == [], "\n".join(v.format() for v in violations)

@@ -584,6 +584,147 @@ def test_extract_top_peaks_two_stage_branch():
     assert np.all(np.diff(sv[iv >= 0]) <= 0)
 
 
+def _extract_ref(spec, thresh, start, stop, cap):
+    """numpy ground truth for extract_above_threshold's contract: the
+    cap smallest qualifying indices ascending, -1 padding, true count."""
+    n = len(spec)
+    i = np.arange(n)
+    m = (i >= start) & (i < min(stop, n)) & (spec > thresh)
+    hits = i[m]
+    k = min(cap, len(hits))
+    out_i = np.full(cap, -1, np.int64)
+    out_s = np.zeros(cap, np.float32)
+    out_i[:k] = hits[:k]
+    out_s[:k] = spec[hits[:k]]
+    return out_i, out_s, int(m.sum())
+
+
+def _edge_shape_cases():
+    """ISSUE-6 satellite: stop_idx at _TWO_STAGE_MIN_SIZE +- 1 (and
+    exactly), count > capacity, zero survivors, start_idx > 0, and
+    non-multiple-of-row-width stops."""
+    from peasoup_tpu.ops.peaks import _TWO_STAGE_MIN_SIZE as M
+
+    # (name, n, start, stop, cap, thresh, hit_stride)
+    return [
+        ("two_stage_min_minus_1", M + 64, 0, M - 1, 64, 9.0, 997),
+        ("two_stage_min_exact", M + 64, 0, M, 64, 9.0, 997),
+        ("two_stage_min_plus_1", M + 64, 0, M + 1, 64, 9.0, 997),
+        ("count_over_capacity", 40000, 0, 39999, 16, 9.0, 101),
+        ("zero_survivors", 30000, 10, 29999, 32, 1e9, 0),
+        ("start_idx_positive", 50000, 12345, 49999, 64, 9.0, 509),
+        ("non_multiple_row_width", 36909 + 7, 100, 36909, 320, 9.0, 601),
+        ("stop_past_size", 20000, 0, 25000, 64, 9.0, 701),
+        ("cap_exceeds_stop", 600, 0, 500, 2048, 9.0, 7),
+    ]
+
+
+@pytest.mark.parametrize(
+    "case", _edge_shape_cases(), ids=lambda c: c[0])
+def test_extract_above_threshold_edge_shapes_xla_methods(case):
+    """Bit-exact agreement of the sort and two-stage lowerings with
+    the numpy reference over the ISSUE-6 edge shapes (the pallas leg
+    runs in test_extract_above_threshold_edge_shapes_pallas — it
+    needs the interpret-mode fixture)."""
+    _name, n, start, stop, cap, thresh, stride = case
+    spec = np.abs(rng.normal(size=n)).astype(np.float32)
+    if stride:
+        spec[::stride] += 11.0
+    want = _extract_ref(spec, thresh, start, stop, cap)
+    for method in ("sort", "two_stage"):
+        gi, gs, gc = extract_above_threshold(
+            jnp.asarray(spec), thresh, start, stop, cap, method=method)
+        np.testing.assert_array_equal(np.asarray(gi), want[0],
+                                      err_msg=method)
+        np.testing.assert_array_equal(np.asarray(gs), want[1],
+                                      err_msg=method)
+        assert int(gc) == want[2], method
+    # narrow row widths must not change the result either
+    for rw in (64, 128, 256):
+        gi, gs, gc = extract_above_threshold(
+            jnp.asarray(spec), thresh, start, stop, cap,
+            method="two_stage", row_width=rw)
+        np.testing.assert_array_equal(np.asarray(gi), want[0],
+                                      err_msg=f"row_width={rw}")
+        assert int(gc) == want[2]
+
+
+@pytest.mark.parametrize(
+    "case", _edge_shape_cases(), ids=lambda c: c[0])
+def test_extract_above_threshold_edge_shapes_pallas(
+        case, peaks_pallas_interpret):
+    """The threshold-compaction kernel (real kernel, interpret mode)
+    must agree bit-for-bit with the numpy reference — and therefore
+    with the other two lowerings — on every edge shape."""
+    _name, n, start, stop, cap, thresh, stride = case
+    spec = np.abs(rng.normal(size=n)).astype(np.float32)
+    if stride:
+        spec[::stride] += 11.0
+    want = _extract_ref(spec, thresh, start, stop, cap)
+    gi, gs, gc = extract_above_threshold(
+        jnp.asarray(spec), thresh, start, stop, cap, method="pallas")
+    np.testing.assert_array_equal(np.asarray(gi), want[0])
+    np.testing.assert_array_equal(np.asarray(gs), want[1])
+    assert int(gc) == want[2]
+
+
+def test_extract_pallas_kernel_vmap(peaks_pallas_interpret):
+    """The hot paths vmap the extraction over accel batches: the
+    kernel's running-offset scratch must reset per spectrum (the
+    batch axis lands as a leading grid axis)."""
+    import jax
+
+    from peasoup_tpu.ops.peaks_pallas import (
+        extract_above_threshold_pallas,
+    )
+
+    B, n, cap = 6, 9000, 64
+    specs = np.abs(rng.normal(size=(B, n))).astype(np.float32) * 3
+    specs[:, ::611] += 9.5
+    f = jax.jit(jax.vmap(
+        lambda s: extract_above_threshold_pallas(
+            s, 2.0, 10, n - 1, cap, block=1024, interpret=True)
+    ))
+    bi, bs, bc = f(jnp.asarray(specs))
+    for b in range(B):
+        wi, ws, wc = _extract_ref(specs[b], 2.0, 10, n - 1, cap)
+        np.testing.assert_array_equal(np.asarray(bi[b]), wi)
+        np.testing.assert_array_equal(np.asarray(bs[b]), ws)
+        assert int(bc[b]) == wc
+
+
+def test_extract_top_peaks_method_parity():
+    """All lowerings of the value-ordered extractor deliver the SAME
+    hit set/pairing when count <= capacity (slot order differs by
+    contract: SNR-descending for sort/two_stage, index-ascending for
+    pallas's XLA fallback — consumers sort either way)."""
+    from peasoup_tpu.ops.peaks import extract_top_peaks
+
+    n = 20000
+    spec = np.abs(rng.normal(size=n)).astype(np.float32)
+    spec[::997] += 10.0
+    i = np.arange(n)
+    m = (i >= 50) & (i < n - 13) & (spec > 9.0)
+    assert m.sum() <= 64
+    for method in ("sort", "two_stage"):
+        iv, sv, cv = extract_top_peaks(
+            jnp.asarray(spec), 9.0, 50, n - 13, 64, method=method)
+        iv, sv = np.asarray(iv), np.asarray(sv)
+        assert int(cv) == int(m.sum()), method
+        np.testing.assert_array_equal(np.sort(iv[iv >= 0]), i[m],
+                                      err_msg=method)
+        np.testing.assert_allclose(sv[iv >= 0], spec[iv[iv >= 0]],
+                                   rtol=1e-6, err_msg=method)
+
+
+def test_extract_method_validation():
+    from peasoup_tpu.ops.peaks import extract_top_peaks
+
+    spec = jnp.zeros(100, jnp.float32)
+    with pytest.raises(ValueError, match="peaks method"):
+        extract_top_peaks(spec, 1.0, 0, 100, 8, method="bogus")
+
+
 def test_harmonic_sums_pallas_exact_interpret(pallas_interpret):
     """The fused Pallas TPU kernel (interpret mode on CPU) must be
     bit-identical with the gather formulation, plain and under vmap

@@ -278,3 +278,33 @@ def test_pick_row_capacity_ignores_pathological_rows():
     row_hw2 = [1300] * 400 + [100] * 100
     cap2 = pick_row_capacity(row_hw2, n_accel_trials=2688)
     assert cap2 >= 1332
+
+
+def test_onehot_selection_exact_on_device():
+    """ADVICE round-5 closeout: the kernel2 stage-2 bf16 one-hot row
+    selection must be proven bit-identical to a jnp.take gather ON
+    DEVICE (the prior test only compared a host f32 np.einsum).  The
+    helper caches per backend, so the second call is free."""
+    from peasoup_tpu.parallel.mesh import (
+        _onehot_exact_checked,
+        assert_onehot_selection_exact,
+    )
+
+    assert_onehot_selection_exact()  # must not raise on this backend
+    assert any(k[1] == "bfloat16" and k[2] == "float32"
+               for k in _onehot_exact_checked)
+    assert_onehot_selection_exact()  # cached second call
+
+
+def test_onehot_selection_assert_trips_on_inexact_dtype():
+    """The assert must actually DETECT inexactness: pushing the values
+    operand through bfloat16 truncates full-precision mantissas and
+    has to raise, proving the checker would catch a backend whose
+    HIGHEST precision is not an exact limb decomposition."""
+    import jax.numpy as jnp
+
+    from peasoup_tpu.errors import DomainError
+    from peasoup_tpu.parallel.mesh import assert_onehot_selection_exact
+
+    with pytest.raises(DomainError, match="NOT bit-exact"):
+        assert_onehot_selection_exact(value_dtype=jnp.bfloat16)

@@ -1,0 +1,378 @@
+"""ISSUE-6 vertical: tuner-driven peak-extraction method selection.
+
+Covers the measured-cost sidecar (search/tuning.py ``extraction``
+section), the per-level resolution and its safety/availability rules,
+the picked-path audit trail, the costmodel's per-method peaks formula,
+the perf gate's new stage device-time columns, the sweep harness, and
+end-to-end forced-method candidate parity on both drivers.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.search import tuning
+
+
+BOUNDS = ((1, 9228, 0.1), (2, 18456, 0.05), (4, 36909, 0.025),
+          (8, 65537, 0.0125), (16, 65537, 0.00625))
+
+
+# --------------------------------------------------------------------------
+# tuning-layer unit tests
+# --------------------------------------------------------------------------
+
+def test_stop_bucket_powers_of_two():
+    assert tuning.stop_bucket(1) == 1
+    assert tuning.stop_bucket(9216) == 16384
+    assert tuning.stop_bucket(16384) == 16384
+    assert tuning.stop_bucket(36909) == 65536
+    assert tuning.stop_bucket(65537) == 131072
+
+
+def test_update_extraction_roundtrip_and_save_tuning_preserves(tmp_path):
+    side = str(tmp_path / "tune.json")
+    tuning.update_extraction(side, "TPU v5 lite", 65537, 320,
+                             costs={"sort": 5.4e-5, "pallas": 6.2e-6})
+    tuning.update_extraction(side, "TPU v5 lite", 65537, 320,
+                             picked="pallas")
+    sec = tuning.load_extraction(side)
+    cell = sec["TPU v5 lite"]["131072/320"]
+    assert cell["pallas"] == pytest.approx(6.2e-6)
+    assert cell["picked"] == "pallas"
+    # the buffer-tuning writer must carry the section across rewrites
+    tuning.save_tuning(side, "some-search-key", 100, 2000)
+    assert tuning.load_tuning(side, "some-search-key")["cap_hw"] == 100
+    sec2 = tuning.load_extraction(side)
+    assert sec2 == sec
+    # and a search-key MISMATCH still exposes the extraction section
+    assert tuning.load_tuning(side, "other-key") is None
+    assert tuning.load_extraction(side)["TPU v5 lite"]
+
+
+def test_resolve_forced_method_wins_everywhere(tmp_path):
+    for forced in ("sort", "two_stage", "pallas"):
+        got = tuning.resolve_peaks_methods(
+            BOUNDS, 320, forced=forced, device_kind="TPU v5 lite")
+        assert got == (forced,) * len(BOUNDS)
+
+
+def test_resolve_rejects_unknown_method():
+    from peasoup_tpu.errors import ConfigError
+
+    with pytest.raises(ConfigError, match="peaks_method"):
+        tuning.resolve_peaks_methods(BOUNDS, 320, forced="quantum")
+
+
+def test_resolve_uses_measured_sidecar_argmin(tmp_path):
+    side = str(tmp_path / "tune.json")
+    # measured: two_stage cheapest at this cell, pallas cheapest but
+    # NOT available (pallas_ok=None) -> two_stage must win
+    tuning.update_extraction(side, "cpu", 9228, 64,
+                             costs={"sort": 5e-5, "two_stage": 1e-5,
+                                    "pallas": 1e-6})
+    got = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1),), 64, device_kind="cpu", sidecar=side,
+        pallas_ok=None)
+    assert got == ("two_stage",)
+    got = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1),), 64, device_kind="cpu", sidecar=side,
+        pallas_ok="compiled")
+    assert got == ("pallas",)
+
+
+def test_resolve_skips_unsafe_two_stage_cells(tmp_path):
+    side = str(tmp_path / "tune.json")
+    tuning.update_extraction(side, "cpu", 9228, 64,
+                             costs={"sort": 5e-5, "two_stage": 1e-5},
+                             safe=False)
+    got = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1),), 64, device_kind="cpu", sidecar=side,
+        pallas_ok=None)
+    assert got == ("sort",)
+
+
+def test_resolve_default_table_picks_pallas_on_v5e():
+    """The committed v5e sweep numbers make the compaction kernel the
+    tuned pick at the tutorial's dominant cells when compiled pallas
+    is available."""
+    got = tuning.resolve_peaks_methods(
+        BOUNDS, 320, device_kind="TPU v5 lite", pallas_ok="compiled")
+    assert set(got) == {"pallas"}
+    # without the kernel, the small-cap cells fall to two_stage where
+    # the sweep measured it faster, sort otherwise
+    got64 = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1), (8, 65537, 0.0125)), 64,
+        device_kind="TPU v5 lite", pallas_ok=None)
+    assert got64 == ("two_stage", "two_stage")
+    got320 = tuning.resolve_peaks_methods(
+        ((8, 65537, 0.0125),), 320,
+        device_kind="TPU v5 lite", pallas_ok=None)
+    assert got320 == ("sort",)
+
+
+def test_resolve_heuristic_matches_legacy_on_unknown_device():
+    from peasoup_tpu.ops.peaks import _TWO_STAGE_MIN_SIZE
+
+    bounds = ((0, 9228, 1.0), (0, _TWO_STAGE_MIN_SIZE + 1, 1.0))
+    got = tuning.resolve_peaks_methods(
+        bounds, 320, device_kind="weird-device-9000", pallas_ok=None)
+    assert got == ("sort", "two_stage")
+    # a TPU generation with no table entry prefers the compiled kernel
+    got = tuning.resolve_peaks_methods(
+        bounds, 320, device_kind="weird-device-9000",
+        pallas_ok="compiled")
+    assert got == ("pallas", "pallas")
+
+
+def test_record_peaks_choices_audit_trail(tmp_path):
+    side = str(tmp_path / "tune.json")
+    methods = ("sort", "sort", "two_stage", "pallas", "pallas")
+    tuning.record_peaks_choices(side, BOUNDS, 320, methods,
+                                device_kind="cpu")
+    sec = tuning.load_extraction(side)["cpu"]
+    assert sec["16384/320"]["picked"] == "sort"
+    assert sec["65536/320"]["picked"] == "two_stage"
+    assert sec["131072/320"]["picked"] == "pallas"
+
+
+# --------------------------------------------------------------------------
+# costmodel: the compaction formula
+# --------------------------------------------------------------------------
+
+def test_peaks_cost_per_method_formulas():
+    from peasoup_tpu.obs import costmodel as cm
+
+    nb, cap = 1 << 20, 320
+    sort = cm.peaks_cost(nb, cap, "sort")
+    two = cm.peaks_cost(nb, cap, "two_stage")
+    pal = cm.peaks_cost(nb, cap, "pallas")
+    # the compaction is O(n + survivors): far fewer flops than the
+    # sort's n log k selection network at large n
+    assert pal.flops < two.flops < sort.flops
+    # identical traffic model: all three stream the prefix once and
+    # write the same fixed-capacity buffers
+    for c in (sort, two, pal):
+        assert c.bytes_read == nb * 4
+        assert c.bytes_written == cap * 8
+    # compaction intensity ~2 flops/byte -> memory-roof bound
+    peak = cm.device_peak("TPU v5 lite")
+    assert pal.dominant(peak) == "memory"
+    assert cm.peaks_cost(nb, cap).flops == sort.flops  # default=sort
+
+
+def test_pipeline_geometry_carries_peaks_method():
+    from peasoup_tpu.obs import costmodel as cm
+
+    geom = cm.PipelineGeometry(
+        n_dm=4, nchans=16, out_nsamps=1 << 18, in_itemsize=1,
+        size=1 << 18, nharmonics=2, peak_capacity=64, n_trials_total=12,
+        npdmp=0, fold_nsamps=1 << 17, fold_nbins=64, fold_nints=16,
+        peaks_method="pallas")
+    js = geom.to_json()
+    assert js["peaks_method"] == "pallas"
+    costs = cm.pipeline_costs(geom)
+    geom_sort = cm.PipelineGeometry(**{**js, "peaks_method": "sort"})
+    costs_sort = cm.pipeline_costs(geom_sort)
+    assert costs["peaks"].flops < costs_sort["peaks"].flops
+
+
+# --------------------------------------------------------------------------
+# perf gate: stage device-time columns
+# --------------------------------------------------------------------------
+
+def _ledger(tmp_path, rows):
+    path = str(tmp_path / "history.jsonl")
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return path
+
+
+def _bench_rec(e2e, peaks=None):
+    rec = {"v": 1, "kind": "bench", "metrics": {"e2e_s": e2e}}
+    if peaks is not None:
+        rec["metrics"]["peaks_device_s"] = peaks
+    return rec
+
+
+def test_gate_trips_on_peaks_device_time_regression(tmp_path):
+    from peasoup_tpu.tools.perf_report import main as pr_main
+
+    rows = [_bench_rec(0.37, 0.007) for _ in range(6)]
+    rows.append(_bench_rec(0.37, 0.064))  # sort wall came back
+    path = _ledger(tmp_path, rows)
+    rc = pr_main(["--gate", "--ledger", path, "--legacy-glob", ""])
+    assert rc == 1
+    # wall-clock alone would NOT have caught it
+    rc = pr_main(["--gate", "--ledger", path, "--legacy-glob", "",
+                  "--stage-metrics", ""])
+    assert rc == 0
+
+
+def test_gate_passes_without_stage_columns(tmp_path):
+    from peasoup_tpu.tools.perf_report import main as pr_main
+
+    rows = [_bench_rec(0.37) for _ in range(5)]
+    path = _ledger(tmp_path, rows)
+    assert pr_main(["--gate", "--ledger", path,
+                    "--legacy-glob", ""]) == 0
+
+
+def test_gate_clean_stage_columns_pass(tmp_path):
+    from peasoup_tpu.tools.perf_report import main as pr_main
+
+    rows = [_bench_rec(0.37, 0.06) for _ in range(5)]
+    rows.append(_bench_rec(0.33, 0.007))  # the ISSUE-6 improvement
+    path = _ledger(tmp_path, rows)
+    assert pr_main(["--gate", "--ledger", path,
+                    "--legacy-glob", ""]) == 0
+
+
+# --------------------------------------------------------------------------
+# sweep harness
+# --------------------------------------------------------------------------
+
+def test_sweep_cell_in_process_structure():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "peaks_sweep", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "peaks_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+    cell = sweep.run_cell(128, 9216, 64, iters=2)
+    assert cell["safe"] is True and cell["exact"] is True
+    assert cell["row_width"] == 128 and cell["stop"] == 9216
+    assert "sort" in cell["ms_per_batch8"]
+    assert "two_stage" in cell["ms_per_batch8"]
+
+
+def test_sweep_carries_unsafe_cells_forward(tmp_path):
+    """A cell the artifact marks unsafe is NEVER re-executed by
+    default — the r5 C=64/stop=65537 v5e crash must not be
+    reproducible by an innocent re-run."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "peaks_sweep2", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "peaks_sweep.py"))
+    sweep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sweep)
+    out = str(tmp_path / "sweep.json")
+    prior = {
+        "cells": {
+            sweep.cell_key(c, s, k): {
+                "row_width": c, "stop": s, "cap": k, "safe": False,
+                "errors": ["prior worker crash"],
+            }
+            for c in sweep.ROW_WIDTHS for s in sweep.STOPS
+            for k in sweep.CAPS
+        }
+    }
+    with open(out, "w") as f:
+        json.dump(prior, f)
+    # every cell carried forward -> no subprocesses, near-instant
+    rc = sweep.main(["--out", out])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["n_unsafe"] == len(doc["cells"])
+    assert all(v.get("skipped") for v in doc["cells"].values())
+
+
+def test_committed_sweep_artifact_matches_tuner_safety():
+    """The committed v5e sweep artifact and the tuner's built-in
+    unsafe-cell table must agree: every unsafe artifact cell is a
+    C=64 / stop >= 2^16 cell (the r5 crash signature)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "peaks_sweep.json")
+    doc = json.load(open(path))
+    unsafe = [v for v in doc["cells"].values() if not v.get("safe")]
+    assert unsafe, "the r5 crash cells must be recorded"
+    for cell in unsafe:
+        assert cell["row_width"] == 64 and cell["stop"] >= 65536
+    # and every safe two-stage cell was exactness-verified
+    for v in doc["cells"].values():
+        if v.get("safe"):
+            assert v.get("exact") is True
+
+
+# --------------------------------------------------------------------------
+# end-to-end: forced methods produce identical candidates
+# --------------------------------------------------------------------------
+
+def _synthetic_fil(tmp_path, nsamps=8192, nchans=16):
+    from peasoup_tpu.tools.serve_smoke import _write_synthetic
+
+    return _write_synthetic(str(tmp_path / "obs.fil"), nsamps=nsamps,
+                            nchans=nchans)
+
+
+def _run_search(fil_path, method, mesh=False, tune_file=""):
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = read_filterbank(fil_path)
+    cfg = SearchConfig(
+        dm_start=0.0, dm_end=30.0, acc_start=-2.0, acc_end=2.0,
+        acc_pulse_width=64000.0, nharmonics=2, npdmp=0, min_snr=6.0,
+        peaks_method=method, tune_file=tune_file,
+    )
+    search = (MeshPulsarSearch(fil, cfg, max_devices=2) if mesh
+              else PulsarSearch(fil, cfg))
+    result = search.run()
+    return sorted((round(c.freq, 9), round(c.snr, 5), c.dm_idx, c.nh)
+                  for c in result.candidates)
+
+
+def test_forced_methods_host_loop_parity(tmp_path):
+    fil_path = _synthetic_fil(tmp_path)
+    base = _run_search(fil_path, "auto")
+    assert base, "synthetic pulse train must yield candidates"
+    for method in ("sort", "two_stage"):
+        assert _run_search(fil_path, method) == base, method
+
+
+def test_forced_pallas_host_loop_parity(tmp_path, peaks_pallas_interpret):
+    fil_path = _synthetic_fil(tmp_path)
+    base = _run_search(fil_path, "auto")
+    assert _run_search(fil_path, "pallas") == base
+
+
+def test_forced_methods_mesh_parity_and_sidecar(tmp_path):
+    fil_path = _synthetic_fil(tmp_path)
+    tune = str(tmp_path / "tune.json")
+    base = _run_search(fil_path, "auto", mesh=True, tune_file=tune)
+    assert base
+    # the audit trail recorded a picked path per (bucket, capacity)
+    sec = tuning.load_extraction(tune)
+    assert sec, "mesh run must record its picked extraction paths"
+    kinds = list(sec)
+    cells = sec[kinds[0]]
+    assert cells and all("picked" in c for c in cells.values())
+    for method in ("sort", "two_stage"):
+        assert _run_search(fil_path, method, mesh=True) == base, method
+
+
+def test_run_report_reflects_peaks_method(tmp_path):
+    """The costmodel geometry (run_report perf section input) carries
+    the resolved lowering of the deepest level."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.obs.costmodel import get_run_costs
+    from peasoup_tpu.search.pipeline import PulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil_path = _synthetic_fil(tmp_path)
+    fil = read_filterbank(fil_path)
+    cfg = SearchConfig(dm_start=0.0, dm_end=10.0, nharmonics=1,
+                       npdmp=0, min_snr=6.0, peaks_method="two_stage")
+    PulsarSearch(fil, cfg).run()
+    geom = get_run_costs()["geometry"]
+    assert geom.peaks_method == "two_stage"
