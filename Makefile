@@ -5,8 +5,9 @@ PY ?= python
 TUTORIAL ?= /root/reference/example_data/tutorial.fil
 SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
+FLEET_SMOKE_DIR ?= /tmp/peasoup-fleet-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -59,3 +60,13 @@ trace-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.serve_smoke \
 	    --dir $(SERVE_SMOKE_DIR)
+
+# fleet control-plane smoke test: two real fleet-worker processes (fake
+# membership) drain one spool — 2 done + 1 quarantined with zero
+# double-claims and per-host store shards — then a worker is SIGKILLed
+# mid-job and `requeue --expired` recovers its lease-expired job with
+# the attempt history intact; merged-shard coincidence must equal a
+# single store and `status --fleet` must aggregate every host
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.fleet_smoke \
+	    --dir $(FLEET_SMOKE_DIR)

@@ -30,7 +30,11 @@ Record schema (``v`` = 1; consumers tolerate additions)::
 loop (``serve/worker.py``) with metrics ``jobs_claimed``,
 ``jobs_succeeded``, ``jobs_failed``, ``elapsed_s`` and
 ``jobs_per_hour`` — the survey-throughput headline the perf tooling
-trends alongside the per-run benchmark figures.
+trends alongside the per-run benchmark figures.  In fleet mode
+(``serve/fleet.py``) every host appends its own record with
+``config.host`` set to its fleet label, so per-host throughput can be
+trended — and summed — from the same ledger ``status --fleet``
+aggregates live.
 
 Ledger I/O never raises into a benchmark run: append/load failures
 warn and return best-effort results.

@@ -59,9 +59,31 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK = 8192
 
 #: lane chunk of the survivor scatter: the one-hot select materialises
-#: (capacity_padded, _SCATTER_CHUNK) i32/f32 tiles, <= 2 MB at the
-#: sweep's largest capacity (2048).
+#: (capacity_padded, chunk) i32/f32 tiles, <= 2 MB at the sweep's
+#: largest capacity (2048).  :func:`_scatter_chunk_for` narrows the
+#: chunk for bigger capacities so the transient tiles stay within
+#: :data:`_SCATTER_TILE_BYTES` of VMEM.
 _SCATTER_CHUNK = 512
+
+#: VMEM ceiling for one transient one-hot scatter tile (i32/f32).
+_SCATTER_TILE_BYTES = 4 * 1024 * 1024
+
+#: largest whole-buffer compaction capacity routed to this kernel by
+#: the fused drivers (``parallel/mesh._compact_peaks``): at the
+#: narrowest scatter chunk (128 lanes) an 8192-slot output keeps the
+#: one-hot tile at 4 MB.  Tuned compact_k is rounded up in 8192 quanta
+#: with 8192 as the floor, so the gate admits exactly the tuned
+#: common case; bigger (untuned) buffers keep the XLA cumsum+scatter.
+COMPACT_PALLAS_MAX_K = 8192
+
+
+def _scatter_chunk_for(cap_p: int) -> int:
+    """Widest power-of-two lane chunk (>= 128) whose one-hot tile fits
+    :data:`_SCATTER_TILE_BYTES`."""
+    chunk = _SCATTER_CHUNK
+    while chunk > 128 and cap_p * chunk * 4 > _SCATTER_TILE_BYTES:
+        chunk //= 2
+    return chunk
 
 
 def _inclusive_scan_lanes(x: jnp.ndarray, width: int) -> jnp.ndarray:
@@ -79,6 +101,7 @@ def _inclusive_scan_lanes(x: jnp.ndarray, width: int) -> jnp.ndarray:
 def _compact_kernel(
     spec_ref, idx_ref, snr_ref, cnt_ref, off_ref,
     *, block, cap_p, capacity, thresh, start_idx, stop_idx,
+    scatter_chunk=_SCATTER_CHUNK,
 ):
     """One grid step = one spectrum block (see module docstring)."""
     bi = pl.program_id(0)
@@ -118,19 +141,19 @@ def _compact_kernel(
         ).astype(jnp.int32)
         slots = jax.lax.broadcasted_iota(jnp.int32, (cap_p, 1), 0)
         open_slot = slots < jnp.int32(capacity)
-        for c0 in range(0, block, _SCATTER_CHUNK):
-            d = dest[:, c0 : c0 + _SCATTER_CHUNK]  # (1, CHUNK)
+        for c0 in range(0, block, scatter_chunk):
+            d = dest[:, c0 : c0 + scatter_chunk]  # (1, CHUNK)
 
             @pl.when(jnp.any(d >= jnp.int32(0)))
             def _chunk(d=d, c0=c0):
                 onehot = (d == slots) & open_slot  # (cap_p, CHUNK)
                 filled = jnp.any(onehot, axis=1, keepdims=True)
                 gi = jnp.sum(
-                    jnp.where(onehot, gidx[:, c0 : c0 + _SCATTER_CHUNK],
+                    jnp.where(onehot, gidx[:, c0 : c0 + scatter_chunk],
                               jnp.int32(0)),
                     axis=1, keepdims=True, dtype=jnp.int32)
                 gv = jnp.sum(
-                    jnp.where(onehot, vals[:, c0 : c0 + _SCATTER_CHUNK],
+                    jnp.where(onehot, vals[:, c0 : c0 + scatter_chunk],
                               jnp.float32(0.0)),
                     axis=1, keepdims=True)
                 idx_ref[...] = jnp.where(
@@ -190,6 +213,7 @@ def extract_above_threshold_pallas(
             _compact_kernel,
             block=block, cap_p=cap_p, capacity=k_eff,
             thresh=float(thresh), start_idx=start_idx, stop_idx=stop_idx,
+            scatter_chunk=min(_scatter_chunk_for(cap_p), block),
         ),
         grid=(nblocks,),
         in_specs=[
@@ -215,6 +239,42 @@ def extract_above_threshold_pallas(
         idxs = jnp.pad(idxs, (0, capacity - k_eff), constant_values=-1)
         snrs = jnp.pad(snrs, (0, capacity - k_eff))
     return idxs, snrs, count
+
+
+def compact_valid_slots_pallas(flat_idx, flat_val, compact_k: int,
+                               *, interpret: bool = False):
+    """Whole-buffer stream compaction on the threshold kernel: the
+    first ``compact_k`` VALID (``idx >= 0``) slots of a flat peak
+    buffer, in slot order — the drop-in device-side replacement for
+    ``parallel/mesh._compact_peaks``'s cumsum+scatter lowering.
+
+    Validity IS a threshold test: run the kernel on the slot buffer
+    cast to f32 with ``thresh=-0.5`` (any non-negative int32 casts to
+    ``>= 0.0``; the -1 sentinel to exactly -1.0, so rounding at large
+    indices cannot flip the predicate) and it returns the ``compact_k``
+    smallest valid SLOT POSITIONS in ascending order — precisely the
+    slots the cumsum+scatter keeps (both retain the first ``compact_k``
+    valid entries in flat order; the scatter drops the overflow via
+    ``mode="drop"``, the kernel by its capacity gate) — plus the TRUE
+    valid count.  The (index, value) payload is then an exact int32/f32
+    gather at those positions, so the result is bit-identical to the
+    XLA path (tests/test_ops.py asserts this on random buffers).
+
+    Returns ``(sel_idx, sel_val, nvalid)`` shaped ``(compact_k,)``,
+    ``(compact_k,)``, scalar — -1/0.0 padding beyond ``nvalid``.
+    """
+    n = flat_idx.shape[0]
+    slots, _, nvalid = extract_above_threshold_pallas(
+        flat_idx.astype(jnp.float32), -0.5, 0, n, int(compact_k),
+        interpret=interpret,
+    )
+    ok = slots >= 0
+    at = jnp.clip(slots, 0, n - 1)
+    sel_idx = jnp.where(ok, flat_idx[at],
+                        jnp.asarray(-1, flat_idx.dtype))
+    sel_val = jnp.where(ok, flat_val[at].astype(jnp.float32),
+                        jnp.float32(0.0))
+    return sel_idx, sel_val, nvalid
 
 
 _peaks_probe: tuple[bool, str] | None = None

@@ -176,7 +176,7 @@ def assert_onehot_selection_exact(select_dtype=jnp.bfloat16,
 from functools import lru_cache
 
 
-def _compact_peaks(idxs, snrs, counts, compact_k):
+def _compact_peaks(idxs, snrs, counts, compact_k, method: str = "xla"):
     """Shared device-side tail of both fused programs: compact all
     (dm, accel, level) peak buffers of a shard into one packed f32
     buffer (layout documented in :func:`build_fused_search`).
@@ -189,7 +189,14 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
     can never desynchronise the (dm, accel, level) attribution of
     later spectra — it surfaces as ``delivered < min(count, cap)`` on
     the affected spectrum, which the drivers re-search like any
-    clipped row."""
+    clipped row.
+
+    ``method``: ``"xla"`` (cumsum+scatter) or ``"pallas"`` (the
+    ops/peaks_pallas.py threshold-compaction kernel applied to slot
+    validity — bit-identical output, O(n) streaming instead of a
+    whole-buffer cumsum+scatter pair).  The drivers pick via
+    :meth:`MeshPulsarSearch.compact_method_for`.
+    """
     ns = counts.reshape(-1).shape[0]
     delivered = jnp.sum(
         (idxs >= 0).reshape(ns, -1), axis=1, dtype=jnp.int32)
@@ -202,25 +209,38 @@ def _compact_peaks(idxs, snrs, counts, compact_k):
             f"reduce peak_capacity, accel count per dispatch "
             f"(accel_block) or DM rows per shard"
         )
-    valid = flat_bin >= 0
-    # stream compaction via cumsum + scatter.  (A top_k(score,
-    # compact_k) formulation is algebraically equivalent but k ~ 10^5
-    # top_k MISCOMPILES on v5e: shape-dependent garbage output or a
-    # TPU worker crash.  The scatter runs once per dispatch.)
-    pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
-    dest = jnp.where(valid, pos, compact_k)  # OOB -> dropped
-    # the host reconstructs each entry's (dm, accel, level, slot) tag
-    # from ``counts`` alone: valid slots appear in flat spectrum
-    # order, so only bins+snrs are shipped
-    sel_bin = (
-        jnp.full((compact_k,), -1, flat_bin.dtype)
-        .at[dest].set(flat_bin, mode="drop")
-    )
-    sel_snr = (
-        jnp.zeros((compact_k,), jnp.float32)
-        .at[dest].set(flat_snr.astype(jnp.float32), mode="drop")
-    )
-    nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
+    if method == "pallas":
+        from ..ops.peaks_pallas import (
+            compact_valid_slots_pallas,
+            pallas_peaks_interpret,
+        )
+
+        sel_bin, sel_snr, nv = compact_valid_slots_pallas(
+            flat_bin, flat_snr, compact_k,
+            interpret=pallas_peaks_interpret(),
+        )
+        nvalid = nv.reshape(-1)[:1].astype(jnp.int32)
+    else:
+        valid = flat_bin >= 0
+        # stream compaction via cumsum + scatter.  (A top_k(score,
+        # compact_k) formulation is algebraically equivalent but
+        # k ~ 10^5 top_k MISCOMPILES on v5e: shape-dependent garbage
+        # output or a TPU worker crash.  The scatter runs once per
+        # dispatch.)
+        pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+        dest = jnp.where(valid, pos, compact_k)  # OOB -> dropped
+        # the host reconstructs each entry's (dm, accel, level, slot)
+        # tag from ``counts`` alone: valid slots appear in flat
+        # spectrum order, so only bins+snrs are shipped
+        sel_bin = (
+            jnp.full((compact_k,), -1, flat_bin.dtype)
+            .at[dest].set(flat_bin, mode="drop")
+        )
+        sel_snr = (
+            jnp.zeros((compact_k,), jnp.float32)
+            .at[dest].set(flat_snr.astype(jnp.float32), mode="drop")
+        )
+        nvalid = jnp.sum(valid, dtype=jnp.int32)[None]
     counts_f = counts.reshape(-1)
     # pack everything into ONE f32 buffer so the host pays a single
     # device->host round trip.  Every int travels as TWO 16-bit halves
@@ -269,6 +289,7 @@ def build_fused_search(
     dedisp_pallas: tuple | None = None,
     quantise: bool = False,
     peaks_methods: tuple | None = None,
+    compact_method: str = "xla",
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -393,7 +414,8 @@ def build_fused_search(
         counts = jnp.where(valid[:, None], counts, 0)
         # flat batch is (dm-major, accel) row order — exactly the
         # (dm, accel, level, slot) layout _compact_peaks flattens to
-        packed = _compact_peaks(idxs, snrs, counts, compact_k)
+        packed = _compact_peaks(idxs, snrs, counts, compact_k,
+                                compact_method)
         return packed, trials
 
     mapped = _shard_map(
@@ -444,6 +466,7 @@ def build_chunked_search(
     subband: tuple | None = None,
     quantise_nbits: int = 0,
     peaks_methods: tuple | None = None,
+    compact_method: str = "xla",
 ):
     """Bounded-HBM variant of :func:`build_fused_search`.
 
@@ -660,7 +683,8 @@ def build_chunked_search(
         idxs = idxs.reshape(ndm_local, namax, nlevels, capacity)
         snrs = snrs.reshape(ndm_local, namax, nlevels, capacity)
         counts = counts.reshape(ndm_local, namax, nlevels)
-        return _compact_peaks(idxs, snrs, counts, compact_k)
+        return _compact_peaks(idxs, snrs, counts, compact_k,
+                              compact_method)
 
     if subband is None:
         sb_specs = ()
@@ -695,6 +719,32 @@ class MeshPulsarSearch(PulsarSearch):
     def _padded_trial_count(self) -> int:
         ndm = len(self.dm_list)
         return int(np.ceil(ndm / self.ndev)) * self.ndev
+
+    def compact_method_for(self, compact_k: int) -> str:
+        """Lowering of the whole-buffer stream compaction
+        (:func:`_compact_peaks`): the ops/peaks_pallas.py threshold-
+        compaction kernel replaces the cumsum+scatter when the
+        compiled kernel is available and the compacted buffer is small
+        enough that the kernel's one-hot scatter tiles stay in VMEM.
+        ``COMPACT_PALLAS_MAX_K`` admits exactly the tuned common case
+        (the drivers round ``ck_hw`` up in 8192 quanta with an 8192
+        floor); bigger untuned buffers keep the XLA lowering.  Forced
+        ``peaks_method="sort"/"two_stage"`` pins XLA — the compaction
+        is peak-path machinery, so the A/B forcing flag governs it
+        too; forced ``"pallas"`` off-TPU stays XLA here (an interpret-
+        mode compaction inside the fused program would serialise the
+        whole dispatch ~100x; per-level extraction keeps its own
+        forced-pallas fallback story).
+        """
+        from ..ops.peaks_pallas import COMPACT_PALLAS_MAX_K
+        from ..search.pipeline import _pallas_mode
+
+        if (int(compact_k) <= COMPACT_PALLAS_MAX_K
+                and self.config.peaks_method in ("auto", "pallas")
+                and _pallas_mode() == "compiled"):
+            METRICS.inc("peaks.compact_pallas")
+            return "pallas"
+        return "xla"
 
     def _plan_fused_pallas_dedisp(self) -> dict | None:
         """Flat Pallas-kernel dedispersion for the FUSED path.
@@ -1509,6 +1559,7 @@ class MeshPulsarSearch(PulsarSearch):
                     if cfg.trial_nbits == 8 else 0
                 ),
                 peaks_methods=self.peaks_methods_for(cap_),
+                compact_method=self.compact_method_for(ck_),
             )
 
         n_chunks = ndm_local_p // dm_chunk
@@ -2130,6 +2181,7 @@ class MeshPulsarSearch(PulsarSearch):
                 ),
                 quantise=cfg.trial_nbits == 8,
                 peaks_methods=self.peaks_methods_for(capacity),
+                compact_method=self.compact_method_for(ck),
             )
 
         METRICS.inc("runs.mesh_fused")

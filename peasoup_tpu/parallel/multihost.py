@@ -58,6 +58,25 @@ def initialize(coordinator_address: str | None = None,
         pass
 
 
+def process_identity() -> tuple[int, int]:
+    """This host's ``(process_index, process_count)`` in the slice.
+
+    The fleet control plane (``serve/fleet.py``) derives each host's
+    membership — which spool worker identity it runs and which
+    candidate-store shard it owns — from exactly this pair, after
+    :func:`initialize` has (maybe) brought up jax.distributed.
+    Returns ``(0, 1)`` for a plain single-process run, or when jax
+    itself is unavailable: the serve layer must keep operating on a
+    login/submit node with no accelerator runtime.
+    """
+    try:
+        import jax
+
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
+
+
 def global_mesh(axis: str = "dm"):
     """1-D mesh over every device of every participating host."""
     import jax

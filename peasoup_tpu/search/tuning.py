@@ -212,6 +212,44 @@ def _cost_key(bucket: int, capacity: int) -> str:
     return f"{int(bucket)}/{int(capacity)}"
 
 
+def _cell_for(table: dict, bucket: int, capacity: int) -> dict | None:
+    """The (stop bucket, capacity) cost cell, falling back to the
+    NEAREST-capacity cell within the same stop bucket.
+
+    The fallback is what makes the tuner's verdict apply to the
+    escalated-capacity re-search path: a clipped row regrows its peak
+    buffer to the next power of two (e.g. 320 -> 4096), a capacity no
+    sweep ever measured, and an exact-key miss used to drop the whole
+    resolution to the legacy size heuristic — recompiling a fresh XLA
+    sort program on the very dispatch that is already paying an
+    escalation.  Relative method order is a property of the searched-
+    prefix length far more than of the output capacity (every method's
+    cost is dominated by streaming/sorting the prefix), and the
+    two-stage ``safe`` flag depends on row width (chosen from the
+    prefix length, not the capacity), so the donor cell's verdict
+    transfers within a bucket.  Ties prefer the smaller capacity (the
+    conservative, always-measured end of the sweep grid).
+    """
+    cell = table.get(_cost_key(bucket, capacity))
+    if isinstance(cell, dict) and cell:
+        return cell
+    best = None
+    for key, val in table.items():
+        if not (isinstance(val, dict) and val):
+            continue
+        try:
+            b_s, c_s = str(key).split("/")
+            b, c = int(b_s), int(c_s)
+        except ValueError:
+            continue
+        if b != int(bucket):
+            continue
+        rank = (abs(c - int(capacity)), c)
+        if best is None or rank < best[0]:
+            best = (rank, val)
+    return best[1] if best else None
+
+
 def _kind_entry(table: dict, device_kind: str | None) -> dict | None:
     """Case-insensitive substring match of a device kind against the
     table's keys (same matching rule as ``obs.costmodel.device_peak``)."""
@@ -305,11 +343,15 @@ def resolve_peaks_methods(bounds, capacity: int, *, forced: str = "auto",
     pallas kernel can run here (``ops.peaks_pallas``).
 
     Auto resolution per level, in order: a measured sidecar cell for
-    (device kind, stop bucket, capacity) -> cheapest available method;
-    the committed v5e defaults; the legacy size heuristic (two-stage
-    above 2^17, sort below), with compiled pallas preferred on devices
-    the measured tables say nothing about — interpret-mode pallas is
-    never auto-picked (it is a test vehicle, ~100x compiled).
+    (device kind, stop bucket, capacity) — falling back to the
+    nearest-capacity cell in the same stop bucket, so escalated
+    re-search capacities inherit the tuner's verdict instead of
+    recompiling the heuristic's sort (see :func:`_cell_for`) ->
+    cheapest available method; the committed v5e defaults (same
+    nearest-capacity rule); the legacy size heuristic (two-stage above
+    2^17, sort below), with compiled pallas preferred on devices the
+    measured tables say nothing about — interpret-mode pallas is never
+    auto-picked (it is a test vehicle, ~100x compiled).
     """
     if forced != "auto" and forced not in EXTRACTION_METHODS:
         from ..errors import ConfigError
@@ -332,8 +374,9 @@ def resolve_peaks_methods(bounds, capacity: int, *, forced: str = "auto",
         ["pallas"] if pallas_ok == "compiled" else [])
     out = []
     for (_start, stop, _f) in bounds:
-        key = _cost_key(stop_bucket(stop), capacity)
-        cell = measured.get(key) or builtin.get(key) or {}
+        bucket = stop_bucket(stop)
+        cell = (_cell_for(measured, bucket, capacity)
+                or _cell_for(builtin, bucket, capacity) or {})
         costs = {m: cell[m] for m in avail
                  if isinstance(cell.get(m), (int, float))}
         if cell.get("safe") is False:

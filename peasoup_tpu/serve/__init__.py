@@ -18,12 +18,25 @@ beams, flaky runs and worker crashes.  This package is that layer:
   exponential backoff, per-job timeout (and the ONE sanctioned
   ``time.sleep`` site — lint rule PSL008);
 * :mod:`~peasoup_tpu.serve.store` — append-only cross-run candidate
-  store with survey-level dedup/coincidence queries;
+  store with survey-level dedup/coincidence queries (fleet mode
+  shards it per host: ``ShardedCandidateStore``);
+* :mod:`~peasoup_tpu.serve.fleet` — the fleet control plane: one
+  worker per host of a multi-host slice, heartbeat leases on claims,
+  automatic dead-host recovery, per-host store shards and the
+  aggregated fleet report;
 * :mod:`~peasoup_tpu.serve.cli` — ``python -m peasoup_tpu.serve``
-  with ``submit`` / ``worker`` / ``status`` / ``requeue`` verbs.
+  with ``submit`` / ``worker`` / ``fleet-worker`` / ``status`` /
+  ``coincidence`` / ``requeue`` verbs.
 """
 
-from .queue import JobRecord, JobSpool
+from .fleet import (
+    FleetMembership,
+    FleetWorker,
+    LeaseHeartbeat,
+    fleet_report,
+    write_fleet_report,
+)
+from .queue import LEASE_EXPIRED, JobRecord, JobSpool
 from .retry import (
     QUARANTINE,
     RETRY,
@@ -31,17 +44,24 @@ from .retry import (
     JobTimeoutError,
     classify_failure,
 )
-from .store import CandidateStore
+from .store import CandidateStore, ShardedCandidateStore
 from .worker import SurveyWorker
 
 __all__ = [
     "JobRecord",
     "JobSpool",
+    "LEASE_EXPIRED",
     "BackoffPolicy",
     "JobTimeoutError",
     "classify_failure",
     "QUARANTINE",
     "RETRY",
     "CandidateStore",
+    "ShardedCandidateStore",
     "SurveyWorker",
+    "FleetMembership",
+    "FleetWorker",
+    "LeaseHeartbeat",
+    "fleet_report",
+    "write_fleet_report",
 ]

@@ -2,17 +2,29 @@
 
 Verbs::
 
-    submit  <files...> [--priority N] [--set key=value ...]
-    worker  [--drain] [--max-jobs N] [--poll S] [--single_device] ...
-    status  [--jobs]
-    requeue <job_ids...> | --running | --failed
+    submit        <files...> [--priority N] [--set key=value ...]
+    worker        [--drain] [--max-jobs N] [--poll S] ...
+    fleet-worker  [--host-id I --host-count N] [--label L]
+                  [--lease-ttl S] [--heartbeat S] + worker options
+    status        [--jobs] [--fleet]
+    coincidence   [--freq-tol F] [--min-sources N] [--json PATH]
+    requeue       <job_ids...> | --running | --failed | --expired
 
 All verbs take ``--spool DIR`` (default ``./jobs``): the durable spool
 directory described in serve/queue.py.  ``submit`` enqueues
 observations; ``worker`` claims and runs them (``--drain`` exits when
-the queue empties, otherwise it polls forever); ``status`` prints the
-queue + store state; ``requeue`` recovers jobs from a crashed worker
-(``running/``) or retries quarantined ones (``failed/``).
+the queue empties, otherwise it polls forever); ``fleet-worker`` is
+the per-host member of a multi-host fleet (serve/fleet.py: leased
+claims, idle-time lease reaping, per-host store shard; membership is
+auto-detected from jax.distributed, or injected with
+``--host-id/--host-count`` for tests and smoke runs); ``status``
+prints the queue + store state (``--fleet`` aggregates every host's
+snapshot into one table and writes ``fleet_report.json``);
+``coincidence`` runs the survey-level coincidencer over the merged
+store shards; ``requeue`` recovers jobs from a crashed worker
+(``--running``, or ``--expired`` for lease-based recovery that only
+touches jobs whose host stopped heartbeating) or retries quarantined
+ones (``--failed``).
 """
 
 from __future__ import annotations
@@ -61,6 +73,64 @@ def build_parser() -> argparse.ArgumentParser:
                          "--set dm_end=120 --set npdmp=8")
 
     pw = sub.add_parser("worker", help="claim and run jobs")
+    _add_worker_args(pw)
+
+    pf = sub.add_parser(
+        "fleet-worker",
+        help="run this host's member of a multi-host fleet")
+    _add_worker_args(pf)
+    pf.add_argument("--host-id", type=int, default=None,
+                    help="simulated host index (with --host-count); "
+                         "default: detect from jax.distributed")
+    pf.add_argument("--host-count", type=int, default=None,
+                    help="simulated fleet size (with --host-id)")
+    pf.add_argument("--label", default=None,
+                    help="host label for worker id, store shard and "
+                         "status file (default: host-<id>)")
+    pf.add_argument("--lease-ttl", type=float, default=None,
+                    help="seconds without a heartbeat before another "
+                         "host may reap this host's running jobs")
+    pf.add_argument("--heartbeat", type=float, default=0.0,
+                    help="lease refresh interval (0 = ttl/3)")
+
+    pt = sub.add_parser("status", help="queue + store summary")
+    pt.add_argument("--jobs", action="store_true",
+                    help="list individual jobs per state")
+    pt.add_argument("--fleet", action="store_true",
+                    help="aggregate per-host fleet snapshots into one "
+                         "table and write fleet_report.json")
+    pt.add_argument("--lease-ttl", type=float, default=None,
+                    help="TTL used to flag stale leases in the fleet "
+                         "report")
+
+    pc = sub.add_parser(
+        "coincidence",
+        help="survey-level coincidence over the merged store shards")
+    pc.add_argument("--freq-tol", type=float, default=1e-4,
+                    help="fractional frequency-match tolerance")
+    pc.add_argument("--min-sources", type=int, default=2,
+                    help="distinct observations required per group")
+    pc.add_argument("--json", dest="json_path", default=None,
+                    help="also write the groups to this JSON file")
+
+    pr = sub.add_parser("requeue", help="move jobs back to pending")
+    pr.add_argument("job_ids", nargs="*", help="specific job ids")
+    pr.add_argument("--running", action="store_true",
+                    help="requeue every running job (crashed worker "
+                         "recovery)")
+    pr.add_argument("--failed", action="store_true",
+                    help="requeue every failed job (operator retry)")
+    pr.add_argument("--expired", action="store_true",
+                    help="reap only lease-expired running jobs (dead "
+                         "fleet host recovery; safe while other "
+                         "hosts keep working)")
+    pr.add_argument("--lease-ttl", type=float, default=None,
+                    help="lease TTL for --expired (seconds)")
+    return p
+
+
+def _add_worker_args(pw) -> None:
+    """Options shared by ``worker`` and ``fleet-worker``."""
     pw.add_argument("--drain", action="store_true",
                     help="exit when the queue is empty (default: "
                          "poll forever)")
@@ -86,19 +156,6 @@ def build_parser() -> argparse.ArgumentParser:
     pw.add_argument("--history", default=None,
                     help="throughput ledger path (default: the repo "
                          "benchmarks/history.jsonl)")
-
-    pt = sub.add_parser("status", help="queue + store summary")
-    pt.add_argument("--jobs", action="store_true",
-                    help="list individual jobs per state")
-
-    pr = sub.add_parser("requeue", help="move jobs back to pending")
-    pr.add_argument("job_ids", nargs="*", help="specific job ids")
-    pr.add_argument("--running", action="store_true",
-                    help="requeue every running job (crashed worker "
-                         "recovery)")
-    pr.add_argument("--failed", action="store_true",
-                    help="requeue every failed job (operator retry)")
-    return p
 
 
 def cmd_submit(spool, args) -> int:
@@ -137,9 +194,98 @@ def cmd_worker(spool, args) -> int:
     return 0 if summary["failed"] == 0 else 1
 
 
+def cmd_fleet_worker(spool, args) -> int:
+    from ..obs.events import configure_event_log
+    from ..utils import enable_compile_cache
+    from .fleet import FleetMembership, FleetWorker
+    from .queue import DEFAULT_LEASE_TTL_S
+    from .retry import BackoffPolicy
+
+    if (args.host_id is None) != (args.host_count is None):
+        from ..errors import ConfigError
+
+        raise ConfigError(
+            "--host-id and --host-count must be given together")
+    if args.host_id is not None:
+        membership = FleetMembership.fake(
+            args.host_id, args.host_count, args.label)
+    else:
+        membership = FleetMembership.detect(label=args.label)
+    enable_compile_cache()
+    configure_event_log(os.path.join(
+        spool.root, f"worker-events-{membership.label}.jsonl"))
+    worker = FleetWorker(
+        spool,
+        membership,
+        lease_ttl_s=(args.lease_ttl if args.lease_ttl is not None
+                     else DEFAULT_LEASE_TTL_S),
+        heartbeat_s=args.heartbeat or None,
+        backoff=BackoffPolicy(max_attempts=args.max_attempts,
+                              base_s=args.backoff_base),
+        timeout_s=args.timeout,
+        single_device=args.single_device,
+        max_devices=args.max_num_threads,
+        prefetch=not args.no_prefetch,
+        history_path=args.history,
+    )
+    summary = worker.drain(max_jobs=args.max_jobs,
+                           wait=not args.drain, poll_s=args.poll)
+    print(f"fleet host {membership.label} "
+          f"({membership.host_id + 1}/{membership.host_count}) "
+          f"worker {worker.worker_id}: {summary['succeeded']}/"
+          f"{summary['claimed']} jobs ok in {summary['elapsed_s']}s "
+          f"({summary['jobs_per_hour']} jobs/h)")
+    return 0 if summary["failed"] == 0 else 1
+
+
+def _print_fleet_table(report: dict) -> None:
+    cols = ("host", "claimed", "ok", "fail", "jobs/h", "reaped",
+            "shard")
+    rows = []
+    for label, doc in sorted(report["hosts"].items()):
+        s = doc.get("summary", {})
+        sched = doc.get("scheduler", {})
+        rows.append((label, s.get("claimed", 0), s.get("succeeded", 0),
+                     s.get("failed", 0), s.get("jobs_per_hour", 0.0),
+                     sched.get("lease_reaped", 0),
+                     doc.get("shard", "")))
+    t = report["totals"]
+    rows.append(("TOTAL", t["claimed"], t["succeeded"], t["failed"],
+                 t["jobs_per_hour"], t["lease_reaped"], ""))
+    widths = [max(len(str(c)), *(len(str(r[i])) for r in rows))
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*cols))
+    for row in rows:
+        print(fmt.format(*(str(v) for v in row)))
+
+
 def cmd_status(spool, args) -> int:
     from .store import CandidateStore
 
+    if args.fleet:
+        from .fleet import fleet_report, write_fleet_report
+        from .queue import DEFAULT_LEASE_TTL_S
+
+        report = fleet_report(
+            spool, args.lease_ttl if args.lease_ttl is not None
+            else DEFAULT_LEASE_TTL_S)
+        _print_fleet_table(report)
+        q = report["queue"]
+        print("queue: " + "  ".join(f"{k}={v}"
+                                    for k, v in q.items()))
+        st = report["store"]
+        print(f"store: {st['candidates']} candidates from "
+              f"{st['sources']} observation(s) across "
+              f"{len(st['shards'])} shard(s)")
+        lz = report["leases"]
+        if lz["stale"]:
+            print(f"leases: {lz['stale']}/{lz['running']} running "
+                  f"job(s) past the {lz['ttl_s']:.0f}s TTL -- run "
+                  f"'requeue --expired' or start a fleet worker")
+        path = write_fleet_report(spool, report)
+        print(f"wrote {path}")
+        return 0
     counts = spool.counts()
     print("state     jobs")
     for state, n in counts.items():
@@ -165,15 +311,59 @@ def cmd_status(spool, args) -> int:
     return 0
 
 
+def cmd_coincidence(spool, args) -> int:
+    from .store import ShardedCandidateStore
+
+    store = ShardedCandidateStore(spool.root)
+    groups = store.coincident_groups(
+        freq_tol=args.freq_tol, min_sources=args.min_sources)
+    for i, group in enumerate(groups):
+        best = group[0]
+        srcs = sorted({os.path.basename(r.get("source", ""))
+                       for r in group})
+        print(f"group {i}: f={best['freq']:.6f} Hz  "
+              f"snr={best.get('snr', 0.0):.2f}  "
+              f"{len(group)} detection(s) in {len(srcs)} "
+              f"observation(s): {', '.join(srcs)}")
+    print(f"{len(groups)} coincident group(s) across "
+          f"{len(store.shard_files())} shard(s)")
+    if args.json_path:
+        import json
+
+        tmp = args.json_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"v": 1, "freq_tol": args.freq_tol,
+                       "min_sources": args.min_sources,
+                       "groups": groups}, f, sort_keys=True)
+        os.replace(tmp, args.json_path)
+        print(f"wrote {args.json_path}")
+    return 0
+
+
 def cmd_requeue(spool, args) -> int:
+    if args.expired:
+        from .queue import DEFAULT_LEASE_TTL_S
+
+        ttl = (args.lease_ttl if args.lease_ttl is not None
+               else DEFAULT_LEASE_TTL_S)
+        reaped = spool.reap_expired(ttl)
+        for rec in reaped:
+            print(f"reaped {rec.job_id}  attempts={rec.attempts}  "
+                  f"{rec.input}")
+        # zero expired leases is a healthy fleet, not an error
+        print(f"{len(reaped)} lease-expired job(s) back to pending")
+        if args.job_ids or args.running or args.failed:
+            print("(--expired given; other selectors ignored)",
+                  file=sys.stderr)
+        return 0
     ids = list(args.job_ids)
     if args.running:
         ids += [r.job_id for r in spool.jobs("running")]
     if args.failed:
         ids += [r.job_id for r in spool.jobs("failed")]
     if not ids:
-        print("nothing to requeue (give job ids, --running or "
-              "--failed)", file=sys.stderr)
+        print("nothing to requeue (give job ids, --running, --failed "
+              "or --expired)", file=sys.stderr)
         return 1
     for job_id in ids:
         rec = spool.requeue(job_id)
@@ -191,7 +381,9 @@ def main(argv=None) -> int:
     return {
         "submit": cmd_submit,
         "worker": cmd_worker,
+        "fleet-worker": cmd_fleet_worker,
         "status": cmd_status,
+        "coincidence": cmd_coincidence,
         "requeue": cmd_requeue,
     }[args.verb](spool, args)
 

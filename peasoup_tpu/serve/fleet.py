@@ -1,0 +1,335 @@
+"""Fleet control plane: one survey worker per host of a slice.
+
+The reference pipeline's scale-out story stops at one host — a
+pthread pool dispensing DM trials to local GPUs
+(`src/pipeline_multi.cu:33-81`) — and the serve layer so far was the
+same shape: a single-filesystem spool drained by workers on one
+machine.  This module makes the whole service layer fleet-safe, so a
+multi-host TPU slice (or just N machines sharing a filesystem) drains
+ONE spool:
+
+* **membership + identity** — :class:`FleetMembership` derives each
+  host's (id, count, label) from ``parallel/multihost.py`` /
+  ``jax.distributed`` (:meth:`FleetMembership.detect`), with an
+  injectable fake (:meth:`FleetMembership.fake`) so tier-1 tests
+  simulate N hosts in one process — the same pattern as
+  ``gather_host_payloads``'s single-process fast path;
+* **distributed spool** — claims stay ``os.rename``-atomic across
+  hosts on a shared filesystem; each claim drops a lease that a
+  :class:`LeaseHeartbeat` daemon thread keeps fresh while the job
+  runs, and every idle fleet worker runs the spool's lease-expiry
+  reaper so a dead host's jobs return to ``pending/`` without
+  operator action (serve/queue.py);
+* **sharded candidate store** — each host ingests into its own
+  append-only ``store-<host>.jsonl`` shard
+  (``serve/store.ShardedCandidateStore``): single-writer appends need
+  no cross-host locking, and queries/coincidence merge all shards;
+* **fleet verbs** — ``python -m peasoup_tpu.serve fleet-worker`` runs
+  :class:`FleetWorker` (the per-host loop, with all the existing
+  retry/quarantine/checkpoint machinery); ``status --fleet`` renders
+  :func:`fleet_report` — per-host scheduler gauges, queue depths and
+  ``jobs_per_hour`` in one table — and writes ``fleet_report.json``.
+
+Observability: each host's drain writes a status snapshot to
+``<spool>/fleet/<host>.json`` (what ``status --fleet`` aggregates)
+and appends its ``kind="serve"`` throughput record to the bench
+history ledger with ``config.host`` set (obs/history.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from ..errors import ConfigError
+from ..obs.metrics import REGISTRY as METRICS
+from .queue import DEFAULT_LEASE_TTL_S, JobRecord, JobSpool
+from .store import ShardedCandidateStore, safe_label
+from .worker import SurveyWorker
+
+#: spool subdirectory holding per-host status snapshots
+FLEET_DIR = "fleet"
+
+#: aggregated report written by ``status --fleet``
+REPORT_BASENAME = "fleet_report.json"
+
+
+@dataclass(frozen=True)
+class FleetMembership:
+    """This process's place in the fleet: host index, host count and
+    the label that names its worker identity, store shard and status
+    file."""
+
+    host_id: int
+    host_count: int
+    label: str
+
+    @classmethod
+    def make(cls, host_id: int, host_count: int,
+             label: str | None = None) -> "FleetMembership":
+        host_id, host_count = int(host_id), int(host_count)
+        if host_count < 1 or not 0 <= host_id < host_count:
+            raise ConfigError(
+                f"fleet membership host_id={host_id} host_count="
+                f"{host_count}: need 0 <= host_id < host_count")
+        return cls(host_id, host_count,
+                   safe_label(label or f"host-{host_id}"))
+
+    @classmethod
+    def detect(cls, coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               label: str | None = None) -> "FleetMembership":
+        """Real membership: bring up jax.distributed (a no-op off-pod)
+        and read this process's slice identity.  A plain single-process
+        run detects as the 1-host fleet — every fleet verb works,
+        unchanged, on a laptop."""
+        from ..parallel.multihost import initialize, process_identity
+
+        initialize(coordinator_address, num_processes, process_id)
+        idx, n = process_identity()
+        return cls.make(idx, n, label)
+
+    @classmethod
+    def fake(cls, host_id: int, host_count: int,
+             label: str | None = None) -> "FleetMembership":
+        """Injectable membership: simulate host ``host_id`` of
+        ``host_count`` WITHOUT jax.distributed — how tier-1 tests (and
+        ``make fleet-smoke``'s subprocesses) run an N-host fleet on
+        one machine, following ``gather_host_payloads``'s fake-gather
+        pattern."""
+        return cls.make(host_id, host_count, label)
+
+
+class LeaseHeartbeat:
+    """Daemon thread refreshing a claimed job's lease every
+    ``interval_s`` while the job runs, so the fleet's reapers can tell
+    a live long search from a dead host (serve/queue.py lease rules:
+    heartbeat ~ TTL/3, several consecutive missed beats expire).
+
+    A context manager wrapping exactly one job's execution.  Waits on
+    a ``threading.Event`` — not ``time.sleep``, which lint rule
+    PSL008 reserves for serve/retry.py — so :meth:`stop` interrupts
+    the wait immediately and job teardown never blocks on the beat
+    interval.  Beat I/O errors are swallowed: a torn write on a
+    flaky shared filesystem is indistinguishable from a late beat,
+    and the next beat retries.
+    """
+
+    def __init__(self, spool: JobSpool, rec: JobRecord,
+                 interval_s: float):
+        self.spool = spool
+        self.rec = rec
+        self.interval_s = max(float(interval_s), 0.05)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.beats = 0
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.spool.heartbeat(self.rec)
+                self.beats += 1
+                METRICS.inc("scheduler.heartbeats")
+            except OSError:
+                pass  # torn/raced beat; the next one retries
+
+    def start(self) -> "LeaseHeartbeat":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"lease-{self.rec.job_id[:12]}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "LeaseHeartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+
+class FleetWorker(SurveyWorker):
+    """One host's member of the fleet.
+
+    A :class:`~peasoup_tpu.serve.worker.SurveyWorker` — same claim /
+    classify / retry / quarantine / checkpoint / prefetch machinery —
+    that additionally (1) stamps claims with its host label, (2) keeps
+    a :class:`LeaseHeartbeat` alive around every job, (3) reaps
+    expired leases when idle (and once per drain up front, so a
+    restarted fleet adopts a dead host's jobs immediately), (4)
+    ingests candidates into its own store shard, and (5) writes the
+    per-host status snapshot that ``status --fleet`` aggregates.
+    """
+
+    def __init__(self, spool: JobSpool, membership: FleetMembership,
+                 *, lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 heartbeat_s: float | None = None, store=None, **kw):
+        if store is None:
+            store = ShardedCandidateStore(spool.root, membership.label)
+        kw.setdefault(
+            "worker_id", f"{membership.label}:pid{os.getpid()}")
+        super().__init__(spool, store, **kw)
+        self.membership = membership
+        self.host_label = membership.label
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s
+                            else max(self.lease_ttl_s / 3.0, 0.5))
+
+    # -- fleet hooks -------------------------------------------------------
+
+    def run_one(self, job: JobRecord) -> bool:
+        with LeaseHeartbeat(self.spool, job, self.heartbeat_s):
+            return super().run_one(job)
+
+    def _idle_poll(self) -> None:
+        self.spool.reap_expired(self.lease_ttl_s)
+
+    def drain(self, max_jobs: int | None = None, wait: bool = False,
+              poll_s: float = 5.0) -> dict:
+        # adopt any dead host's jobs before the first claim
+        self.spool.reap_expired(self.lease_ttl_s)
+        summary = super().drain(max_jobs=max_jobs, wait=wait,
+                                poll_s=poll_s)
+        summary["host"] = self.membership.label
+        summary["host_id"] = self.membership.host_id
+        summary["host_count"] = self.membership.host_count
+        self.write_host_status(summary)
+        return summary
+
+    # -- per-host status ---------------------------------------------------
+
+    def write_host_status(self, summary: dict) -> str:
+        """Atomic per-host snapshot (``<spool>/fleet/<host>.json``):
+        the drain summary plus this process's scheduler counters and
+        gauges — the raw material of :func:`fleet_report`."""
+        snap = METRICS.snapshot()
+        sched = lambda d: {
+            k.split(".", 1)[1]: v for k, v in d.items()
+            if k.startswith("scheduler.")
+        }
+        doc = {
+            "v": 1,
+            "utc": round(time.time(), 3),
+            "host": self.membership.label,
+            "host_id": self.membership.host_id,
+            "host_count": self.membership.host_count,
+            "worker": self.worker_id,
+            "pid": os.getpid(),
+            "lease_ttl_s": self.lease_ttl_s,
+            "heartbeat_s": self.heartbeat_s,
+            "summary": {k: summary[k] for k in (
+                "claimed", "succeeded", "failed", "elapsed_s",
+                "jobs_per_hour", "geometry_buckets") if k in summary},
+            "scheduler": sched(snap["counters"]),
+            "gauges": sched(snap["gauges"]),
+            "shard": os.path.basename(self.store.path),
+        }
+        d = os.path.join(self.spool.root, FLEET_DIR)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"{self.membership.label}.json")
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+
+# -- fleet-wide aggregation ------------------------------------------------
+
+def load_host_statuses(spool: JobSpool) -> dict[str, dict]:
+    """Every host's latest status snapshot, keyed by host label;
+    corrupt/partial snapshots are skipped (ledger rules)."""
+    out: dict[str, dict] = {}
+    d = os.path.join(spool.root, FLEET_DIR)
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and doc.get("host"):
+            out[str(doc["host"])] = doc
+    return out
+
+
+def fleet_report(spool: JobSpool,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S) -> dict:
+    """One aggregated view of the fleet: queue depths, merged-store
+    shard counts, per-host scheduler gauges and the cross-host
+    throughput totals (``status --fleet``'s table source, serialised
+    to ``fleet_report.json`` by :func:`write_fleet_report`)."""
+    hosts = load_host_statuses(spool)
+    store = ShardedCandidateStore(spool.root)
+    now = time.time()
+    stale = 0
+    leases = 0
+    for rec in spool.jobs("running"):
+        leases += 1
+        lease = spool.lease_info(rec.job_id)
+        beat = (lease or {}).get("utc") or rec.claimed_utc
+        if now - float(beat or 0.0) > float(lease_ttl_s):
+            stale += 1
+
+    def _tot(path, *keys):
+        vals = []
+        for h in hosts.values():
+            v = h
+            for k in keys:
+                v = v.get(k, {}) if isinstance(v, dict) else {}
+            if isinstance(v, (int, float)):
+                vals.append(v)
+        return vals
+
+    totals = {
+        "hosts": len(hosts),
+        "claimed": int(sum(_tot(None, "summary", "claimed"))),
+        "succeeded": int(sum(_tot(None, "summary", "succeeded"))),
+        "failed": int(sum(_tot(None, "summary", "failed"))),
+        "jobs_per_hour": round(
+            sum(_tot(None, "summary", "jobs_per_hour")), 3),
+        "lease_reaped": int(sum(_tot(None, "scheduler",
+                                     "lease_reaped"))),
+        "quarantined": int(sum(_tot(None, "scheduler",
+                                    "quarantined"))),
+    }
+    return {
+        "v": 1,
+        "utc": round(now, 3),
+        "spool": spool.root,
+        "queue": spool.counts(),
+        "leases": {"running": leases, "stale": stale,
+                   "ttl_s": float(lease_ttl_s)},
+        "store": {
+            "candidates": store.count(),
+            "sources": len(store.sources()),
+            "shards": store.shard_counts(),
+        },
+        "hosts": hosts,
+        "totals": totals,
+    }
+
+
+def write_fleet_report(spool: JobSpool, report: dict | None = None,
+                       path: str | None = None) -> str:
+    """Serialise :func:`fleet_report` next to the spool (atomic)."""
+    report = report if report is not None else fleet_report(spool)
+    path = path or os.path.join(spool.root, REPORT_BASENAME)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    os.replace(tmp, path)
+    return path

@@ -10,8 +10,14 @@ One job = one observation: an input filterbank path plus its
     <spool>/failed/<job_id>.json     quarantined or retry-exhausted
     <spool>/work/<job_id>/           per-job scratch: checkpoint file,
                                      output directory, failure reports
+    <spool>/leases/<job_id>.json     claim lease: host + worker +
+                                     heartbeat time of the claimer
+    <spool>/fleet/<host>.json        per-host status snapshot
+                                     (serve/fleet.py)
     <spool>/candidates.jsonl         cross-run candidate store
                                      (serve/store.py default path)
+    <spool>/store-<host>.jsonl       per-host store shards in fleet
+                                     mode (serve/store.py)
 
 A job changes state by ``os.rename`` of its record file — atomic on
 POSIX — so any number of worker processes on one machine can claim
@@ -22,6 +28,17 @@ This is the reference's pthread-mutex trial dispenser
 the queue surviving process death.  Record *contents* are always
 rewritten in place (tmp + ``os.replace``) BEFORE the state rename, so
 a reader never sees a torn or stale record in the new state.
+
+Fleet hardening (multi-HOST spools on a shared filesystem): a claim
+additionally stamps the record with the claimer's ``host`` and drops
+a lease file that the owner's heartbeat keeps fresh while the job
+runs.  A host that dies mid-job stops heartbeating, and ANY surviving
+host's :meth:`JobSpool.reap_expired` — run by every fleet worker when
+idle — returns the job to ``pending/`` with a ``lease_expired`` entry
+appended to its failure log (attempt history intact), generalising
+the operator-driven ``requeue`` to automatic dead-host recovery.
+``os.rename`` atomicity is the arbiter for reapers exactly as for
+claimers, so concurrent reapers converge on one pending record.
 """
 
 from __future__ import annotations
@@ -37,6 +54,14 @@ from ..obs.metrics import REGISTRY as METRICS
 
 #: spool subdirectories, in lifecycle order
 STATES = ("pending", "running", "done", "failed")
+
+#: failure-log classification stamped by the lease reaper (alongside
+#: serve/retry.py's QUARANTINE / RETRY, which classify exceptions)
+LEASE_EXPIRED = "lease_expired"
+
+#: default lease time-to-live; owners heartbeat at ~TTL/3, so a lease
+#: only expires after several consecutive missed beats
+DEFAULT_LEASE_TTL_S = 120.0
 
 _RECORD_VERSION = 1
 
@@ -54,6 +79,8 @@ class JobRecord:
     claimed_utc: float = 0.0
     finished_utc: float = 0.0
     worker: str = ""
+    #: fleet host label of the claimer ("" pre-fleet / single host)
+    host: str = ""
     #: one entry per failed attempt: {utc, attempt, classification,
     #: error, traceback, run_report}
     failures: list = field(default_factory=list)
@@ -84,11 +111,15 @@ class JobSpool:
         for state in STATES:
             os.makedirs(os.path.join(self.root, state), exist_ok=True)
         os.makedirs(os.path.join(self.root, "work"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, "leases"), exist_ok=True)
 
     # -- paths -------------------------------------------------------------
 
     def _path(self, state: str, job_id: str) -> str:
         return os.path.join(self.root, state, f"{job_id}.json")
+
+    def _lease_path(self, job_id: str) -> str:
+        return os.path.join(self.root, "leases", f"{job_id}.json")
 
     def work_dir(self, job_id: str) -> str:
         """Per-job scratch directory (checkpoint, outputs, reports)."""
@@ -155,11 +186,14 @@ class JobSpool:
         jobs = self.pending_jobs()
         return jobs[0] if jobs else None
 
-    def claim(self, worker: str = "") -> JobRecord | None:
+    def claim(self, worker: str = "", host: str = "") -> JobRecord | None:
         """Claim the best pending job via atomic rename, or None.
 
-        Safe against concurrent claimers: the rename is the arbiter,
-        a lost race just moves on to the next candidate.
+        Safe against concurrent claimers — on one machine or across
+        hosts sharing the spool filesystem: the rename is the arbiter,
+        a lost race just moves on to the next candidate.  The winner's
+        record carries ``worker`` and ``host``, and a lease file is
+        dropped for the reaper (kept fresh via :meth:`heartbeat`).
         """
         for rec in self.pending_jobs():
             src = self._path("pending", rec.job_id)
@@ -169,14 +203,103 @@ class JobSpool:
             except FileNotFoundError:
                 continue  # another worker won this one
             rec.worker = worker
+            rec.host = host
             rec.claimed_utc = time.time()
             rec.attempts += 1
             self._write(dst, rec)
+            self.heartbeat(rec)
             METRICS.inc("scheduler.claimed")
             METRICS.observe(
                 "queue_wait", rec.claimed_utc - rec.submitted_utc)
             return rec
         return None
+
+    # -- leases (fleet hardening) ------------------------------------------
+
+    def heartbeat(self, rec: JobRecord) -> None:
+        """Refresh the claimer's lease on a running job (atomic
+        rewrite).  Written on claim and then every ~TTL/3 by the
+        owner's heartbeat thread (serve/fleet.py LeaseHeartbeat), so a
+        fresh lease means the owning host is demonstrably alive."""
+        path = self._lease_path(rec.job_id)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "v": 1,
+                "job_id": rec.job_id,
+                "worker": rec.worker,
+                "host": rec.host,
+                "attempt": rec.attempts,
+                "utc": round(time.time(), 3),
+            }, f)
+        os.replace(tmp, path)
+
+    def lease_info(self, job_id: str) -> dict | None:
+        """The job's lease record, or None (missing/corrupt — a torn
+        lease reads as 'no heartbeat', never as an error)."""
+        try:
+            with open(self._lease_path(job_id)) as f:
+                obj = json.load(f)
+            return obj if isinstance(obj, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _clear_lease(self, job_id: str) -> None:
+        try:
+            os.remove(self._lease_path(job_id))
+        except OSError:
+            pass
+
+    def reap_expired(self, ttl_s: float = DEFAULT_LEASE_TTL_S,
+                     now: float | None = None) -> list[JobRecord]:
+        """Return every running job whose lease went stale to
+        ``pending/`` — automatic dead-host recovery.
+
+        A job is reaped when its last heartbeat (falling back to the
+        claim time for pre-lease records) is more than ``ttl_s``
+        seconds old.  The reaped record keeps its attempt count and
+        gains a :data:`LEASE_EXPIRED` entry in the failure log, so the
+        next claimer sees the full history; the worker/host stamps are
+        cleared.  Concurrent reapers race on the running->pending
+        rename exactly like claimers race on pending->running: losers
+        skip.  ``now`` is injectable for tests.
+        """
+        now = time.time() if now is None else float(now)
+        reaped = []
+        for rec in self.jobs("running"):
+            lease = self.lease_info(rec.job_id)
+            beat = (lease or {}).get("utc") or rec.claimed_utc \
+                or rec.submitted_utc
+            age = now - float(beat)
+            if age <= float(ttl_s):
+                continue
+            dead_host = rec.host or (lease or {}).get("host") or "?"
+            rec.failures.append({
+                "utc": round(now, 3),
+                "attempt": rec.attempts,
+                "classification": LEASE_EXPIRED,
+                "error": (f"lease expired after {age:.1f}s "
+                          f"(ttl {float(ttl_s):.1f}s; last owner "
+                          f"{rec.worker or '?'} on host {dead_host})"),
+            })
+            rec.worker = ""
+            rec.host = ""
+            try:
+                self._transition(rec, "running", "pending")
+            except (ConfigError, OSError):
+                continue  # another reaper won this one
+            self._clear_lease(rec.job_id)
+            warn_event(
+                "job_lease_expired",
+                f"job {rec.job_id} reaped after {age:.1f}s without a "
+                f"heartbeat from host {dead_host}; re-queued with "
+                f"attempt history intact",
+                job_id=rec.job_id, host=dead_host, age_s=round(age, 1),
+                ttl_s=float(ttl_s), attempt=rec.attempts,
+            )
+            METRICS.inc("scheduler.lease_reaped")
+            reaped.append(rec)
+        return reaped
 
     # -- state transitions (record rewritten BEFORE the rename) ------------
 
@@ -199,17 +322,20 @@ class JobSpool:
         if summary:
             rec.summary = dict(summary)
         self._transition(rec, "running", "done")
+        self._clear_lease(rec.job_id)
 
     def mark_failed(self, rec: JobRecord) -> None:
         """running -> failed (the failure log on the record says why:
         quarantined input vs exhausted retries)."""
         rec.finished_utc = time.time()
         self._transition(rec, "running", "failed")
+        self._clear_lease(rec.job_id)
 
     def release(self, rec: JobRecord) -> None:
         """running -> pending for a bounded retry (attempt count and
         failure log travel with the record)."""
         self._transition(rec, "running", "pending")
+        self._clear_lease(rec.job_id)
 
     def requeue(self, job_id: str) -> JobRecord:
         """Recover a job from ``running/`` (crashed worker) or
@@ -219,7 +345,9 @@ class JobSpool:
             rec = self._read(path)
             if rec is not None:
                 rec.worker = ""
+                rec.host = ""
                 self._transition(rec, state, "pending")
+                self._clear_lease(rec.job_id)
                 METRICS.inc("scheduler.requeued")
                 return rec
         raise ConfigError(

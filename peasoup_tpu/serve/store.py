@@ -25,17 +25,66 @@ Record schema (``v`` = 1; consumers tolerate additions)::
 Store I/O follows the ledger rules (obs/history.py): appends are one
 atomic line write; corrupt/torn lines are skipped on load so a killed
 worker cannot poison the survey.
+
+Fleet mode shards the ledger per host
+(:class:`ShardedCandidateStore`): each host APPENDS only to its own
+``store-<host>.jsonl`` — append-only single-writer files need no
+cross-host locking on a shared filesystem — while every query
+(:meth:`~CandidateStore.query`, the coincidencer
+:meth:`~CandidateStore.coincident_groups`) reads the MERGE of all
+shards plus the legacy single-store file.  A torn tail on one shard
+(its host died mid-append) skips that line only; the merge is
+unaffected.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import time
 
 import numpy as np
 
 STORE_VERSION = 1
+
+#: fleet store shards: <spool>/store-<host_label>.jsonl
+SHARD_PREFIX = "store-"
+
+#: the pre-fleet single-store file, still merged by the sharded reader
+LEGACY_BASENAME = "candidates.jsonl"
+
+
+def safe_label(label: str) -> str:
+    """Host label sanitised for use in file names (shards, per-host
+    status files): anything outside [A-Za-z0-9_.-] becomes '_'."""
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(label)) or "host"
+
+
+def _iter_records(path: str, source: str | None = None,
+                  min_snr: float | None = None):
+    """Yield one file's records in file order; corrupt/torn lines and
+    a missing file are skipped (ledger rules)."""
+    if not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail from a killed worker
+            if not isinstance(rec, dict) or "freq" not in rec:
+                continue
+            if source is not None and rec.get("source") != source:
+                continue
+            if min_snr is not None and \
+                    rec.get("snr", 0.0) < min_snr:
+                continue
+            yield rec
 
 
 def _record_from_candidate(job_id: str, source: str, cand,
@@ -86,27 +135,7 @@ class CandidateStore:
     def records(self, source: str | None = None,
                 min_snr: float | None = None) -> list[dict]:
         """All records in file order; corrupt lines skipped."""
-        out: list[dict] = []
-        if not os.path.exists(self.path):
-            return out
-        with open(self.path, encoding="utf-8") as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail from a killed worker
-                if not isinstance(rec, dict) or "freq" not in rec:
-                    continue
-                if source is not None and rec.get("source") != source:
-                    continue
-                if min_snr is not None and \
-                        rec.get("snr", 0.0) < min_snr:
-                    continue
-                out.append(rec)
-        return out
+        return list(_iter_records(self.path, source, min_snr))
 
     def count(self) -> int:
         return len(self.records())
@@ -170,3 +199,57 @@ class CandidateStore:
             if len({r["source"] for r in family}) >= min_sources:
                 groups.append(family)
         return groups
+
+
+def shard_path(root: str, host_label: str) -> str:
+    """One host's append-only shard file under the spool root."""
+    return os.path.join(root, f"{SHARD_PREFIX}{safe_label(host_label)}"
+                              f".jsonl")
+
+
+class ShardedCandidateStore(CandidateStore):
+    """Fleet store: per-host append-only shards, merged reads.
+
+    ``host_label`` names the shard THIS process appends to
+    (``store-<host>.jsonl``); without one the store is a pure merged
+    reader (the ``status --fleet`` / ``coincidence`` verbs) and
+    ingests fall through to the legacy single-store file so nothing is
+    ever dropped.  Every read-side method — :meth:`records` and
+    therefore :meth:`count`, :meth:`sources`, :meth:`query` and the
+    coincidencer :meth:`coincident_groups` — sees the merge of ALL
+    shards plus the legacy file, in (shard name, file order): a
+    deterministic order, so merged queries equal the single-store
+    answer on the same record set (tests/test_fleet.py asserts this).
+    """
+
+    def __init__(self, root: str, host_label: str | None = None):
+        self.root = os.path.abspath(root)
+        self.host_label = (safe_label(host_label)
+                           if host_label is not None else None)
+        super().__init__(
+            shard_path(self.root, self.host_label)
+            if self.host_label is not None
+            else os.path.join(self.root, LEGACY_BASENAME))
+
+    def shard_files(self) -> list[str]:
+        """All shard files plus the legacy store, merge order."""
+        shards = sorted(
+            glob.glob(os.path.join(self.root, f"{SHARD_PREFIX}*.jsonl")))
+        legacy = os.path.join(self.root, LEGACY_BASENAME)
+        if os.path.exists(legacy):
+            shards.append(legacy)
+        return shards
+
+    def records(self, source: str | None = None,
+                min_snr: float | None = None) -> list[dict]:
+        out: list[dict] = []
+        for path in self.shard_files():
+            out.extend(_iter_records(path, source, min_snr))
+        return out
+
+    def shard_counts(self) -> dict[str, int]:
+        """Readable records per shard basename (fleet status table)."""
+        return {
+            os.path.basename(p): sum(1 for _ in _iter_records(p))
+            for p in self.shard_files()
+        }

@@ -137,6 +137,9 @@ class SurveyWorker:
         self.single_device = single_device
         self.max_devices = max_devices
         self.worker_id = worker_id or f"worker-{os.getpid()}"
+        #: fleet host label stamped on claims ("" single-host; set by
+        #: serve/fleet.py FleetWorker)
+        self.host_label = ""
         self.prefetch = prefetch
         self.run_job_fn = run_job_fn
         self.history_path = history_path
@@ -325,10 +328,11 @@ class SurveyWorker:
         t0 = time.time()
         claimed = succeeded = 0
         while max_jobs is None or claimed < max_jobs:
-            job = self.spool.claim(self.worker_id)
+            job = self.spool.claim(self.worker_id, host=self.host_label)
             if job is None:
                 if not wait:
                     break
+                self._idle_poll()
                 pause(poll_s, self.sleeper)
                 continue
             claimed += 1
@@ -348,6 +352,12 @@ class SurveyWorker:
         }
         self._append_throughput(summary)
         return summary
+
+    def _idle_poll(self) -> None:
+        """Hook run on every empty poll of a waiting drain (before
+        the pause).  The fleet worker reaps expired leases here —
+        idle hosts are the ones with time to adopt a dead host's
+        jobs."""
 
     def _append_throughput(self, summary: dict) -> None:
         """One ledger record per drain (the survey-level counterpart
@@ -377,6 +387,10 @@ class SurveyWorker:
                 "worker": self.worker_id,
                 "single_device": self.single_device,
                 "geometry_buckets": summary["geometry_buckets"],
+                # fleet mode: which host this throughput sample is
+                # from (obs/history.py documents the serve schema)
+                **({"host": self.host_label}
+                   if self.host_label else {}),
             },
         )
         append_history(rec, self.history_path)

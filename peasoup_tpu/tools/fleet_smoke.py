@@ -1,0 +1,274 @@
+"""Fleet smoke test (``make fleet-smoke``).
+
+Exercises the fleet control plane (serve/fleet.py) with REAL worker
+processes — ``python -m peasoup_tpu.serve fleet-worker`` subprocesses
+on fake membership — against one shared spool, the way a multi-host
+slice shares a filesystem:
+
+Phase 1 — two-host drain: spool two good synthetic observations plus
+one truncated mid-data, start fleet workers for host 0 and host 1
+concurrently, and assert the fleet's promises: 2 done + 1 quarantined
+with ZERO double-claims (every terminal record shows exactly one
+attempt), candidates landing in per-host ``store-<host>.jsonl``
+shards, no leases left behind, and both hosts' status snapshots
+present.
+
+Phase 2 — dead-host recovery: submit another observation, SIGKILL the
+claiming worker mid-job, and assert ``requeue --expired`` returns the
+job to ``pending/`` with a ``lease_expired`` failure entry and the
+attempt history intact; a second host's re-drain then finishes it.
+
+Phase 3 — fleet queries: the merged-shard ``coincident_groups`` must
+equal a single store holding the concatenated shards and find the
+cross-observation pulse train; ``status --fleet`` must render every
+host and write ``fleet_report.json``.
+
+Exit status 0 only if every assertion holds — CI-gateable like
+``serve-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+import numpy as np
+
+#: fast-search overrides shared by every smoke job
+FAST = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0, "limit": 10}
+
+
+def _write_synthetic(path: str, nsamps: int = 4096, nchans: int = 16,
+                     seed: int = 0, truncate_bytes: int = 0) -> str:
+    """A small 8-bit filterbank with a pulse train (the SAME period in
+    every observation, so the survey coincidencer has a cross-source
+    signal to find); ``truncate_bytes`` chops the data section short
+    of what the header declares."""
+    from peasoup_tpu.io.sigproc import (
+        SigprocHeader, write_sigproc_header,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    with open(path, "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        payload = data.tobytes()
+        if truncate_bytes:
+            payload = payload[:-truncate_bytes]
+        f.write(payload)
+    return path
+
+
+def _check(ok: bool, what: str, failures: list[str]) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        failures.append(what)
+
+
+def _fleet_worker_cmd(spool_dir: str, host_id: int, history: str,
+                      extra: list[str] | None = None) -> list[str]:
+    return [
+        sys.executable, "-m", "peasoup_tpu.serve",
+        "--spool", spool_dir, "fleet-worker",
+        "--host-id", str(host_id), "--host-count", "2",
+        "--drain", "--single_device", "--max-attempts", "2",
+        "--backoff-base", "0", "--history", history,
+        "--lease-ttl", "60", "--heartbeat", "0.5",
+    ] + (extra or [])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-fleet-smoke",
+        description="Peasoup-TPU - fleet control-plane smoke test",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-fleet-smoke",
+                   help="scratch directory (wiped)")
+    args = p.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir)
+    spool_dir = os.path.join(args.dir, "jobs")
+    history = os.path.join(args.dir, "history.jsonl")
+
+    from peasoup_tpu.serve import (
+        LEASE_EXPIRED, CandidateStore, JobSpool, ShardedCandidateStore,
+    )
+    from peasoup_tpu.serve.retry import pause
+
+    spool = JobSpool(spool_dir)
+    good = [
+        _write_synthetic(os.path.join(args.dir, f"obs{i}.fil"),
+                         seed=i)
+        for i in range(2)
+    ]
+    truncated = _write_synthetic(
+        os.path.join(args.dir, "obs_truncated.fil"), seed=2,
+        truncate_bytes=1024)
+    for path in good + [truncated]:
+        spool.submit(path, FAST)
+
+    failures: list[str] = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    # ---- phase 1: two hosts drain one spool concurrently -------------
+    # --max-jobs 2 caps either host at 2 of the 3 jobs, so BOTH hosts
+    # are guaranteed work (and a per-host throughput ledger record)
+    procs = [
+        subprocess.Popen(_fleet_worker_cmd(spool_dir, h, history,
+                                           ["--max-jobs", "2"]),
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for h in (0, 1)
+    ]
+    outs = [proc.communicate(timeout=600)[0] for proc in procs]
+    for h, out in enumerate(outs):
+        print(f"---- fleet-worker host-{h} ----")
+        print(out.strip())
+
+    counts = spool.counts()
+    _check(counts["done"] == 2, "2 jobs in done/", failures)
+    _check(counts["failed"] == 1, "1 job in failed/ (quarantine)",
+           failures)
+    _check(counts["pending"] == counts["running"] == 0,
+           "queue fully drained", failures)
+    terminal = spool.jobs("done") + spool.jobs("failed")
+    _check(all(rec.attempts == 1 for rec in terminal),
+           "zero double-claims (every terminal job: exactly 1 attempt)",
+           failures)
+    _check(not os.listdir(os.path.join(spool.root, "leases")),
+           "no leases left behind", failures)
+    bad = spool.jobs("failed")
+    _check(bool(bad) and bad[0].input == truncated
+           and bad[0].failures[0]["classification"] == "quarantine",
+           "truncated observation quarantined", failures)
+
+    from peasoup_tpu.serve.fleet import load_host_statuses
+
+    statuses = load_host_statuses(spool)
+    _check(set(statuses) == {"host-0", "host-1"},
+           "both hosts wrote status snapshots", failures)
+    claimed_total = sum(s["summary"]["claimed"]
+                       for s in statuses.values())
+    _check(claimed_total == 3,
+           f"per-host claims sum to 3 (got {claimed_total})", failures)
+
+    merged = ShardedCandidateStore(spool_dir)
+    shard_counts = merged.shard_counts()
+    _check(merged.count() > 0 and all(
+        name.startswith("store-host-") for name in shard_counts),
+        f"candidates in per-host shards {shard_counts}", failures)
+    _check(set(merged.sources()) == set(good),
+           "merged store sees both observations", failures)
+
+    # ---- phase 2: SIGKILL mid-job, lease-expiry recovery -------------
+    kill_fil = _write_synthetic(os.path.join(args.dir, "obs_kill.fil"),
+                                seed=3)
+    kill_rec = spool.submit(kill_fil, FAST)
+    proc = subprocess.Popen(
+        _fleet_worker_cmd(spool_dir, 0, history), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 120.0
+    while spool.counts()["running"] == 0 and time.time() < deadline:
+        pause(0.05)
+    claimed_mid_job = spool.counts()["running"] == 1
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=60)
+    _check(claimed_mid_job and spool.counts()["running"] == 1,
+           "worker SIGKILLed mid-job (job stuck in running/)",
+           failures)
+
+    rq = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+         spool_dir, "requeue", "--expired", "--lease-ttl", "0"],
+        env=env, capture_output=True, text=True, timeout=120)
+    print(rq.stdout.strip())
+    _check(rq.returncode == 0 and kill_rec.job_id in rq.stdout,
+           "requeue --expired reaped the killed worker's job",
+           failures)
+    _, back = spool.get(kill_rec.job_id)
+    _check(spool.counts()["pending"] == 1 and back.attempts == 1
+           and back.failures[-1]["classification"] == LEASE_EXPIRED,
+           "reaped job pending with attempt history + lease_expired "
+           "entry", failures)
+
+    redrain = subprocess.run(
+        _fleet_worker_cmd(spool_dir, 1, history), env=env,
+        capture_output=True, text=True, timeout=600)
+    print(redrain.stdout.strip())
+    _check(redrain.returncode == 0, "host-1 re-drain exit 0", failures)
+    state, done_rec = spool.get(kill_rec.job_id)
+    _check(state == "done" and done_rec.attempts == 2,
+           "killed job recovered to done/ on the second attempt",
+           failures)
+
+    # ---- phase 3: merged coincidence + status --fleet ----------------
+    merged = ShardedCandidateStore(spool_dir)
+    single_path = os.path.join(args.dir, "all_candidates.jsonl")
+    with open(single_path, "w") as out:
+        for shard in merged.shard_files():
+            with open(shard) as f:
+                out.write(f.read())
+    single = CandidateStore(single_path)
+    strip = lambda recs: sorted(
+        (r["source"], r["freq"], r["snr"]) for r in recs)
+    g_m = merged.coincident_groups(freq_tol=1e-3, min_sources=2)
+    g_s = single.coincident_groups(freq_tol=1e-3, min_sources=2)
+    _check([strip(g) for g in g_m] == [strip(g) for g in g_s],
+           "merged-shard coincident_groups == single-store groups",
+           failures)
+    cross = [g for g in g_m
+             if len({r["source"] for r in g}) >= 2]
+    _check(bool(cross),
+           f"cross-observation pulse train found "
+           f"({len(g_m)} group(s))", failures)
+
+    st = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+         spool_dir, "status", "--fleet"],
+        env=env, capture_output=True, text=True, timeout=120)
+    print(st.stdout.strip())
+    _check(st.returncode == 0 and "host-0" in st.stdout
+           and "host-1" in st.stdout and "TOTAL" in st.stdout,
+           "status --fleet renders every host + totals", failures)
+    report_path = os.path.join(spool_dir, "fleet_report.json")
+    report = (json.load(open(report_path))
+              if os.path.exists(report_path) else {})
+    _check(report.get("totals", {}).get("hosts") == 2
+           and report.get("queue", {}).get("done") == 3
+           and report.get("queue", {}).get("failed") == 1
+           and len(report.get("store", {}).get("shards", {})) >= 1,
+           "fleet_report.json aggregates hosts, queue and shards",
+           failures)
+
+    from peasoup_tpu.obs.history import load_history
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        serve_recs = load_history(history, kinds=["serve"])
+    hosts_in_ledger = {r.get("config", {}).get("host")
+                       for r in serve_recs}
+    _check({"host-0", "host-1"} <= hosts_in_ledger,
+           "per-host throughput records in the history ledger",
+           failures)
+
+    if failures:
+        print(f"\nfleet-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("\nfleet-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

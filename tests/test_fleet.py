@@ -1,0 +1,386 @@
+"""Fleet control-plane tests: fake membership, lease lifecycle +
+reaper recovery, simulated multi-host drains with no double-runs,
+sharded-store merge equality, and the fleet verbs — all WITHOUT real
+multihost (FleetMembership.fake simulates N hosts in one process;
+see CONTRIBUTING.md)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from peasoup_tpu.errors import ConfigError
+from peasoup_tpu.obs.metrics import REGISTRY
+from peasoup_tpu.serve import (
+    LEASE_EXPIRED,
+    BackoffPolicy,
+    CandidateStore,
+    FleetMembership,
+    FleetWorker,
+    JobSpool,
+    LeaseHeartbeat,
+    ShardedCandidateStore,
+    fleet_report,
+    write_fleet_report,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def _fleet_worker(spool, host_id, host_count, run_job_fn,
+                  tmp_path, **kw):
+    """A FleetWorker with fake membership and an injected job body —
+    the whole claim/lease/retry machinery stays live."""
+    kw.setdefault("backoff", BackoffPolicy(max_attempts=2, base_s=0.0))
+    kw.setdefault("history_path", str(tmp_path / "h.jsonl"))
+    kw.setdefault("sleeper", lambda s: None)
+    return FleetWorker(
+        spool, FleetMembership.fake(host_id, host_count),
+        run_job_fn=run_job_fn, **kw)
+
+
+# --------------------------------------------------------------------------
+# membership
+# --------------------------------------------------------------------------
+
+def test_fake_membership_identity_and_validation():
+    m = FleetMembership.fake(2, 4)
+    assert (m.host_id, m.host_count, m.label) == (2, 4, "host-2")
+    assert FleetMembership.fake(0, 1, "pod a/slice:3").label == \
+        "pod_a_slice_3"  # sanitised for file names
+    for bad in ((3, 3), (-1, 2), (0, 0)):
+        with pytest.raises(ConfigError):
+            FleetMembership.fake(*bad)
+
+
+def test_detect_single_process_is_one_host_fleet():
+    """Off-pod (no coordinator env) detect() must come back as the
+    1-host fleet — every fleet verb works on a laptop."""
+    m = FleetMembership.detect(label="solo")
+    assert (m.host_id, m.host_count, m.label) == (0, 1, "solo")
+
+
+# --------------------------------------------------------------------------
+# leases: claim -> heartbeat -> done/reap
+# --------------------------------------------------------------------------
+
+def test_claim_drops_lease_and_done_clears_it(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/x.fil")
+    job = spool.claim("w0", host="host-0")
+    lease = spool.lease_info(job.job_id)
+    assert lease["host"] == "host-0" and lease["attempt"] == 1
+    assert job.host == "host-0"
+    spool.mark_done(job)
+    assert spool.lease_info(job.job_id) is None
+
+
+def test_reaper_recovers_dead_host_job(tmp_path):
+    """An expired lease sends the job back to pending with the attempt
+    history intact and a LEASE_EXPIRED entry naming the dead host."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/x.fil", {"dm_end": 30.0})
+    job = spool.claim("w-dead", host="host-9")
+    assert job.attempts == 1
+    # fresh lease: nothing to reap at the default TTL
+    assert spool.reap_expired(120.0) == []
+    # the host dies; its lease goes stale past the TTL
+    with pytest.warns(UserWarning, match="reaped"):
+        reaped = spool.reap_expired(120.0, now=time.time() + 121.0)
+    assert [r.job_id for r in reaped] == [rec.job_id]
+    assert spool.counts()["pending"] == 1
+    assert spool.lease_info(rec.job_id) is None
+    again = spool.claim("w-live", host="host-0")
+    assert again.job_id == rec.job_id
+    assert again.attempts == 2  # history intact, like requeue
+    assert again.overrides == {"dm_end": 30.0}
+    exp = again.failures[-1]
+    assert exp["classification"] == LEASE_EXPIRED
+    assert "host-9" in exp["error"]
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.lease_reaped"] == 1
+
+
+def test_heartbeat_keeps_lease_fresh(tmp_path):
+    """A LeaseHeartbeat thread refreshes the lease faster than the
+    TTL, so a slow-but-alive job is never reaped."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/slow.fil")
+    job = spool.claim("w0", host="host-0")
+    first = spool.lease_info(job.job_id)["utc"]
+    with LeaseHeartbeat(spool, job, interval_s=0.05) as hb:
+        deadline = time.time() + 5.0
+        while hb.beats < 3 and time.time() < deadline:
+            hb._stop.wait(0.01)  # avoid bare sleep (PSL008)
+        assert hb.beats >= 3
+        # a reaper sweeping NOW sees a fresh beat, not the claim time
+        assert spool.reap_expired(1.0, now=first + 0.9) == []
+        assert spool.lease_info(job.job_id)["utc"] >= first
+    # heartbeat stopped: the same TTL eventually expires the lease
+    last = spool.lease_info(job.job_id)["utc"]
+    with pytest.warns(UserWarning, match="reaped"):
+        assert len(spool.reap_expired(1.0, now=last + 1.1)) == 1
+
+
+# --------------------------------------------------------------------------
+# simulated multi-host drains
+# --------------------------------------------------------------------------
+
+def test_three_host_drain_no_double_runs(tmp_path):
+    """Three fake hosts drain one spool concurrently: every job runs
+    exactly once, each host ingests into its own shard, and the
+    merged store sees everything."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    submitted = {spool.submit(f"/tmp/{i}.fil").job_id
+                 for i in range(18)}
+    runs: list[tuple[str, str]] = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(3)
+
+    def _make_runner(label):
+        def _run(job):
+            with lock:
+                runs.append((label, job.job_id))
+            return {"candidates": 0}
+        return _run
+
+    workers = [
+        _fleet_worker(spool, i, 3, _make_runner(f"host-{i}"), tmp_path,
+                      lease_ttl_s=60.0)
+        for i in range(3)
+    ]
+    summaries = [None] * 3
+
+    def _drain(i):
+        barrier.wait()
+        summaries[i] = workers[i].drain()
+
+    ts = [threading.Thread(target=_drain, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    ran = [job_id for _, job_id in runs]
+    assert sorted(ran) == sorted(submitted)  # all jobs ran
+    assert len(ran) == len(set(ran))  # ...exactly once
+    assert spool.counts()["done"] == 18
+    assert sum(s["claimed"] for s in summaries) == 18
+    assert {s["host"] for s in summaries} == {"host-0", "host-1",
+                                              "host-2"}
+    # no lease survives a drained queue
+    assert not os.listdir(os.path.join(spool.root, "leases"))
+    # every host wrote its status snapshot for `status --fleet`
+    report = fleet_report(spool)
+    assert set(report["hosts"]) == {"host-0", "host-1", "host-2"}
+    assert report["totals"]["claimed"] == 18
+    assert report["totals"]["succeeded"] == 18
+    assert report["queue"]["done"] == 18
+
+
+def test_fleet_drain_adopts_dead_hosts_job(tmp_path):
+    """Host A claims a job and dies (no heartbeat); host B's drain
+    reaps the stale lease up front and runs the job itself."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/orphan.fil")
+    dead = spool.claim("host-0:pid1", host="host-0")
+    assert dead.job_id == rec.job_id
+    # age the lease past a tiny TTL: rewrite it with an old beat
+    path = spool._lease_path(rec.job_id)
+    lease = json.load(open(path))
+    lease["utc"] = time.time() - 60.0
+    json.dump(lease, open(path, "w"))
+
+    ran = []
+    worker = _fleet_worker(spool, 1, 2, lambda job: ran.append(
+        job.job_id) or {"candidates": 0}, tmp_path, lease_ttl_s=5.0)
+    with pytest.warns(UserWarning, match="reaped"):
+        summary = worker.drain()
+    assert ran == [rec.job_id]
+    assert summary["succeeded"] == 1
+    done = spool.jobs("done")[0]
+    assert done.attempts == 2
+    assert done.failures[-1]["classification"] == LEASE_EXPIRED
+
+
+# --------------------------------------------------------------------------
+# sharded store
+# --------------------------------------------------------------------------
+
+class _C:
+    def __init__(self, freq, snr, dm=10.0):
+        self.freq = freq
+        self.snr = snr
+        self.dm = dm
+        self.acc = 0.0
+        self.folded_snr = 0.0
+        self.nh = 0
+
+
+def _populate(shard_a, shard_b):
+    # the same 10 Hz signal seen from two hosts' observations, plus
+    # per-host noise candidates
+    shard_a.ingest("j1", "beamA.fil", [_C(10.0, 12.0), _C(3.3, 9.0)])
+    shard_b.ingest("j2", "beamB.fil", [_C(10.0004, 11.0)])
+    shard_b.ingest("j3", "beamC.fil", [_C(77.7, 9.5)])
+
+
+def test_sharded_merge_equals_single_store(tmp_path):
+    """query/coincident_groups over the shard merge must equal a
+    single store holding the same records."""
+    root = str(tmp_path / "fleet")
+    _populate(ShardedCandidateStore(root, "host-0"),
+              ShardedCandidateStore(root, "host-1"))
+    single = CandidateStore(str(tmp_path / "single.jsonl"))
+    _populate(single, single)
+
+    merged = ShardedCandidateStore(root)  # pure reader: no label
+    assert merged.count() == single.count() == 4
+    assert merged.sources() == single.sources()
+    assert merged.shard_counts() == {"store-host-0.jsonl": 2,
+                                     "store-host-1.jsonl": 2}
+
+    q_m = merged.query(10.0, freq_tol=1e-3)
+    q_s = single.query(10.0, freq_tol=1e-3)
+    strip = lambda recs: sorted(
+        (r["source"], r["freq"], r["snr"]) for r in recs)
+    assert strip(q_m) == strip(q_s)
+
+    g_m = merged.coincident_groups(freq_tol=1e-3, min_sources=2)
+    g_s = single.coincident_groups(freq_tol=1e-3, min_sources=2)
+    assert [strip(g) for g in g_m] == [strip(g) for g in g_s]
+    assert len(g_m) == 1
+    assert {r["source"] for r in g_m[0]} == {"beamA.fil", "beamB.fil"}
+
+
+def test_sharded_store_tolerates_torn_shard_tail(tmp_path):
+    """One host killed mid-append tears only its own shard's tail;
+    the merge loses that one line, nothing else."""
+    root = str(tmp_path / "fleet")
+    a = ShardedCandidateStore(root, "host-0")
+    b = ShardedCandidateStore(root, "host-1")
+    _populate(a, b)
+    with open(b.path, "a") as f:
+        f.write('{"v": 1, "freq": 5.5, "job_id": "to')  # SIGKILL here
+    merged = ShardedCandidateStore(root)
+    assert merged.count() == 4
+    assert len(merged.coincident_groups(freq_tol=1e-3)) == 1
+
+
+def test_sharded_store_merges_legacy_single_file(tmp_path):
+    """A spool upgraded to fleet mode keeps its pre-fleet
+    candidates.jsonl visible in every merged query."""
+    root = str(tmp_path / "fleet")
+    os.makedirs(root)
+    legacy = CandidateStore(os.path.join(root, "candidates.jsonl"))
+    legacy.ingest("j0", "beamZ.fil", [_C(10.0002, 8.0)])
+    ShardedCandidateStore(root, "host-0").ingest(
+        "j1", "beamA.fil", [_C(10.0, 12.0)])
+    merged = ShardedCandidateStore(root)
+    assert merged.count() == 2
+    groups = merged.coincident_groups(freq_tol=1e-3, min_sources=2)
+    assert {r["source"] for r in groups[0]} == {"beamA.fil",
+                                                "beamZ.fil"}
+
+
+# --------------------------------------------------------------------------
+# fleet verbs
+# --------------------------------------------------------------------------
+
+def test_fleet_worker_verb_and_status_fleet(tmp_path, capsys):
+    """The CLI path end-to-end on fake membership: fleet-worker drains
+    with its label in the summary line, status --fleet renders the
+    per-host table and writes fleet_report.json."""
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    spool.submit("/tmp/a.fil")
+    # the real pipeline would quarantine /tmp/a.fil; inject instead
+    worker = _fleet_worker(spool, 0, 2,
+                           lambda job: {"candidates": 0}, tmp_path)
+    assert worker.drain()["succeeded"] == 1
+
+    rc = main(["--spool", spool_dir, "status", "--fleet"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "host-0" in out and "TOTAL" in out
+    assert "store-host-0.jsonl" in out
+    report = json.load(open(os.path.join(spool_dir,
+                                         "fleet_report.json")))
+    assert report["totals"]["hosts"] == 1
+    assert report["totals"]["claimed"] == 1
+    assert report["hosts"]["host-0"]["summary"]["succeeded"] == 1
+    assert "jobs_per_hour" in report["hosts"]["host-0"]["summary"]
+
+    # membership flags must come as a pair
+    with pytest.raises(ConfigError, match="together"):
+        main(["--spool", spool_dir, "fleet-worker", "--host-id", "0",
+              "--drain"])
+
+
+def test_coincidence_verb_over_shards(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    JobSpool(spool_dir)  # creates the root
+    _populate(ShardedCandidateStore(spool_dir, "host-0"),
+              ShardedCandidateStore(spool_dir, "host-1"))
+    out_json = str(tmp_path / "groups.json")
+    rc = main(["--spool", spool_dir, "coincidence",
+               "--freq-tol", "1e-3", "--json", out_json])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 coincident group(s)" in out
+    doc = json.load(open(out_json))
+    assert len(doc["groups"]) == 1
+    assert {r["source"] for r in doc["groups"][0]} == {"beamA.fil",
+                                                       "beamB.fil"}
+
+
+def test_requeue_expired_verb(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    rec = spool.submit("/tmp/x.fil")
+    spool.claim("w-dead", host="host-3")
+    # healthy fleet: zero reaped is rc 0, not an error
+    rc = main(["--spool", spool_dir, "requeue", "--expired"])
+    assert rc == 0
+    assert "0 lease-expired" in capsys.readouterr().out
+    # stale lease: --lease-ttl 0 reaps it immediately
+    with pytest.warns(UserWarning, match="reaped"):
+        rc = main(["--spool", spool_dir, "requeue", "--expired",
+                   "--lease-ttl", "0"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert f"reaped {rec.job_id}" in out
+    assert spool.counts()["pending"] == 1
+
+
+def test_write_fleet_report_is_atomic_and_stale_leases_flagged(
+        tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/x.fil")
+    spool.claim("w0", host="host-0")
+    # a lease older than the TTL shows up as stale in the report
+    path = spool._lease_path(spool.jobs("running")[0].job_id)
+    lease = json.load(open(path))
+    lease["utc"] = time.time() - 999.0
+    json.dump(lease, open(path, "w"))
+    report = fleet_report(spool, lease_ttl_s=10.0)
+    assert report["leases"] == {"running": 1, "stale": 1,
+                                "ttl_s": 10.0}
+    out = write_fleet_report(spool, report)
+    assert os.path.basename(out) == "fleet_report.json"
+    assert json.load(open(out))["leases"]["stale"] == 1
+    assert not [p for p in os.listdir(spool.root)
+                if p.startswith("fleet_report.json.tmp")]

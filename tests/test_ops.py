@@ -693,6 +693,95 @@ def test_extract_pallas_kernel_vmap(peaks_pallas_interpret):
         assert int(bc[b]) == wc
 
 
+def test_scatter_chunk_for_vmem_bound():
+    """The one-hot scatter tile must stay within the VMEM ceiling at
+    any lane-padded capacity (the whole-buffer compaction reuse pushes
+    cap_p to 8192)."""
+    from peasoup_tpu.ops.peaks_pallas import (
+        _SCATTER_TILE_BYTES,
+        _scatter_chunk_for,
+    )
+
+    assert _scatter_chunk_for(128) == 512
+    assert _scatter_chunk_for(2048) == 512
+    assert _scatter_chunk_for(4096) == 256
+    assert _scatter_chunk_for(8192) == 128
+    for cap_p in (128, 1024, 8192, 65536):
+        chunk = _scatter_chunk_for(cap_p)
+        assert chunk >= 128 and chunk & (chunk - 1) == 0
+        assert (cap_p * chunk * 4 <= _SCATTER_TILE_BYTES
+                or chunk == 128)
+
+
+def _compact_ref(flat_idx, flat_val, ck):
+    """Numpy model of the cumsum+scatter compaction: first ``ck``
+    valid slots in flat order, -1/0.0 padded, plus the TRUE count."""
+    keep = np.flatnonzero(flat_idx >= 0)
+    sel_i = np.full(ck, -1, flat_idx.dtype)
+    sel_v = np.zeros(ck, np.float32)
+    took = keep[:ck]
+    sel_i[: took.size] = flat_idx[took]
+    sel_v[: took.size] = flat_val[took]
+    return sel_i, sel_v, keep.size
+
+
+def test_compact_valid_slots_pallas_matches_reference(
+        peaks_pallas_interpret):
+    from peasoup_tpu.ops.peaks_pallas import compact_valid_slots_pallas
+
+    rng_ = np.random.default_rng(11)
+    for n, ck, p_valid in ((512, 64, 0.3), (2048, 128, 0.02),
+                           (1024, 64, 0.5),   # overflow: nvalid > ck
+                           (640, 128, 0.0),   # all invalid
+                           (256, 256, 1.0)):  # exactly full
+        idx = np.where(rng_.random(n) < p_valid,
+                       rng_.integers(0, 1 << 22, n),
+                       -1).astype(np.int32)
+        val = rng_.normal(size=n).astype(np.float32)
+        gi, gv, gc = compact_valid_slots_pallas(
+            jnp.asarray(idx), jnp.asarray(val), ck, interpret=True)
+        wi, wv, wc = _compact_ref(idx, val, ck)
+        np.testing.assert_array_equal(np.asarray(gi), wi)
+        np.testing.assert_array_equal(
+            np.asarray(gv).view(np.uint32), wv.view(np.uint32))
+        assert int(gc) == wc
+
+
+def test_compact_peaks_pallas_bit_equivalence(peaks_pallas_interpret):
+    """The whole-buffer compaction's pallas lowering must produce a
+    bit-identical packed buffer to the cumsum+scatter path — including
+    the overflow (nvalid > compact_k), all-invalid and exactly-full
+    cases, and adversarially scattered validity patterns (the XLA
+    contract only relies on flat slot order, not prefix packing)."""
+    from peasoup_tpu.parallel.mesh import _compact_peaks
+
+    rng_ = np.random.default_rng(5)
+    cases = [
+        (4, 3, 16, 64, 0.2),    # sparse, ck > nvalid
+        (6, 2, 32, 128, 0.9),   # overflow: nvalid > ck
+        (3, 2, 64, 384, 1.0),   # exactly full buffers
+        (5, 4, 8, 96, 0.0),     # no survivors at all
+    ]
+    for ntr, nl, cap, ck, p_valid in cases:
+        idxs = np.where(
+            rng_.random((ntr, nl, cap)) < p_valid,
+            rng_.integers(0, 1 << 22, (ntr, nl, cap)),
+            -1).astype(np.int32)
+        snrs = np.where(idxs >= 0,
+                        rng_.normal(size=idxs.shape) * 30,
+                        0.0).astype(np.float32)
+        counts = (idxs >= 0).sum(axis=2).astype(np.int32)
+        args = (jnp.asarray(idxs), jnp.asarray(snrs),
+                jnp.asarray(counts), ck)
+        want = np.asarray(_compact_peaks(*args, "xla"))
+        got = np.asarray(_compact_peaks(*args, "pallas"))
+        assert got.dtype == want.dtype == np.float32
+        np.testing.assert_array_equal(
+            got.view(np.uint32), want.view(np.uint32),
+            err_msg=f"case ntr={ntr} nl={nl} cap={cap} ck={ck} "
+                    f"p={p_valid}")
+
+
 def test_extract_top_peaks_method_parity():
     """All lowerings of the value-ordered extractor deliver the SAME
     hit set/pairing when count <= capacity (slot order differs by

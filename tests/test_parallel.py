@@ -308,3 +308,33 @@ def test_onehot_selection_assert_trips_on_inexact_dtype():
 
     with pytest.raises(DomainError, match="NOT bit-exact"):
         assert_onehot_selection_exact(value_dtype=jnp.bfloat16)
+
+
+def test_compact_method_for_gating(monkeypatch):
+    """The pallas whole-buffer compaction only applies where the
+    compiled kernel exists AND the buffer fits the kernel's VMEM gate
+    (exactly the tuned 8192-quantum compact_k range); forced XLA-side
+    extraction methods pin the XLA lowering."""
+    from types import SimpleNamespace
+
+    from peasoup_tpu.ops.peaks_pallas import COMPACT_PALLAS_MAX_K
+    from peasoup_tpu.search import pipeline as pipeline_mod
+
+    method_for = MeshPulsarSearch.compact_method_for
+
+    def stub(peaks_method="auto"):
+        return SimpleNamespace(
+            config=SimpleNamespace(peaks_method=peaks_method))
+
+    monkeypatch.setattr(pipeline_mod, "_pallas_mode",
+                        lambda: "compiled")
+    assert method_for(stub(), COMPACT_PALLAS_MAX_K) == "pallas"
+    assert method_for(stub(), COMPACT_PALLAS_MAX_K + 1) == "xla"
+    assert method_for(stub("pallas"), 4096) == "pallas"
+    assert method_for(stub("sort"), 4096) == "xla"
+    assert method_for(stub("two_stage"), 4096) == "xla"
+    # off-TPU (no compiled kernel) the compaction always stays XLA —
+    # an interpret-mode compaction would serialise the fused program
+    monkeypatch.setattr(pipeline_mod, "_pallas_mode", lambda: None)
+    assert method_for(stub(), 4096) == "xla"
+    assert method_for(stub("pallas"), 4096) == "xla"

@@ -83,6 +83,54 @@ def test_resolve_uses_measured_sidecar_argmin(tmp_path):
     assert got == ("pallas",)
 
 
+def test_resolve_escalated_capacity_reuses_nearest_cell():
+    """A clipped row's escalated capacity (e.g. 320 -> 4096) never has
+    an exact sweep cell; the nearest-capacity cell in the same stop
+    bucket must carry the tuner's verdict so the re-search does not
+    recompile the legacy heuristic's sort."""
+    bounds = ((0, 131000, 1.0),)  # bucket 131072, below 2^17 heuristic
+    # nearest cell to capacity 128 is 131072/64: two_stage measured
+    # ~3x cheaper than sort on v5e
+    got = tuning.resolve_peaks_methods(
+        bounds, 128, device_kind="TPU v5 lite", pallas_ok=None)
+    assert got == ("two_stage",)
+    # with the kernel available the donor cell's argmin is pallas
+    got = tuning.resolve_peaks_methods(
+        bounds, 4096, device_kind="TPU v5 lite", pallas_ok="compiled")
+    assert got == ("pallas",)
+
+
+def test_resolve_sidecar_nearest_capacity_and_exact_priority(tmp_path):
+    side = str(tmp_path / "tune.json")
+    tuning.update_extraction(side, "cpu", 9228, 320,
+                             costs={"sort": 5e-5, "two_stage": 1e-5})
+    # capacity 4096 has no exact cell: the 320 cell's verdict applies
+    got = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1),), 4096, device_kind="cpu", sidecar=side,
+        pallas_ok=None)
+    assert got == ("two_stage",)
+    # an exact cell at the escalated capacity still wins over nearest
+    tuning.update_extraction(side, "cpu", 9228, 4096,
+                             costs={"sort": 1e-5, "two_stage": 5e-5})
+    got = tuning.resolve_peaks_methods(
+        ((1, 9228, 0.1),), 4096, device_kind="cpu", sidecar=side,
+        pallas_ok=None)
+    assert got == ("sort",)
+    # other stop buckets never donate cells
+    got = tuning.resolve_peaks_methods(
+        ((0, 40000, 1.0),), 4096, device_kind="cpu", sidecar=side,
+        pallas_ok=None)
+    assert got == ("sort",)  # bucket 65536 empty -> heuristic
+
+
+def test_cell_for_tie_prefers_smaller_capacity():
+    table = {"16384/64": {"sort": 1.0}, "16384/576": {"sort": 2.0},
+             "32768/320": {"sort": 9.0}, "junk": 3, "a/b": {"sort": 1}}
+    cell = tuning._cell_for(table, 16384, 320)  # both 256 away
+    assert cell == {"sort": 1.0}
+    assert tuning._cell_for(table, 8192, 320) is None
+
+
 def test_resolve_skips_unsafe_two_stage_cells(tmp_path):
     side = str(tmp_path / "tune.json")
     tuning.update_extraction(side, "cpu", 9228, 64,
