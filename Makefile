@@ -6,8 +6,9 @@ TUTORIAL ?= /root/reference/example_data/tutorial.fil
 SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
 FLEET_SMOKE_DIR ?= /tmp/peasoup-fleet-smoke
+BATCH_SMOKE_DIR ?= /tmp/peasoup-batch-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -70,3 +71,12 @@ serve-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.fleet_smoke \
 	    --dir $(FLEET_SMOKE_DIR)
+
+# batched-dispatch smoke test: drain 4 same-geometry + 1 odd-geometry
+# observations with `worker --batch 4` and assert ONE batched dispatch
+# (+1 singleton for the odd bucket), all 5 done, fewer fused dispatches
+# than a sequential drain, per-beam store records bit-identical to the
+# batch=1 reference, and a ledger record with batch_fill >= 2
+batch-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.batch_smoke \
+	    --dir $(BATCH_SMOKE_DIR)

@@ -22,6 +22,16 @@ the benchmark's spans (obs/trace.py), including a parity-checked pass
 on the chunked driver for its per-chunk ``Chunked-Search-<i>`` spans;
 ``--lint`` runs the peasoup-lint gate instead of the benchmark.
 
+``--batch [B]`` (default 4) runs the batched-dispatch throughput
+benchmark instead: B synthetic same-geometry observations are drained
+twice — once with a ``batch=1`` worker (one device dispatch per job)
+and once with ``batch=B`` (ONE dispatch for all B beams) — with
+per-beam store records asserted bit-identical between the two drains
+before any number is reported.  Both drains append their own
+``kind="serve"`` ledger record (batch / batched_dispatches /
+batch_fill metrics included), so the B=1 vs B=B ``jobs_per_hour``
+pair is trendable from the same history the serve workers feed.
+
 Every successful run appends one structured record (git sha, device,
 timers, per-stage device time, roofline utilization, compile counts,
 parity verdict) to ``benchmarks/history.jsonl`` through the shared
@@ -132,6 +142,94 @@ def run_lint() -> int:
     return lint_main([])
 
 
+def batch_arg(argv: list[str]) -> int | None:
+    """``--batch [B]``: run the batched-dispatch throughput benchmark
+    with B same-geometry beams per dispatch (default 4)."""
+    if "--batch" not in argv:
+        return None
+    i = argv.index("--batch")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(2, int(argv[i + 1]))
+    return 4
+
+
+def run_batch_bench(b: int) -> int:
+    """``bench.py --batch B``: B=1 vs B=b survey drains over the same
+    synthetic observations; prints one JSON line with both
+    ``jobs_per_hour`` figures and the speedup, after asserting the
+    batched drain's per-beam store records are bit-identical to the
+    sequential reference (throughput that changes candidates is not
+    throughput)."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve import CandidateStore, JobSpool, SurveyWorker
+    from peasoup_tpu.tools.batch_smoke import (
+        _store_fingerprint, _write_synthetic,
+    )
+
+    work = tempfile.mkdtemp(prefix="peasoup-batch-bench-")
+    # --no-history: point the workers at a throwaway ledger so scratch
+    # experiments stay out of the trend (same contract as the e2e bench)
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        overrides = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0,
+                     "limit": 10}
+        obs = [
+            _write_synthetic(os.path.join(work, f"obs{i}.fil"), seed=i)
+            for i in range(b)
+        ]
+        modes = {}
+        fps = {}
+        for label, width in (("sequential", 1), ("batched", b)):
+            REGISTRY.reset()
+            spool = JobSpool(os.path.join(work, f"jobs_{label}"))
+            for path in obs:
+                spool.submit(path, overrides)
+            summary = SurveyWorker(
+                spool, batch=width, history_path=history,
+                sleeper=lambda s: None,
+            ).drain()
+            counters = REGISTRY.snapshot()["counters"]
+            modes[label] = {
+                "jobs_per_hour": summary["jobs_per_hour"],
+                "elapsed_s": summary["elapsed_s"],
+                "dispatches": counters.get("runs.mesh_fused", 0),
+                "batched_dispatches": counters.get(
+                    "scheduler.batched_dispatches", 0),
+                "batch_fill": counters.get("scheduler.batch_fill", 0),
+            }
+            if summary["succeeded"] != b:
+                print(json.dumps({
+                    "metric": "batched_dispatch_jobs_per_hour",
+                    "value": None, "batch": b,
+                    "error": f"{label} drain succeeded "
+                             f"{summary['succeeded']}/{b}",
+                }))
+                return 1
+            fps[label] = _store_fingerprint(CandidateStore(os.path.join(
+                work, f"jobs_{label}", "candidates.jsonl")), obs)
+        parity_ok = fps["sequential"] == fps["batched"]
+        out = {
+            "metric": "batched_dispatch_jobs_per_hour",
+            "value": modes["batched"]["jobs_per_hour"],
+            "unit": "jobs/h",
+            "batch": b,
+            "vs_sequential": round(
+                modes["batched"]["jobs_per_hour"]
+                / max(modes["sequential"]["jobs_per_hour"], 1e-9), 3),
+            "modes": modes,
+            "parity": ("per-beam candidates bit-identical"
+                       if parity_ok else "PER-BEAM PARITY FAILED"),
+        }
+        print(json.dumps(out))
+        return 0 if parity_ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -146,6 +244,9 @@ def trace_arg(argv: list[str]) -> str | None:
 def main() -> None:
     if "--lint" in sys.argv[1:]:
         sys.exit(run_lint())
+    b = batch_arg(sys.argv[1:])
+    if b is not None:
+        sys.exit(run_batch_bench(b))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

@@ -299,9 +299,15 @@ class PipelineGeometry:
     #: the peaks_cost formula so the roofline table reflects the
     #: actual lowering, not always the sort
     peaks_method: str = "sort"
+    #: leading observation axis of a batched dispatch (ISSUE 9): the
+    #: fused program unrolls B beams of identical per-beam work, so
+    #: every stage's flops/bytes scale linearly in B and roofline
+    #: utilization stays meaningful for the batched program
+    batch: int = 1
 
     @classmethod
-    def from_search(cls, search, acc_lists=None) -> "PipelineGeometry":
+    def from_search(cls, search, acc_lists=None,
+                    batch: int = 1) -> "PipelineGeometry":
         """Build from a ``PulsarSearch``-like driver.  ``acc_lists``
         (per-DM accel arrays) skips regenerating the trial grid when
         the caller already holds it."""
@@ -328,6 +334,7 @@ class PipelineGeometry:
             pass
         return cls(
             peaks_method=str(peaks_method),
+            batch=int(batch),
             n_dm=int(len(search.dm_list)),
             nchans=int(search.fil.nchans),
             out_nsamps=int(search.out_nsamps),
@@ -346,7 +353,7 @@ class PipelineGeometry:
         out = {k: int(getattr(self, k)) for k in (
             "n_dm", "nchans", "out_nsamps", "in_itemsize", "size",
             "nharmonics", "peak_capacity", "n_trials_total", "npdmp",
-            "fold_nsamps", "fold_nbins", "fold_nints")}
+            "fold_nsamps", "fold_nbins", "fold_nints", "batch")}
         out["peaks_method"] = str(self.peaks_method)
         return out
 
@@ -356,7 +363,9 @@ STAGES = ("dedisperse", "spectrum", "harmonics", "peaks", "fold")
 
 
 def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
-    """Per-stage totals for one full search at ``geom``."""
+    """Per-stage totals for one full search at ``geom`` — times
+    ``geom.batch`` when the dispatch stacks B observations (each beam
+    repeats the identical per-beam work, so totals are linear in B)."""
     nb = geom.size // 2 + 1
     nlevels = geom.nharmonics + 1
     spectrum = (whiten_cost(geom.size).scaled(geom.n_dm)
@@ -365,7 +374,7 @@ def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
     peaks = peaks_cost(nb, geom.peak_capacity,
                        geom.peaks_method).scaled(
         nlevels * geom.n_trials_total)
-    return {
+    stages = {
         "dedisperse": dedisperse_cost(
             geom.n_dm, geom.nchans, geom.out_nsamps, geom.in_itemsize),
         "spectrum": spectrum,
@@ -376,6 +385,9 @@ def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
             geom.fold_nsamps, geom.fold_nbins, geom.fold_nints
         ).scaled(geom.npdmp),
     }
+    if geom.batch > 1:
+        stages = {k: v.scaled(geom.batch) for k, v in stages.items()}
+    return stages
 
 
 # --------------------------------------------------------------------------
@@ -386,13 +398,15 @@ _lock = threading.Lock()
 _RUN_COSTS: dict | None = None
 
 
-def record_run_costs(search, acc_lists=None) -> dict:
+def record_run_costs(search, acc_lists=None, batch: int = 1) -> dict:
     """Compute and stash this run's stage costs (called once per run by
     each driver).  Also caches per-unit scalars on the search object so
     span call sites can attach ``gflops`` attributes cheaply.  Returns
-    ``{"geometry": PipelineGeometry, "stages": {name: StageCost}}``."""
+    ``{"geometry": PipelineGeometry, "stages": {name: StageCost}}``.
+    ``batch``: observation count of a batched dispatch (totals scale
+    linearly; the per-trial/per-row scalars stay per-beam)."""
     global _RUN_COSTS
-    geom = PipelineGeometry.from_search(search, acc_lists)
+    geom = PipelineGeometry.from_search(search, acc_lists, batch=batch)
     stages = pipeline_costs(geom)
     costs = {"geometry": geom, "stages": stages}
     # per-accel-trial search work (spectrum formation + harmonic sums +

@@ -30,7 +30,13 @@ Record schema (``v`` = 1; consumers tolerate additions)::
 loop (``serve/worker.py``) with metrics ``jobs_claimed``,
 ``jobs_succeeded``, ``jobs_failed``, ``elapsed_s`` and
 ``jobs_per_hour`` — the survey-throughput headline the perf tooling
-trends alongside the per-run benchmark figures.  In fleet mode
+trends alongside the per-run benchmark figures.  Workers running with
+``--batch B > 1`` additionally record ``batch`` (the configured stack
+width), ``batched_dispatches`` (device round trips that carried more
+than one observation) and ``batch_fill`` (total observations carried
+by those dispatches — ``batch_fill / batched_dispatches`` is the mean
+bucket fill), so the ledger can answer "did batching actually engage"
+next to the ``jobs_per_hour`` it is supposed to move.  In fleet mode
 (``serve/fleet.py``) every host appends its own record with
 ``config.host`` set to its fleet label, so per-host throughput can be
 trended — and summed — from the same ledger ``status --fleet``

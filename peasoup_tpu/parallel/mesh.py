@@ -290,6 +290,7 @@ def build_fused_search(
     quantise: bool = False,
     peaks_methods: tuple | None = None,
     compact_method: str = "xla",
+    batch: int = 1,
 ):
     """One jitted program for the ENTIRE device side of the search.
 
@@ -333,14 +334,28 @@ def build_fused_search(
     uint8 data (measured 2.1 ms vs 46 ms at tutorial scale on v5e;
     the vmapped dynamic_slice lowers to a batched gather).  Requires
     per-shard DM rows divisible by dm_tile and nbits <= 8.
+
+    ``batch``: leading observation axis B (ISSUE 9).  ``batch == 1``
+    is byte-for-byte the historical single-observation program.  For
+    ``batch > 1`` the ``raw`` input becomes ``(B, rawlen)`` packed
+    bytes (replicated) and the per-observation body is UNROLLED B
+    times — deliberately not vmapped: the Pallas dedisperse /
+    compaction kernels take no batch dim, and unrolling keeps each
+    beam's HLO identical to the B=1 program so per-beam results stay
+    bit-identical to sequential runs (the batched-parity gate).
+    Outputs become ``packed (B, ndev*blk_len)`` — row ``b`` is
+    exactly the B=1 packed global buffer — and ``trials
+    (B, ndm, out_nsamps)``.  Everything else (delay tables, accel
+    grid, masks) is shared: callers must only batch observations from
+    the same geometry bucket.
     """
     from ..ops.unpack import unpack_bits_device
 
     nlevels = nharms + 1
     use_tables = block is not None
 
-    def shard_fn(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
-                 birdies, widths):
+    def one_obs(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
+                birdies, widths):
         vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
         # full-width trials are returned for the folding phase (which
         # must see prev_power_of_two(out_nsamps) real samples exactly
@@ -418,6 +433,24 @@ def build_fused_search(
                                 compact_method)
         return packed, trials
 
+    if batch == 1:
+        shard_fn = one_obs
+        out_specs = (P("dm"), P("dm", None))
+    else:
+        def shard_fn(raw, delays, killmask, accs, uidx, d0_u, pos_u,
+                     step_u, birdies, widths):
+            outs = [one_obs(raw[b], delays, killmask, accs, uidx, d0_u,
+                            pos_u, step_u, birdies, widths)
+                    for b in range(batch)]
+            packed = jnp.stack([o[0] for o in outs])
+            trials = jnp.stack([o[1] for o in outs])
+            return packed, trials
+
+        # packed: shards concatenate along the buffer axis so row b of
+        # the global (B, ndev*blk_len) result IS the B=1 packed layout
+        # _decode_packed already understands; trials keep dm sharded
+        out_specs = (P(None, "dm"), P(None, "dm", None))
+
     mapped = _shard_map(
         shard_fn,
         mesh=mesh,
@@ -425,7 +458,7 @@ def build_fused_search(
             P(), P("dm", None), P(), P("dm", None), P("dm", None),
             P(), P(), P(), P(), P(),
         ),
-        out_specs=(P("dm"), P("dm", None)),
+        out_specs=out_specs,
         # pallas_call out_shapes carry no varying-mesh-axes annotation
         # (same waiver as build_chunked_search)
         check_vma=False,
@@ -2156,33 +2189,7 @@ class MeshPulsarSearch(PulsarSearch):
         self.record_peaks_selection(cap)
 
         def make_program(capacity, ck):
-            return build_fused_search(
-                self.mesh,
-                nbits=self.fil.header.nbits,
-                nchans=self.fil.nchans,
-                nsamps=self.fil.nsamps,
-                out_nsamps=self.out_nsamps,
-                size=self.size,
-                bin_width=self.bin_width,
-                tsamp=float(self.fil.tsamp),
-                nharms=cfg.nharmonics,
-                bounds=self.bounds,
-                capacity=capacity,
-                min_snr=cfg.min_snr,
-                b5=cfg.boundary_5_freq,
-                b25=cfg.boundary_25_freq,
-                use_zap=bool(len(self.birdies)),
-                use_killmask=self.killmask is not None,
-                compact_k=ck,
-                max_shift=self.max_shift,
-                block=self.resample_block,
-                dedisp_pallas=(
-                    dd_pallas["params"] if dd_pallas is not None else None
-                ),
-                quantise=cfg.trial_nbits == 8,
-                peaks_methods=self.peaks_methods_for(capacity),
-                compact_method=self.compact_method_for(ck),
-            )
+            return self._fused_program(capacity, ck, dd_pallas)
 
         METRICS.inc("runs.mesh_fused")
         while True:
@@ -2296,3 +2303,277 @@ class MeshPulsarSearch(PulsarSearch):
         if ckpt:
             ckpt.remove()
         return result
+
+    # -- batched multi-observation dispatch (ISSUE 9) --------------------
+
+    def _fused_program(self, capacity, ck, dd_pallas, batch: int = 1):
+        """The fused one-dispatch program for this search's geometry
+        (shared by ``run`` and ``run_batch``; lru-cached by shape)."""
+        cfg = self.config
+        return build_fused_search(
+            self.mesh,
+            nbits=self.fil.header.nbits,
+            nchans=self.fil.nchans,
+            nsamps=self.fil.nsamps,
+            out_nsamps=self.out_nsamps,
+            size=self.size,
+            bin_width=self.bin_width,
+            tsamp=float(self.fil.tsamp),
+            nharms=cfg.nharmonics,
+            bounds=self.bounds,
+            capacity=capacity,
+            min_snr=cfg.min_snr,
+            b5=cfg.boundary_5_freq,
+            b25=cfg.boundary_25_freq,
+            use_zap=bool(len(self.birdies)),
+            use_killmask=self.killmask is not None,
+            compact_k=ck,
+            max_shift=self.max_shift,
+            block=self.resample_block,
+            dedisp_pallas=(
+                dd_pallas["params"] if dd_pallas is not None else None
+            ),
+            quantise=cfg.trial_nbits == 8,
+            peaks_methods=self.peaks_methods_for(capacity),
+            compact_method=self.compact_method_for(ck),
+            batch=batch,
+        )
+
+    def _spawn(self, fil, cfg):
+        return MeshPulsarSearch(fil, cfg, mesh=self.mesh)
+
+    def _pack_raw(self, fil) -> np.ndarray:
+        if fil.header.nbits == 32:  # float data: nothing to pack
+            return np.ascontiguousarray(fil.data, np.float32).ravel()
+        return pack_bits(fil.data.ravel(), fil.header.nbits)
+
+    def run_batch(self, fils, configs=None) -> list:
+        """ONE fused dispatch over B same-bucket observations.
+
+        The per-dispatch fixed costs (compile lookup + two ~0.1 s
+        host<->device round trips) dominate fused-search wall-clock, so
+        stacking B beams into one ``(B, ...)`` program is a near-linear
+        ``jobs_per_hour`` multiplier for survey drains (ROADMAP open
+        item 2).  Per-beam semantics are preserved exactly: the batched
+        program unrolls the B=1 body per beam (bit-identical HLO),
+        decode/rerun/distill/checkpoint/finalise run per beam, and a
+        beam whose post-processing fails returns its exception in its
+        result slot without touching its batch-mates.  Falls back to
+        the sequential base implementation when the bounded-HBM
+        chunked plan is active or every beam is a checkpoint resume.
+        """
+        import time
+
+        from ..obs.metrics import install_compile_hook
+
+        B = len(fils)
+        configs = ([self.config] * B if configs is None
+                   else list(configs))
+        if B == 1:
+            return super().run_batch(fils, configs)
+        self._assert_batch_compatible(fils)
+        install_compile_hook()
+        cfg = self.config
+        ndm = len(self.dm_list)
+        acc_lists = [
+            self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
+        ]
+        namax = max(len(a) for a in acc_lists)
+        n_trials_total = sum(len(a) for a in acc_lists)
+        plan = self._plan_chunking(namax)
+        if plan is not None:
+            # production-scale chunked path has no batch axis (its HBM
+            # budget is already saturated by ONE observation): run the
+            # beams sequentially rather than refuse
+            warn_event(
+                "batch_fallback",
+                "bounded-HBM chunked plan active: batched dispatch "
+                "falls back to sequential per-beam runs",
+                batch=B, path="chunked",
+            )
+            return super().run_batch(fils, configs)
+        # per-beam checkpoints: complete resumes skip decode/distill
+        # for that beam (mirrors run()'s all-done short-circuit)
+        ckpts, resumed = [], {}
+        for b in range(B):
+            ck_b, done_b = self._make_checkpoint(fils[b], configs[b])
+            ckpts.append(ck_b)
+            if ck_b and len(done_b) == ndm:
+                resumed[b] = done_b
+        live = [b for b in range(B) if b not in resumed]
+        if not live:
+            # nothing left to search; sequential resumes also handle
+            # the npdmp>0 re-dedisperse correctly
+            return super().run_batch(fils, configs)
+
+        timers: dict[str, float] = {}
+        t_total = time.time()
+        METRICS.gauge("search.n_dm_trials", ndm)
+        METRICS.gauge("search.fft_size", self.size)
+        METRICS.gauge("search.n_devices", self.ndev)
+        METRICS.gauge("search.batch", B)
+        ndm_p = self._padded_trial_count()
+        ndev = self.ndev
+        nlevels = cfg.nharmonics + 1
+        from ..obs.costmodel import record_run_costs
+
+        run_costs = record_run_costs(self, acc_lists, batch=B)["stages"]
+        dd_pallas = self._plan_fused_pallas_dedisp()
+        if dd_pallas is not None:
+            ndm_p = dd_pallas["ndm_p"]
+        ndm_local = ndm_p // ndev
+        from ..search.tuning import load_tuning, round_up, save_tuning
+
+        # capacity/compaction tuning is per BEAM (every beam compacts
+        # its own buffer), so the B=1 hints and sidecar cells apply
+        # unchanged — see search/tuning.py "Batch axis" note
+        if cfg.tune_file and getattr(self, "_cap_hint", None) is None:
+            tune = load_tuning(cfg.tune_file,
+                               self._tune_scoped_key("fused"))
+            if tune is not None:
+                self._cap_hint = round_up(tune["cap_hw"] + 32, 64, 64,
+                                          cfg.peak_capacity)
+                self._ck_hint = round_up(int(tune["ck_hw"] * 1.1), 8192,
+                                         8192, cfg.compact_capacity)
+        cap = min(cfg.peak_capacity,
+                  getattr(self, "_cap_hint", cfg.peak_capacity))
+        compact_k = min(
+            cfg.compact_capacity, ndm_local * namax * nlevels * cap,
+            getattr(self, "_ck_hint", cfg.compact_capacity),
+        )
+        t0 = time.time()
+        inputs = self._device_inputs(acc_lists, ndm_p, namax)
+        raw_B = np.stack([self._pack_raw(f) for f in fils])
+        inputs = (put_global(raw_B, NamedSharding(self.mesh, P())),
+                  ) + tuple(inputs[1:])
+        self.record_peaks_selection(cap)
+        METRICS.inc("runs.mesh_fused")
+        METRICS.inc("runs.mesh_fused_batched")
+        beam_fail: dict[int, BaseException] = {}
+        decoded: dict[int, tuple] = {}
+        while True:
+            program = self._fused_program(cap, compact_k, dd_pallas,
+                                          batch=B)
+            fused_gflops = sum(
+                run_costs[s].flops
+                for s in ("dedisperse", "spectrum", "harmonics", "peaks")
+            ) / 1e9
+            with span("Fused-Search", metric="fused_search",
+                      batch=B, n_dm_trials=ndm,
+                      n_trials=int(n_trials_total),
+                      dm_lo=float(self.dm_list[0]),
+                      dm_hi=float(self.dm_list[-1]),
+                      capacity=int(cap), compact_k=int(compact_k),
+                      hbm_budget_bytes=float(cfg.hbm_budget_gb * 1e9),
+                      gflops=round(fused_gflops, 3),
+                      ) as sp:
+                packed, trials = program(*inputs)
+                tf = time.time()
+                # (B, ndev*blk_len): row b IS the B=1 packed layout
+                packed = fetch_to_host(packed)
+                sp.add_device_time(time.time() - tf)
+            beam_fail, decoded = {}, {}
+            with span("Peak-Decode", metric="peak_decode", batch=B):
+                for b in live:
+                    try:
+                        decoded[b] = self._decode_packed(
+                            packed[b], ndm_local, namax, nlevels, cap,
+                            compact_k,
+                        )
+                    except Exception as exc:  # beam-fatal, mates live on
+                        beam_fail[b] = exc
+            if not decoded:
+                break
+            mx_count = max(d[1] for d in decoded.values())
+            mx_valid = max(d[2] for d in decoded.values())
+            n_trunc = max(len(d[5]) for d in decoded.values())
+            nxt = self._escalated(
+                cap, compact_k, mx_count, mx_valid,
+                ndm_local * namax * nlevels * cap, n_trunc, ndm,
+            )
+            if nxt is None:
+                break
+            cap, compact_k = nxt
+        # per-beam clipped-row re-searches on that beam's trials
+        reruns: dict[int, dict] = {}
+        for b in list(decoded):
+            try:
+                _g, _mc, _mv, counts_b, clipped_b, _t = decoded[b]
+                trials_b = trials[b]
+                reruns[b] = self._rerun_clipped_rows(
+                    clipped_b, counts_b,
+                    lambda rows, _t=trials_b: (
+                        _t, {ii: ii for ii in rows}),
+                )
+            except Exception as exc:
+                beam_fail[b] = exc
+                decoded.pop(b)
+        if decoded:
+            # observed high-waters tighten the NEXT dispatch's buffers;
+            # max over beams — a per-beam quantity, so B=1 and batched
+            # runs feed the same hints/sidecar cells (B-invariance)
+            mx_count = max(d[1] for d in decoded.values())
+            mx_valid = max(d[2] for d in decoded.values())
+            self._cap_hint = round_up(mx_count + 32, 64, 64,
+                                      cfg.peak_capacity)
+            ck_hint = round_up(int(mx_valid * 1.1), 8192, 8192,
+                               cfg.compact_capacity)
+            if ck_hint < getattr(self, "_ck_hint", 1 << 62):
+                self._ck_hint = ck_hint
+            if cfg.tune_file:
+                hw_valid = max(
+                    int(d[3].reshape(self.ndev, -1).sum(axis=1).max())
+                    for d in decoded.values()
+                )
+                save_tuning(cfg.tune_file,
+                            self._tune_scoped_key("fused"),
+                            mx_count, hw_valid)
+        timers["dedispersion"] = 0.0  # fused into the search program
+        timers["searching_device"] = time.time() - t0
+        # ONE segmented distill across every live beam: (beam, dm) keys
+        # keep the segments per-beam, so cross-beam absorption is
+        # structurally impossible
+        with span("Distill", metric="distillation",
+                  n_dm_trials=ndm * max(len(decoded), 1), batch=B):
+            distilled = self._distill_rows_batch(
+                (((b, ii), decoded[b][0].get(ii), acc_lists[ii])
+                 for b in decoded for ii in range(ndm)
+                 if ii not in reruns[b]),
+                dm_of=lambda k: k[1],
+            )
+        timers["searching"] = time.time() - t0
+        # fan results back out per beam; a beam that fails here keeps
+        # its exception in its own slot (checkpoints of batch-mates are
+        # untouched — each beam has its own checkpoint file/key)
+        results: list = [None] * B
+        for b in range(B):
+            if b in beam_fail:
+                results[b] = beam_fail[b]
+                continue
+            try:
+                dm_cands = CandidateCollection()
+                ckpt_done = {}
+                if b in resumed:
+                    for ii in range(ndm):
+                        dm_cands.append(resumed[b][ii])
+                else:
+                    rerun_b = reruns[b]
+                    for ii in range(ndm):
+                        cands_ii = (rerun_b[ii] if ii in rerun_b
+                                    else distilled[(b, ii)])
+                        ckpt_done[ii] = cands_ii
+                        dm_cands.append(cands_ii)
+                    if ckpts[b]:
+                        ckpts[b].save(ckpt_done)
+                # folding inputs are per-beam: never share the cache
+                self._fold_input_cache = {}
+                results[b] = self._finalise(
+                    dm_cands, trials[b], dict(timers), t_total,
+                    config=configs[b],
+                )
+                if ckpts[b]:
+                    ckpts[b].remove()
+            except Exception as exc:  # per-beam failure isolation
+                results[b] = exc
+        self.last_dispatch_batched = True
+        return results

@@ -19,6 +19,16 @@ Host-side greedy SNR-sorted dedup, exact semantics of
 
 The O(n^2) pair predicates are vectorised over the trailing candidates
 (the reference's inner loops, `distiller.hpp:69-197`, are per-pair).
+
+Distillers are strictly per-observation: every pass runs over ONE
+SearchResult's candidates.  Batched multi-observation dispatch
+(ISSUE 9) preserves this — the driver keys its batched distillation
+rows by ``(beam, dm_idx)`` so each beam's candidates flow through
+separate native segments, and a fundamental in one beam can never
+absorb a harmonic from a batch-mate.  Cross-OBSERVATION matching is a
+different operation with different semantics (position/epoch aware)
+and lives in the survey layer (``serve/store.py``'s coincidence
+queries), not here.
 """
 
 from __future__ import annotations

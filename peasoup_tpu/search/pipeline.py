@@ -575,12 +575,17 @@ class PulsarSearch:
             ])
         return self._distill_accel_groups(groups)
 
-    def _distill_rows_batch(self, rows) -> dict[int, list[Candidate]]:
+    def _distill_rows_batch(self, rows, dm_of=None) -> dict:
         """Vectorised per-DM distillation tail for many DM rows at once.
 
-        ``rows``: iterable of ``(dm_idx, group_or_None, acc_list)`` with
+        ``rows``: iterable of ``(key, group_or_None, acc_list)`` with
         ``group = (freqs, snrs, acc_slot, level)`` arrays as produced by
-        the mesh decode.  Semantically identical to calling
+        the mesh decode.  ``key`` is normally the DM index; batched
+        dispatch passes ``(beam, dm_idx)`` keys with ``dm_of`` mapping a
+        key to its DM index, so one segmented call distills every
+        beam's rows while per-beam candidate separation is structural —
+        rows from different beams are distinct segments and can never
+        absorb each other.  Semantically identical to calling
         ``_distill_dm_row`` per row (harmonic distillation within each
         accel trial, then acceleration distillation across them,
         `pipeline_multi.cu:238,243`), but runs ONE segmented native call
@@ -594,12 +599,14 @@ class PulsarSearch:
 
         cfg = self.config
         rows = list(rows)
+        if dm_of is None:
+            dm_of = lambda k: k
         if _native is None:
             return {
-                ii: self._distill_dm_row(ii, grp, acc_list)
+                ii: self._distill_dm_row(dm_of(ii), grp, acc_list)
                 for ii, grp, acc_list in rows
             }
-        out: dict[int, list[Candidate]] = {}
+        out: dict = {}
         # ---- stage A: harmonic distill per (dm, accel) segment -------
         fa, sa, nha, acca = [], [], [], []
         bounds_a = [0]
@@ -657,7 +664,7 @@ class PulsarSearch:
             self.tobs / SPEED_OF_LIGHT, True,
         )
         # ---- materialise Candidate objects (assoc via pair list) -----
-        dmib = np.repeat([ii for ii, _na in row_meta],
+        dmib = np.repeat([dm_of(ii) for ii, _na in row_meta],
                          np.diff(bounds_b))
         objs = [
             Candidate(dm=float(self.dm_list[dmib[k]]),
@@ -733,15 +740,18 @@ class PulsarSearch:
 
     # -- full run ----------------------------------------------------------
 
-    def _make_checkpoint(self):
-        cfg = self.config
+    def _make_checkpoint(self, fil=None, cfg=None):
+        # batched dispatch passes per-beam (fil, cfg) so every beam
+        # keeps its own checkpoint identity/file; default: this search
+        fil = self.fil if fil is None else fil
+        cfg = self.config if cfg is None else cfg
         if not cfg.checkpoint_file:
             return None, {}
         from .checkpoint import SearchCheckpoint, search_key
 
         ckpt = SearchCheckpoint(
             cfg.checkpoint_file,
-            search_key(cfg.infilename, self.fil, cfg),
+            search_key(cfg.infilename, fil, cfg),
             cfg.checkpoint_interval,
             advisory={"input": cfg.infilename},
         )
@@ -812,16 +822,79 @@ class PulsarSearch:
             ckpt.remove()  # run completed; resume no longer needed
         return result
 
+    # -- batched multi-observation dispatch (ISSUE 9) ----------------------
+
+    # True after a run_batch() that actually used a single batched
+    # device program (the mesh fused path); False after the sequential
+    # fallback — the worker's scheduler.batched_dispatches counter and
+    # the batch-smoke gate key off this.
+    last_dispatch_batched = False
+
+    def _spawn(self, fil, cfg):
+        """Fresh driver of this type for one batch-mate observation."""
+        return type(self)(fil, cfg)
+
+    @staticmethod
+    def _batch_fields(fil):
+        hdr = fil.header
+        return (fil.nsamps, fil.nchans, int(hdr.nbits), float(hdr.tsamp),
+                float(hdr.fch1), float(hdr.foff))
+
+    def _assert_batch_compatible(self, fils):
+        """Batched dispatch shares ONE plan (delay table, accel grid,
+        fft size) across beams, so every observation must match the
+        leader's geometry exactly — the worker's bucket key guarantees
+        this; anything else is a caller bug, not a data problem."""
+        want = self._batch_fields(self.fil)
+        for i, fil in enumerate(fils):
+            got = self._batch_fields(fil)
+            if got != want:
+                raise ConfigError(
+                    f"batch beam {i} geometry {got} != leader {want}; "
+                    f"batched dispatch requires one geometry bucket"
+                )
+
+    def run_batch(self, fils, configs=None) -> list:
+        """Search B same-geometry observations; one result per beam.
+
+        Returns a list aligned with ``fils`` whose slots are either a
+        :class:`SearchResult` or the exception that beam raised — a
+        failing beam never poisons its batch-mates.  This base
+        implementation runs the beams sequentially (the host-loop
+        driver has no batched program); :class:`MeshPulsarSearch`
+        overrides it with the single-dispatch ``(B, ...)`` fused
+        program.  ``self`` must have been built from ``fils[0]``;
+        ``configs`` may differ per beam only in path-like fields
+        (outdir / checkpoint_file / infilename).
+        """
+        configs = ([self.config] * len(fils) if configs is None
+                   else list(configs))
+        self._assert_batch_compatible(fils)
+        self.last_dispatch_batched = False
+        results = []
+        for fil, cfg in zip(fils, configs):
+            try:
+                drv = (self if fil is self.fil and cfg is self.config
+                       else self._spawn(fil, cfg))
+                results.append(drv.run())
+            except Exception as exc:  # per-beam failure isolation
+                results.append(exc)
+        return results
+
     def _finalise(self, dm_cands, trials, timers, t_total,
-                  trials_provider=None) -> SearchResult:
+                  trials_provider=None, config=None) -> SearchResult:
         """Shared tail of every driver (`pipeline_multi.cu:362-391`):
         cross-DM distillation, scoring, folding, limit, result.
 
         ``trials_provider``: bounded-HBM drivers pass a callable
         (dm_idxs) -> (trials, row_map) instead of resident trials; the
         candidate DM rows are re-dedispersed only if folding runs.
+
+        ``config``: batched dispatch passes the per-beam config (same
+        search parameters by construction, beam-specific paths) so the
+        SearchResult routes outputs to that beam's outdir.
         """
-        cfg = self.config
+        cfg = self.config if config is None else config
         with span("Distill", metric="distillation",
                   n_candidates=len(dm_cands.cands)):
             dm_still = DMDistiller(cfg.freq_tol, True)

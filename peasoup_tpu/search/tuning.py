@@ -41,6 +41,23 @@ committed v5e defaults (:data:`DEFAULT_EXTRACTION_COSTS`) and then to
 the legacy size heuristic.  The section survives ``save_tuning``
 rewrites and search-key mismatches: extraction costs are a property
 of the device, not of one search.
+
+Batch axis (ISSUE 9)
+--------------------
+
+Batched multi-observation dispatch (``MeshPulsarSearch.run_batch``)
+deliberately does NOT extend either key with the batch width ``B``.
+Every quantity this sidecar records is a per-spectrum / per-beam
+figure — the max above-threshold count of ONE spectrum, the valid
+-peak total of ONE beam's shard, the extraction cost of ONE spectrum's
+top-k — because each beam in a batch compacts its own buffer through
+the same per-beam program body a solo run uses.  A batched run
+therefore saves the max over its beams' high-water marks under the
+unchanged search key, and a hint recorded at ``B=4`` sizes a ``B=1``
+run (or vice versa) exactly as well as one recorded solo.  Keying
+cells by ``B`` would instead fragment the record (cold hints after
+every batch-width change) for no information gain;
+``tests/test_search.py::TestBatchedDispatch`` pins the invariance.
 """
 
 from __future__ import annotations

@@ -153,6 +153,12 @@ def _add_worker_args(pw) -> None:
                     help="device cap for the mesh driver")
     pw.add_argument("--no-prefetch", action="store_true",
                     help="disable next-observation read overlap")
+    pw.add_argument("--batch", type=int, default=1,
+                    help="stack up to B same-geometry pending jobs "
+                         "into ONE batched device dispatch (bucket "
+                         "fill: mates jump queue order; --timeout "
+                         "then bounds the whole dispatch). 1 = "
+                         "per-job dispatch")
     pw.add_argument("--history", default=None,
                     help="throughput ledger path (default: the repo "
                          "benchmarks/history.jsonl)")
@@ -184,6 +190,7 @@ def cmd_worker(spool, args) -> int:
         max_devices=args.max_num_threads,
         prefetch=not args.no_prefetch,
         history_path=args.history,
+        batch=args.batch,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -227,6 +234,7 @@ def cmd_fleet_worker(spool, args) -> int:
         max_devices=args.max_num_threads,
         prefetch=not args.no_prefetch,
         history_path=args.history,
+        batch=args.batch,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)

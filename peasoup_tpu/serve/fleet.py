@@ -191,6 +191,17 @@ class FleetWorker(SurveyWorker):
         with LeaseHeartbeat(self.spool, job, self.heartbeat_s):
             return super().run_one(job)
 
+    def _run_batch_jobs(self, jobs: list[JobRecord]) -> int:
+        # every beam of a batched dispatch keeps its own lease fresh,
+        # so a long batch never looks dead to the reaper
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            for job in jobs:
+                stack.enter_context(
+                    LeaseHeartbeat(self.spool, job, self.heartbeat_s))
+            return super()._run_batch_jobs(jobs)
+
     def _idle_poll(self) -> None:
         self.spool.reap_expired(self.lease_ttl_s)
 

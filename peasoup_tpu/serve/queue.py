@@ -214,6 +214,35 @@ class JobSpool:
             return rec
         return None
 
+    def claim_job(self, job_id: str, worker: str = "",
+                  host: str = "") -> JobRecord | None:
+        """Claim one SPECIFIC pending job, or None (gone / lost race).
+
+        The batched worker uses this to pull same-geometry batch-mates
+        out of queue order once it holds a leader job: the same atomic
+        pending->running rename arbitrates against concurrent
+        claimers, so a lost race simply means a smaller batch.
+        """
+        src = self._path("pending", job_id)
+        rec = self._read(src)
+        if rec is None:
+            return None
+        dst = self._path("running", job_id)
+        try:
+            os.rename(src, dst)
+        except FileNotFoundError:
+            return None  # another worker won this one
+        rec.worker = worker
+        rec.host = host
+        rec.claimed_utc = time.time()
+        rec.attempts += 1
+        self._write(dst, rec)
+        self.heartbeat(rec)
+        METRICS.inc("scheduler.claimed")
+        METRICS.observe(
+            "queue_wait", rec.claimed_utc - rec.submitted_utc)
+        return rec
+
     # -- leases (fleet hardening) ------------------------------------------
 
     def heartbeat(self, rec: JobRecord) -> None:

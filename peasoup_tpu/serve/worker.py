@@ -58,58 +58,60 @@ from .store import CandidateStore
 
 
 class ObservationPrefetcher:
-    """Single-slot background filterbank reader (double buffering at
-    observation granularity).
+    """Multi-slot background filterbank reader (double buffering at
+    observation granularity; ``slots`` of them for batched dispatch).
 
     ``start(path)`` spawns a daemon thread reading + unpacking the
     file while the caller's search occupies the devices; ``take(path)``
     joins and hands the :class:`Filterbank` over — or returns None on
     a slot miss (a different job won the claim) or a read error (the
     claimer's own synchronous read then raises the real, classifiable
-    exception in job context).
+    exception in job context).  With ``slots > 1`` the batched worker
+    fills the NEXT batch's observations while the current batch is on
+    device; when full, the oldest slot is evicted (its read result is
+    simply dropped — prefetch is only ever a hint).
     """
 
-    def __init__(self):
-        self._thread: threading.Thread | None = None
-        self._path: str | None = None
-        self._result = None
-        self._error: BaseException | None = None
+    def __init__(self, slots: int = 1):
+        self.slots = max(1, int(slots))
+        # path -> {"thread", "result", "error"}; insertion-ordered so
+        # eviction drops the oldest prefetch first
+        self._inflight: dict[str, dict] = {}
 
     def start(self, path: str) -> None:
-        if self._path == path:
+        if path in self._inflight:
             return  # already in flight (or landed) for this path
-        if self._thread is not None and self._thread.is_alive():
-            self._thread.join()  # reads are short next to a search
-        self._path = path
-        self._result = None
-        self._error = None
+        while len(self._inflight) >= self.slots:
+            oldest = next(iter(self._inflight))
+            slot = self._inflight.pop(oldest)
+            if slot["thread"].is_alive():
+                slot["thread"].join()  # reads are short next to a search
+        slot = {"thread": None, "result": None, "error": None}
 
         def _read():
             from ..io.sigproc import read_filterbank
 
             try:
-                self._result = read_filterbank(path)
+                slot["result"] = read_filterbank(path)
             except BaseException as exc:
-                self._error = exc
+                slot["error"] = exc
 
-        self._thread = threading.Thread(
+        slot["thread"] = threading.Thread(
             target=_read, daemon=True, name="serve-prefetch")
-        self._thread.start()
+        self._inflight[path] = slot
+        slot["thread"].start()
 
     def take(self, path: str):
-        if self._path != path:
+        slot = self._inflight.pop(path, None)
+        if slot is None:
             METRICS.inc("scheduler.prefetch_misses")
             return None
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        result, error = self._result, self._error
-        self._path = self._result = self._error = None
-        if error is not None or result is None:
+        slot["thread"].join()
+        if slot["error"] is not None or slot["result"] is None:
             METRICS.inc("scheduler.prefetch_misses")
             return None
         METRICS.inc("scheduler.prefetch_hits")
-        return result
+        return slot["result"]
 
 
 class SurveyWorker:
@@ -127,7 +129,8 @@ class SurveyWorker:
                  timeout_s: float = 0.0, single_device: bool = False,
                  max_devices: int | None = None, worker_id: str = "",
                  prefetch: bool = True, run_job_fn=None,
-                 history_path: str | None = None, sleeper=None):
+                 history_path: str | None = None, sleeper=None,
+                 batch: int = 1):
         self.spool = spool
         self.store = store if store is not None else CandidateStore(
             os.path.join(spool.root, "candidates.jsonl"))
@@ -144,7 +147,11 @@ class SurveyWorker:
         self.run_job_fn = run_job_fn
         self.history_path = history_path
         self.sleeper = sleeper
-        self._prefetcher = ObservationPrefetcher()
+        #: batched dispatch (ISSUE 9): stack up to ``batch``
+        #: same-geometry pending jobs into ONE fused device program per
+        #: round trip; 1 = historical per-job dispatch
+        self.batch = max(1, int(batch))
+        self._prefetcher = ObservationPrefetcher(slots=self.batch)
         #: geometry bucket -> jobs served (program-reuse accounting)
         self.geometries: dict[tuple, int] = {}
 
@@ -200,6 +207,187 @@ class SurveyWorker:
             METRICS.inc("scheduler.plan_reuse")
         self.geometries[gkey] = self.geometries.get(gkey, 0) + 1
         return fil, search
+
+    # -- batched dispatch (ISSUE 9) ----------------------------------------
+
+    def _batch_key(self, job: JobRecord):
+        """Geometry fingerprint computable from the HEADER alone.
+
+        Two jobs may share one batched dispatch iff they resolve to
+        the identical plan: same overrides and same (nchans, nbits,
+        tsamp, fch1, foff) — which fix the delay table and accel grid
+        — plus the same fft ``size`` and the same effective (post
+        lossless-trim) sample count.  Deliberately STRICTER than
+        ``_build_search``'s reuse bucket, which omits the frequency
+        axis.  Only the SIGPROC header is read (cheap), never the
+        data.  None = don't batch this job (unreadable header, odd
+        config); it then runs through the normal solo path.
+        """
+        try:
+            cfg = self._job_config(job)
+            from ..io.sigproc import read_sigproc_header
+
+            with open(job.input, "rb") as f:
+                hdr = read_sigproc_header(f)
+            from ..ops import delay_table, generate_dm_list, max_delay
+            from ..search.plan import prev_power_of_two
+
+            if cfg.dm_list is not None:
+                import numpy as np
+
+                dm_list = np.asarray(cfg.dm_list, dtype=np.float32)
+            elif cfg.dm_file:
+                from ..search.pipeline import load_dm_file
+
+                dm_list = load_dm_file(cfg.dm_file)
+            else:
+                dm_list = generate_dm_list(
+                    cfg.dm_start, cfg.dm_end, hdr.tsamp,
+                    cfg.dm_pulse_width, hdr.fch1, hdr.foff, hdr.nchans,
+                    cfg.dm_tol,
+                )
+            md = max_delay(dm_list, delay_table(
+                hdr.nchans, hdr.tsamp, hdr.fch1, hdr.foff))
+            size = cfg.size or prev_power_of_two(hdr.nsamples)
+            eff = min(int(hdr.nsamples), int(size) + int(md) + 1)
+            ovr = tuple(sorted(
+                (k, repr(v)) for k, v in (job.overrides or {}).items()))
+            return (ovr, int(hdr.nchans), int(hdr.nbits),
+                    float(hdr.tsamp), float(hdr.fch1), float(hdr.foff),
+                    int(size), eff)
+        except Exception:
+            return None
+
+    def _claim_batch_mates(self, leader: JobRecord,
+                           room: int) -> list[JobRecord]:
+        """Claim up to ``room`` pending jobs sharing the leader's
+        batch key (bucket-fill: mates jump the priority queue — a full
+        batch beats strict queue order because the marginal cost of a
+        same-bucket beam is near zero)."""
+        key = self._batch_key(leader)
+        if key is None:
+            return []
+        mates: list[JobRecord] = []
+        for rec in self.spool.pending_jobs():
+            if len(mates) >= room:
+                break
+            if self._batch_key(rec) != key:
+                continue
+            got = self.spool.claim_job(
+                rec.job_id, self.worker_id, host=self.host_label)
+            if got is not None:  # lost races just shrink the batch
+                mates.append(got)
+        return mates
+
+    def _run_batch_jobs(self, jobs: list[JobRecord]) -> int:
+        """Run claimed same-bucket jobs through ONE batched dispatch;
+        returns the success count.  Failures stay per-job: a beam that
+        fails to read, search or ingest goes through the usual
+        classify/retry/quarantine path without touching its
+        batch-mates (their checkpoints are per-job files)."""
+        from ..cli import write_search_output
+        from ..io.sigproc import read_filterbank
+        from ..obs.events import configure_event_log
+
+        # phase A: per-job config + observation read; a beam failing
+        # HERE (e.g. truncated file -> typed InputFileError) peels off
+        # through _handle_failure before the dispatch
+        ready: list[tuple] = []
+        for job in jobs:
+            try:
+                cfg = self._job_config(job)
+                configure_event_log(
+                    os.path.join(self.spool.work_dir(job.job_id),
+                                 "events.jsonl"))
+                fil = (self._prefetcher.take(job.input)
+                       if self.prefetch else None)
+                if fil is None:
+                    with span("Observation-Read", metric="obs_read",
+                              input=job.input):
+                        fil = read_filterbank(job.input)
+                ready.append((job, cfg, fil))
+            except Exception as exc:
+                self._handle_failure(job, exc)
+        # phase B: build per-job searches (lossless trim + geometry
+        # accounting per job); the first survivor's search leads
+        js, cfgs, fils, searches = [], [], [], []
+        for job, cfg, fil in ready:
+            try:
+                fil2, search = self._build_search(fil, cfg)
+            except Exception as exc:
+                self._handle_failure(job, exc)
+                continue
+            js.append(job)
+            cfgs.append(cfg)
+            fils.append(fil2)
+            searches.append(search)
+        if not js:
+            return 0
+        leader = searches[0]
+        ok = 0
+        if len(js) > 1:
+            # defensive: the batch key should guarantee this; anything
+            # incompatible is peeled back out to the solo path
+            want = leader._batch_fields(fils[0])
+            solo = [i for i in range(1, len(js))
+                    if leader._batch_fields(fils[i]) != want]
+            for i in reversed(solo):
+                job_i = js.pop(i)
+                cfgs.pop(i)
+                fils.pop(i)
+                searches.pop(i)
+                if self.run_one(job_i):
+                    ok += 1
+        if len(js) == 1:
+            return ok + (1 if self.run_one(js[0]) else 0)
+        # overlap the NEXT wave's reads with this batch's device time
+        if self.prefetch:
+            for rec in self.spool.pending_jobs()[: self.batch]:
+                self._prefetcher.start(rec.input)
+        B = len(js)
+        try:
+            results = run_with_timeout(
+                lambda: leader.run_batch(fils, cfgs), self.timeout_s,
+                label=f"batch {js[0].job_id}+{B - 1}")
+        except Exception as exc:
+            # whole-dispatch failure (timeout, compile error): every
+            # beam classifies/retries individually
+            for job in js:
+                self._handle_failure(job, exc)
+            return ok
+        if getattr(leader, "last_dispatch_batched", False):
+            METRICS.inc("scheduler.batched_dispatches")
+            METRICS.inc("scheduler.batch_fill", B)
+        for job, cfg, result in zip(js, cfgs, results):
+            with span(f"Job-{job.job_id}", metric="job",
+                      job_id=job.job_id, input=job.input,
+                      attempt=job.attempts, priority=job.priority,
+                      batch=B):
+                if isinstance(result, BaseException):
+                    self._handle_failure(job, result)
+                    continue
+                try:
+                    write_search_output(result, cfg.outdir)
+                    ingested = self.store.ingest(
+                        job.job_id, job.input, result.candidates)
+                    best = max((float(c.snr)
+                                for c in result.candidates), default=0.0)
+                    summary = {
+                        "candidates": len(result.candidates),
+                        "ingested": ingested,
+                        "best_snr": round(best, 4),
+                        "outdir": cfg.outdir,
+                        "batch": B,
+                        "timers": {k: round(float(v), 3)
+                                   for k, v in result.timers.items()},
+                    }
+                except Exception as exc:
+                    self._handle_failure(job, exc)
+                    continue
+            self.spool.mark_done(job, summary)
+            METRICS.inc("scheduler.succeeded")
+            ok += 1
+        return ok
 
     # -- one job -----------------------------------------------------------
 
@@ -302,7 +490,8 @@ class SurveyWorker:
         runner = self.run_job_fn or self._run_job
         with span(f"Job-{job.job_id}", metric="job",
                   job_id=job.job_id, input=job.input,
-                  attempt=job.attempts, priority=job.priority):
+                  attempt=job.attempts, priority=job.priority,
+                  batch=1):
             try:
                 summary = run_with_timeout(
                     lambda: runner(job), self.timeout_s,
@@ -335,8 +524,17 @@ class SurveyWorker:
                 self._idle_poll()
                 pause(poll_s, self.sleeper)
                 continue
-            claimed += 1
-            if self.run_one(job):
+            mates: list = []
+            if self.batch > 1 and self.run_job_fn is None:
+                room = self.batch - 1
+                if max_jobs is not None:
+                    room = min(room, max_jobs - claimed - 1)
+                if room > 0:
+                    mates = self._claim_batch_mates(job, room)
+            claimed += 1 + len(mates)
+            if mates:
+                succeeded += self._run_batch_jobs([job] + mates)
+            elif self.run_one(job):
                 succeeded += 1
         elapsed = time.time() - t0
         jobs_per_hour = (succeeded / (elapsed / 3600.0)
@@ -349,6 +547,7 @@ class SurveyWorker:
             "elapsed_s": round(elapsed, 3),
             "jobs_per_hour": round(jobs_per_hour, 3),
             "geometry_buckets": len(self.geometries),
+            "batch": self.batch,
         }
         self._append_throughput(summary)
         return summary
@@ -372,6 +571,7 @@ class SurveyWorker:
         )
 
         snap = METRICS.snapshot()
+        counters = snap.get("counters", {})
         rec = make_history_record(
             "serve",
             {
@@ -380,6 +580,15 @@ class SurveyWorker:
                 "jobs_failed": summary["failed"],
                 "elapsed_s": summary["elapsed_s"],
                 "jobs_per_hour": summary["jobs_per_hour"],
+                # batched dispatch (ISSUE 9): configured stack depth
+                # plus how well the dispatches actually filled — the
+                # perf gate watches the jobs_per_hour multiplier
+                # between batch=1 and batch=B records
+                "batch": self.batch,
+                "batched_dispatches": int(
+                    counters.get("scheduler.batched_dispatches", 0)),
+                "batch_fill": int(
+                    counters.get("scheduler.batch_fill", 0)),
             },
             stage_device_s=stage_device_seconds(snap),
             config={

@@ -403,3 +403,109 @@ def test_distill_rows_batch_matches_per_row(seed, tutorial_fil):
             assert a.freq == b.freq and a.snr == b.snr
             assert a.acc == b.acc and a.nh == b.nh
             assert a.count_assoc() == b.count_assoc()
+
+
+# --------------------------------------------------------------------------
+# batched multi-observation dispatch (ISSUE 9)
+# --------------------------------------------------------------------------
+
+
+def _batch_fil(path, seed=0, nchans=16, nsamps=4096):
+    """Small synthetic 8-bit observation (same recipe as test_serve)."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    write_filterbank(str(path), Filterbank(header=hdr, data=data))
+    return read_filterbank(str(path))
+
+
+def _batch_cfg(**kw):
+    return SearchConfig(dm_start=0.0, dm_end=20.0, acc_start=-5.0,
+                        acc_end=5.0, acc_pulse_width=64000.0, npdmp=0,
+                        limit=10, min_snr=6.0, **kw)
+
+
+def _cand_tuples(result):
+    return [(float(c.freq), float(c.snr), float(c.dm), float(c.acc),
+             int(c.nh), float(c.folded_snr))
+            for c in result.candidates]
+
+
+class TestBatchedDispatch:
+    def test_run_batch_bit_identical_per_beam(self, tmp_path):
+        """One B=3 batched dispatch returns exactly the candidates of
+        three sequential B=1 runs, beam for beam (the unrolled batch
+        body keeps per-beam HLO identical, so equality is EXACT)."""
+        from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+
+        fils = [_batch_fil(tmp_path / f"b{i}.fil", seed=i)
+                for i in range(3)]
+        cfg = _batch_cfg()
+        want = [_cand_tuples(MeshPulsarSearch(f, cfg).run())
+                for f in fils]
+
+        leader = MeshPulsarSearch(fils[0], cfg)
+        results = leader.run_batch(fils)
+        assert leader.last_dispatch_batched
+        assert [_cand_tuples(r) for r in results] == want
+
+    def test_run_batch_rejects_mismatched_geometry(self, tmp_path):
+        """Beams that cannot share one compiled program (different
+        nchans here) must be refused up front, not mis-searched."""
+        from peasoup_tpu.errors import ConfigError
+        from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+
+        a = _batch_fil(tmp_path / "a.fil", seed=0)
+        b = _batch_fil(tmp_path / "b.fil", seed=1, nchans=32)
+        with pytest.raises(ConfigError, match="batch"):
+            MeshPulsarSearch(a, _batch_cfg()).run_batch([a, b])
+
+    def test_tuning_hints_batch_invariant(self, tmp_path):
+        """The tune sidecar must record the same high-water marks — and
+        therefore pick the same extraction path — whether an
+        observation ran solo or inside a batch: extraction cells are
+        per-spectrum/per-beam quantities, so the key stays B-free and
+        records stay comparable across batch widths."""
+        from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+        from peasoup_tpu.search.tuning import load_tuning
+
+        src = tmp_path / "obs.fil"
+        fil = _batch_fil(src, seed=3)
+        t_seq = str(tmp_path / "seq.tune.json")
+        t_bat = str(tmp_path / "bat.tune.json")
+
+        cfg_seq = _batch_cfg(infilename=str(src), tune_file=t_seq)
+        s_seq = MeshPulsarSearch(fil, cfg_seq)
+        s_seq.run()
+        key = s_seq._tune_scoped_key("fused")
+
+        # same observation as every beam: max-over-beams == solo marks
+        cfg_bat = _batch_cfg(infilename=str(src), tune_file=t_bat)
+        beams = [fil,
+                 _batch_fil(tmp_path / "copy1.fil", seed=3),
+                 _batch_fil(tmp_path / "copy2.fil", seed=3)]
+        leader = MeshPulsarSearch(beams[0], cfg_bat)
+        leader.run_batch(beams)
+        assert leader.last_dispatch_batched
+        assert leader._tune_scoped_key("fused") == key  # key is B-free
+
+        seq_rec = load_tuning(t_seq, key)
+        bat_rec = load_tuning(t_bat, key)
+        assert seq_rec is not None and bat_rec is not None
+        assert seq_rec["cap_hw"] == bat_rec["cap_hw"]
+        assert seq_rec["ck_hw"] == bat_rec["ck_hw"]
+
+        # identical hints -> identical picked extraction path on the
+        # next run, independent of the batch width that recorded them
+        cap = max(64, seq_rec["cap_hw"])
+        again_seq = MeshPulsarSearch(fil, cfg_seq)
+        again_bat = MeshPulsarSearch(fil, cfg_bat)
+        assert (again_seq.peaks_methods_for(cap)
+                == again_bat.peaks_methods_for(cap))

@@ -465,3 +465,112 @@ def test_geometry_bucketing_is_lossless(tmp_path):
     want = [(round(float(c.freq), 6), round(float(c.snr), 3))
             for c in full.candidates]
     assert got == want
+
+
+# --------------------------------------------------------------------------
+# batched multi-observation dispatch (ISSUE 9)
+# --------------------------------------------------------------------------
+
+
+def _drain_spool(tmp_path, name, obs, batch):
+    """Spool ``obs`` and drain with a ``batch``-wide mesh worker;
+    returns (spool, drain summary)."""
+    spool = JobSpool(str(tmp_path / name))
+    for path in obs:
+        spool.submit(path, FAST)
+    worker = SurveyWorker(
+        spool, batch=batch, sleeper=lambda s: None,
+        history_path=str(tmp_path / f"{name}.jsonl"))
+    return spool, worker.drain()
+
+
+def _per_source_outputs(spool, sources):
+    """{source: (store tuples, candidates.peasoup bytes)} — the
+    bit-identity fingerprint of a drained spool."""
+    store = CandidateStore(os.path.join(spool.root, "candidates.jsonl"))
+    by_input = {rec.input: rec for rec in spool.jobs("done")}
+    out = {}
+    for src in sources:
+        cands = sorted(
+            (r["dm"], r["acc"], r["freq"], r["snr"], r["folded_snr"],
+             r["nh"])
+            for r in store.records(source=src)
+        )
+        binary = open(os.path.join(
+            by_input[src].summary["outdir"], "candidates.peasoup"),
+            "rb").read()
+        out[os.path.basename(src)] = (cands, binary)
+    return out
+
+
+def test_batched_drain_bit_identical_to_sequential(tmp_path):
+    """Three same-geometry observations drained as ONE batched dispatch
+    must produce byte-for-byte the candidates of three sequential
+    dispatches: store records AND candidates.peasoup binaries."""
+    obs = [_write_fil(tmp_path / f"obs{i}.fil", seed=i)
+           for i in range(3)]
+
+    seq_spool, seq_sum = _drain_spool(tmp_path, "seq", obs, batch=1)
+    assert seq_sum["succeeded"] == 3
+    seq_counters = REGISTRY.snapshot()["counters"]
+    assert seq_counters.get("scheduler.batched_dispatches", 0) == 0
+    seq_dispatches = seq_counters["runs.mesh_fused"]
+
+    REGISTRY.reset()
+    bat_spool, bat_sum = _drain_spool(tmp_path, "bat", obs, batch=3)
+    assert bat_sum["succeeded"] == 3 and bat_sum["batch"] == 3
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.batched_dispatches"] == 1
+    assert counters["scheduler.batch_fill"] == 3
+    # the point of batching: fewer fused device dispatches
+    assert counters["runs.mesh_fused"] < seq_dispatches
+    for rec in bat_spool.jobs("done"):
+        assert rec.summary["batch"] == 3
+
+    assert (_per_source_outputs(bat_spool, obs)
+            == _per_source_outputs(seq_spool, obs))
+
+
+def test_batched_drain_quarantines_failing_beam(tmp_path):
+    """A truncated observation claimed into a batch must quarantine via
+    the typed-failure path WITHOUT poisoning its batch-mates: the good
+    beams complete with candidates, the bad one carries the
+    InputFileError byte counts, and no checkpoint files leak."""
+    good = [_write_fil(tmp_path / f"obs{i}.fil", seed=i)
+            for i in range(2)]
+    bad = _write_truncated_fil(tmp_path / "obs_bad.fil", seed=9)
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    # submit the bad beam between the good ones: batch-mate claiming
+    # must not depend on queue position
+    for path in (good[0], bad, good[1]):
+        spool.submit(path, FAST)
+    worker = SurveyWorker(
+        spool, batch=3, sleeper=lambda s: None,
+        backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+        history_path=str(tmp_path / "h.jsonl"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        summary = worker.drain()
+
+    assert summary["succeeded"] == 2 and summary["failed"] == 1
+    counts = spool.counts()
+    assert counts["done"] == 2 and counts["failed"] == 1
+    counters = REGISTRY.snapshot()["counters"]
+    # the two surviving beams still went out as ONE batched dispatch
+    assert counters["scheduler.batched_dispatches"] == 1
+    assert counters["scheduler.batch_fill"] == 2
+    assert counters["scheduler.quarantined"] == 1
+
+    failed = spool.jobs("failed")[0]
+    assert failed.input == bad
+    assert failed.failures[0]["classification"] == QUARANTINE
+    assert "truncated filterbank" in failed.failures[0]["error"]
+    assert failed.attempts == 1  # quarantine is immediate
+
+    store = CandidateStore(os.path.join(spool.root, "candidates.jsonl"))
+    assert set(store.sources()) == set(good)
+    for rec in spool.jobs("done"):
+        assert rec.summary["candidates"] >= 1
+        # per-beam checkpoints were consumed on success, not leaked
+        assert not os.path.exists(
+            os.path.join(spool.work_dir(rec.job_id), "search.ckpt"))
