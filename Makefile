@@ -7,8 +7,9 @@ SMOKE_DIR ?= /tmp/peasoup-trace-smoke
 SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
 FLEET_SMOKE_DIR ?= /tmp/peasoup-fleet-smoke
 BATCH_SMOKE_DIR ?= /tmp/peasoup-batch-smoke
+HEALTH_SMOKE_DIR ?= /tmp/peasoup-health-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -80,3 +81,14 @@ fleet-smoke:
 batch-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.batch_smoke \
 	    --dir $(BATCH_SMOKE_DIR)
+
+# telemetry/health-plane smoke test: two real fleet-worker processes
+# drain with fast telemetry — both hosts must leave ts- shards whose
+# samples carry queue depths, `health` must exit 0, and the sampler's
+# self-measured overhead must stay <1% of the drain wall-clock; then a
+# worker is SIGKILLed mid-job and `health` must exit nonzero with a
+# crit stale_host finding until `requeue --expired` + a re-drain bring
+# the fleet back to ok
+health-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.health_smoke \
+	    --dir $(HEALTH_SMOKE_DIR)

@@ -193,6 +193,7 @@ def run_batch_bench(b: int) -> int:
                 sleeper=lambda s: None,
             ).drain()
             counters = REGISTRY.snapshot()["counters"]
+            telem = summary.get("telemetry") or {}
             modes[label] = {
                 "jobs_per_hour": summary["jobs_per_hour"],
                 "elapsed_s": summary["elapsed_s"],
@@ -200,6 +201,10 @@ def run_batch_bench(b: int) -> int:
                 "batched_dispatches": counters.get(
                     "scheduler.batched_dispatches", 0),
                 "batch_fill": counters.get("scheduler.batch_fill", 0),
+                "telemetry_overhead_s": telem.get("overhead_s", 0.0),
+                "telemetry_overhead_frac": round(
+                    telem.get("overhead_s", 0.0)
+                    / max(summary["elapsed_s"], 1e-9), 6),
             }
             if summary["succeeded"] != b:
                 print(json.dumps({

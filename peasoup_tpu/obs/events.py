@@ -23,6 +23,16 @@ Line schema (one JSON object per line)::
 capacities, paths) so a service can alert on them without parsing
 message strings.  A repo lint test asserts no bare ``warnings.warn``
 remains under ``peasoup_tpu/search/`` or ``peasoup_tpu/parallel/``.
+
+Flood suppression: a wedged worker re-raising the same warning in a
+tight loop must not grow the event log unboundedly.  Per event kind,
+at most :data:`FLOOD_LIMIT` lines are persisted per
+:data:`FLOOD_WINDOW_S`-second window; further repeats are *counted*
+but not written, and when the window rolls over one ``event_flood``
+summary line records how many were collapsed (``data.kind``,
+``data.suppressed``).  Counters (``events.<kind>``), the in-memory
+summary and the raised Python warnings are NEVER suppressed — only
+the on-disk line volume is bounded.
 """
 
 from __future__ import annotations
@@ -36,6 +46,13 @@ import warnings
 from .metrics import REGISTRY
 
 SCHEMA_VERSION = 1
+
+#: per-kind persisted-line budget per flood window (events beyond it
+#: are counted, collapsed into one ``event_flood`` summary line)
+FLOOD_LIMIT = 20
+
+#: flood-window length in seconds
+FLOOD_WINDOW_S = 60.0
 
 
 def _json_safe(value):
@@ -66,28 +83,75 @@ class EventLog:
     a multi-hour search).
     """
 
-    def __init__(self, path: str = "", registry=None):
+    def __init__(self, path: str = "", registry=None, *,
+                 flood_limit: int = FLOOD_LIMIT,
+                 flood_window_s: float = FLOOD_WINDOW_S,
+                 clock=time.time):
         self.path = path or ""
         self._registry = registry if registry is not None else REGISTRY
         self._lock = threading.Lock()
         self._file = None
         self._counts: dict[str, int] = {}
         self._io_failed = False
+        self.flood_limit = max(1, int(flood_limit))
+        self.flood_window_s = float(flood_window_s)
+        self._clock = clock
+        # kind -> {"start": window open time, "written": lines
+        # persisted this window, "suppressed": lines collapsed}
+        self._flood: dict[str, dict] = {}
+
+    def _flood_admit(self, kind: str, now: float) -> tuple[bool, dict | None]:
+        """(persist this line?, flood-summary record to write first).
+
+        Per-kind sliding window: the first ``flood_limit`` lines of a
+        window persist; later repeats are counted.  A window rollover
+        with suppressions pending emits ONE ``event_flood`` summary
+        (kind/suppressed/window) so the log states what was dropped.
+        Caller holds the lock.
+        """
+        st = self._flood.setdefault(
+            kind, {"start": now, "written": 0, "suppressed": 0})
+        summary = None
+        if now - st["start"] >= self.flood_window_s:
+            if st["suppressed"]:
+                summary = self._flood_summary(kind, st, now)
+            st["start"] = now
+            st["written"] = 0
+            st["suppressed"] = 0
+        if st["written"] < self.flood_limit:
+            st["written"] += 1
+            return True, summary
+        st["suppressed"] += 1
+        self._registry.inc("events.flood_suppressed")
+        return False, summary
+
+    def _flood_summary(self, kind: str, st: dict, now: float) -> dict:
+        return {
+            "v": SCHEMA_VERSION,
+            "ts": round(now, 6),
+            "kind": "event_flood",
+            "message": (f"collapsed {st['suppressed']} repeated "
+                        f"{kind!r} event(s) in "
+                        f"{self.flood_window_s:.0f}s window"),
+            "data": {"kind": kind, "suppressed": st["suppressed"],
+                     "window_s": self.flood_window_s},
+        }
 
     def emit(self, kind: str, message: str = "", **fields) -> dict:
         """Record one typed event; returns the record written."""
         kind = str(kind)
+        now = self._clock()
         rec = {
             "v": SCHEMA_VERSION,
-            "ts": round(time.time(), 6),
+            "ts": round(now, 6),
             "kind": kind,
             "message": str(message),
         }
         if fields:
             rec["data"] = {k: _json_safe(v) for k, v in fields.items()}
-        line = json.dumps(rec)
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
+            persist, summary = self._flood_admit(kind, now)
             if self.path and not self._io_failed:
                 try:
                     if self._file is None:
@@ -95,7 +159,10 @@ class EventLog:
                         if d:
                             os.makedirs(d, exist_ok=True)
                         self._file = open(self.path, "a", buffering=1)
-                    self._file.write(line + "\n")
+                    if summary is not None:
+                        self._file.write(json.dumps(summary) + "\n")
+                    if persist:
+                        self._file.write(json.dumps(rec) + "\n")
                 except OSError as exc:
                     self._io_failed = True
                     warnings.warn(
@@ -111,7 +178,18 @@ class EventLog:
         with self._lock:
             if self._file is not None:
                 try:
+                    # flush pending flood summaries so a bounded log
+                    # still states exactly what it dropped
+                    now = self._clock()
+                    for kind, st in self._flood.items():
+                        if st["suppressed"]:
+                            self._file.write(json.dumps(
+                                self._flood_summary(kind, st, now))
+                                + "\n")
+                            st["suppressed"] = 0
                     self._file.close()
+                except OSError:
+                    pass
                 finally:
                     self._file = None
 

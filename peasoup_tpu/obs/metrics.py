@@ -58,6 +58,28 @@ class _TimerHandle:
         self.device_s += float(seconds)
 
 
+class MetricsCursor:
+    """Opaque position marker for delta snapshots.
+
+    One cursor per consumer: passing it to
+    :meth:`MetricsRegistry.snapshot` returns the counter/timer
+    *increments* since this cursor's previous snapshot (and advances
+    the cursor), so periodic samplers (obs/telemetry.py) report
+    per-interval rates instead of process-lifetime totals.  The cursor
+    is advanced under the registry lock, so concurrent increments are
+    never lost or double-counted across consecutive delta snapshots —
+    every increment lands in exactly one delta.  A registry
+    :meth:`~MetricsRegistry.reset` rewinds totals below the cursor;
+    the next delta snapshot clamps at zero and re-bases.
+    """
+
+    __slots__ = ("_counters", "_timers")
+
+    def __init__(self):
+        self._counters: dict[str, int] = {}
+        self._timers: dict[str, dict] = {}
+
+
 class MetricsRegistry:
     """Counters + gauges + host/device stage timers behind one lock."""
 
@@ -109,15 +131,45 @@ class MetricsRegistry:
 
     # -- snapshot / reset --------------------------------------------------
 
-    def snapshot(self) -> dict:
+    def snapshot(self, cursor: MetricsCursor | None = None) -> dict:
         """Deep-copied point-in-time view: ``{"counters", "gauges",
-        "timers"}``."""
+        "timers"}``.
+
+        With a :class:`MetricsCursor`, the snapshot additionally
+        carries ``"deltas"``: counter increments and timer
+        (count/host_s/device_s) increments since the cursor's previous
+        snapshot.  Both the view and the cursor advance under the one
+        registry lock, so the sum of a cursor's deltas always equals
+        the totals — no increment is lost to or duplicated across a
+        sampling boundary.  Gauges are last-value by definition and
+        have no delta.
+        """
         with self._lock:
-            return {
+            snap = {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
                 "timers": {k: dict(v) for k, v in self._timers.items()},
             }
+            if cursor is not None:
+                dc = {}
+                for name, val in self._counters.items():
+                    inc = val - cursor._counters.get(name, 0)
+                    if inc > 0:
+                        dc[name] = inc
+                dt = {}
+                for name, rec in self._timers.items():
+                    last = cursor._timers.get(name, {})
+                    inc = {
+                        f: rec[f] - last.get(f, 0)
+                        for f in ("count", "host_s", "device_s")
+                    }
+                    if any(v > 0 for v in inc.values()):
+                        dt[name] = inc
+                cursor._counters = dict(self._counters)
+                cursor._timers = {k: dict(v)
+                                  for k, v in self._timers.items()}
+                snap["deltas"] = {"counters": dc, "timers": dt}
+            return snap
 
     def reset(self) -> None:
         with self._lock:

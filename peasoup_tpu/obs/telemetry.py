@@ -1,0 +1,278 @@
+"""Live per-host telemetry time-series for the survey fleet.
+
+The service layer's observability so far is post-hoc and per-run:
+``run_report.json``, span traces, one ``fleet/<host>.json`` status
+snapshot per drain.  This module is the continuous complement — the
+always-on, low-overhead sampling layer (Dapper-style) that the health
+plane (``serve/health.py``) and ``status --watch`` read from:
+
+* :class:`TelemetrySampler` — a daemon thread (``Event.wait`` cadence,
+  PSL008-clean, same shape as the spool's ``LeaseHeartbeat``) that a
+  worker runs for the duration of a drain.  Every tick it appends one
+  schema-versioned JSON line to a **per-host single-writer shard**
+  ``fleet/ts-<host>.jsonl``: counter/timer *deltas* since the previous
+  tick (via :class:`~.metrics.MetricsCursor`, so samples are
+  per-interval rates, not process-lifetime totals), current gauges
+  (HBM high-water, ``scheduler.jobs_per_hour``, batch fill), plus
+  whatever the owner injects through ``extras`` (queue depths from the
+  spool — the sampler itself never imports ``serve/``, keeping the
+  obs→serve layering one-way).
+* a merged, torn-tail-tolerant reader: :func:`read_samples` /
+  :func:`latest_by_host` merge every host's shard (plus its rotated
+  ``.1`` generation), skip corrupt/torn lines, and sort by sample
+  timestamp so cross-host clock skew degrades ordering gracefully
+  instead of crashing the health evaluation.
+
+Sample line schema (one JSON object per line)::
+
+    {"v": 1, "ts": <unix s>, "host": "<label>", "pid": <int>,
+     "seq": <per-process monotonic>, "interval_s": <cadence>,
+     "counters": {<name>: <delta>}, "timers": {<name>:
+         {"count": <d>, "host_s": <d>, "device_s": <d>}},
+     "gauges": {<name>: <value>}, "overhead_s": <cumulative sampler
+     cost>, ...extras (e.g. "queue": {...})}
+
+Shard rotation is bounded: when the live shard exceeds
+``max_shard_bytes`` it is renamed to ``ts-<host>.jsonl.1`` (replacing
+the previous generation), so a long-lived host holds at most two
+generations on disk.  The reader merges both.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+
+from .metrics import REGISTRY, MetricsCursor
+
+#: sample-line schema version
+TS_SCHEMA_VERSION = 1
+
+#: default sampling cadence (seconds)
+DEFAULT_INTERVAL_S = 5.0
+
+#: rotate the live shard past this size; one old generation is kept
+DEFAULT_MAX_SHARD_BYTES = 4 * 1024 * 1024
+
+_SHARD_RE = re.compile(r"^ts-(?P<host>[A-Za-z0-9_.-]+)\.jsonl$")
+
+
+def safe_host(label: str) -> str:
+    """Sanitise a host label for use in a shard filename."""
+    cleaned = re.sub(r"[^A-Za-z0-9_.-]+", "_", str(label).strip())
+    return cleaned or "host"
+
+
+def shard_path(ts_dir: str, host: str) -> str:
+    """The single-writer time-series shard for ``host`` under
+    ``ts_dir`` (normally the spool's ``fleet/`` directory)."""
+    return os.path.join(ts_dir, f"ts-{safe_host(host)}.jsonl")
+
+
+class TelemetrySampler:
+    """Appends one telemetry sample per interval to a per-host shard.
+
+    Single-writer by construction: each host writes only its own
+    ``ts-<host>.jsonl``, so no cross-host locking exists anywhere in
+    the plane.  ``start()`` emits an immediate first sample and
+    ``stop()`` a final one, so even a drain shorter than one interval
+    leaves a usable time-series behind.
+
+    ``extras`` is an optional zero-arg callable returning a dict merged
+    into every sample (the worker passes queue depths; the sampler
+    deliberately knows nothing about spools).  An ``extras`` failure is
+    recorded in the sample (``"extras_error"``) rather than raised —
+    telemetry must never kill a drain.
+
+    The cumulative cost of sampling itself is tracked in
+    ``overhead_s`` and written into every sample, so "is the sampler
+    cheap enough" is answerable from the data it produces.
+    """
+
+    def __init__(self, path: str, host: str,
+                 interval_s: float = DEFAULT_INTERVAL_S, *,
+                 registry=None, extras=None,
+                 max_shard_bytes: int = DEFAULT_MAX_SHARD_BYTES,
+                 clock=time.time):
+        self.path = str(path)
+        self.host = safe_host(host)
+        self.interval_s = max(0.05, float(interval_s))
+        self._registry = registry if registry is not None else REGISTRY
+        self._extras = extras
+        self.max_shard_bytes = int(max_shard_bytes)
+        self._clock = clock
+        self._cursor = MetricsCursor()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._io_failed = False
+        self.samples_written = 0
+        self.overhead_s = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "TelemetrySampler":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self.sample_now()
+        self._thread = threading.Thread(
+            target=self._run, name=f"telemetry-{self.host}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.sample_now()
+
+    def __enter__(self) -> "TelemetrySampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_now()
+
+    # -- sampling ----------------------------------------------------------
+
+    def sample_now(self) -> dict:
+        """Compose and append one sample; returns the record."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._seq += 1
+            snap = self._registry.snapshot(self._cursor)
+            deltas = snap.get("deltas", {"counters": {}, "timers": {}})
+            rec = {
+                "v": TS_SCHEMA_VERSION,
+                "ts": round(self._clock(), 6),
+                "host": self.host,
+                "pid": os.getpid(),
+                "seq": self._seq,
+                "interval_s": self.interval_s,
+                "counters": deltas.get("counters", {}),
+                "timers": deltas.get("timers", {}),
+                "gauges": snap.get("gauges", {}),
+            }
+            if self._extras is not None:
+                try:
+                    ext = self._extras()
+                    if isinstance(ext, dict):
+                        for k, v in ext.items():
+                            rec.setdefault(str(k), v)
+                except Exception as exc:
+                    rec["extras_error"] = repr(exc)
+            rec["overhead_s"] = round(
+                self.overhead_s + (time.perf_counter() - t0), 6)
+            self._append(rec)
+            self.overhead_s += time.perf_counter() - t0
+        return rec
+
+    def _append(self, rec: dict) -> None:
+        if self._io_failed:
+            return
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._maybe_rotate()
+            with open(self.path, "a", buffering=1) as fh:
+                fh.write(json.dumps(rec) + "\n")
+            self.samples_written += 1
+        except OSError:
+            # disk trouble must not kill the drain; one-way latch so a
+            # wedged filesystem costs one syscall per tick at most
+            self._io_failed = True
+
+    def _maybe_rotate(self) -> None:
+        try:
+            if os.path.getsize(self.path) >= self.max_shard_bytes:
+                os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+
+
+# -- merged reader ---------------------------------------------------------
+
+
+def _read_shard_lines(path: str) -> list[dict]:
+    """Parse one shard, skipping torn/corrupt lines (a sampler killed
+    mid-write leaves a torn tail; that must never poison the merge)."""
+    out: list[dict] = []
+    try:
+        with open(path, "r", errors="replace") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if isinstance(rec, dict) and "ts" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def shard_hosts(ts_dir: str) -> list[str]:
+    """Host labels that have a time-series shard under ``ts_dir``."""
+    hosts = set()
+    try:
+        names = os.listdir(ts_dir)
+    except OSError:
+        return []
+    for name in names:
+        base = name[:-2] if name.endswith(".1") else name
+        m = _SHARD_RE.match(base)
+        if m:
+            hosts.add(m.group("host"))
+    return sorted(hosts)
+
+
+def read_samples(ts_dir: str, hosts=None, since: float | None = None
+                 ) -> list[dict]:
+    """Merge every host's shard (rotated ``.1`` generation first, then
+    live) into one list sorted by sample timestamp.
+
+    Cross-host clock skew means the merged order is only as good as
+    the hosts' clocks — the sort is stable and per-host order is
+    preserved by ``seq``, so downstream trend rules should group by
+    ``host`` before differencing.  ``since`` drops samples older than
+    the given unix timestamp after the merge.
+    """
+    wanted = None if hosts is None else {safe_host(h) for h in hosts}
+    merged: list[dict] = []
+    for host in shard_hosts(ts_dir):
+        if wanted is not None and host not in wanted:
+            continue
+        live = shard_path(ts_dir, host)
+        for path in (live + ".1", live):
+            for rec in _read_shard_lines(path):
+                rec.setdefault("host", host)
+                merged.append(rec)
+    if since is not None:
+        merged = [r for r in merged if r.get("ts", 0) >= since]
+    merged.sort(key=lambda r: r.get("ts", 0))
+    return merged
+
+
+def latest_by_host(ts_dir: str) -> dict[str, dict]:
+    """Most recent sample per host (by that host's own clock)."""
+    out: dict[str, dict] = {}
+    for rec in read_samples(ts_dir):
+        host = rec.get("host", "")
+        prev = out.get(host)
+        if prev is None or rec.get("ts", 0) >= prev.get("ts", 0):
+            out[host] = rec
+    return out

@@ -1501,7 +1501,10 @@ class MeshPulsarSearch(PulsarSearch):
         tune = (load_tuning(cfg.tune_file, self._tune_scoped_key("chunked"))
                 if cfg.tune_file else None)
         if tune is not None:
-            from ..search.tuning import pick_row_capacity
+            from ..search.tuning import (
+                calibration_constants,
+                pick_row_capacity,
+            )
 
             # bound the capacity so the stacked per-chunk peak buffers
             # (dm_chunk x namax x nlevels x cap, idx+snr) stay <= 1 GB
@@ -1511,10 +1514,17 @@ class MeshPulsarSearch(PulsarSearch):
                 # per-row counts known: cover the BULK of rows and
                 # leave pathological ones to the cheap re-search (a
                 # 13k-count pulsar row must not make every spectrum's
-                # top_k 13x bigger — measured +330 s at full scale)
+                # top_k 13x bigger — measured +330 s at full scale);
+                # cost constants are this device's measured calibration
+                # when the sidecar has one, v5e defaults otherwise
                 n_tr = sum(len(a) for a in acc_lists)
+                cal = calibration_constants(cfg.tune_file)
                 cap = round_up(
-                    pick_row_capacity(tune["row_hw"], n_tr),
+                    pick_row_capacity(
+                        tune["row_hw"], n_tr,
+                        slot_s=cal["slot_s"],
+                        research_s=cal["research_s"],
+                        compile_s=cal["compile_s"]),
                     64, 64, cap_ceil)
             else:
                 cap = round_up(tune["cap_hw"] + 32, 64, 64, cap_ceil)
@@ -1823,6 +1833,12 @@ class MeshPulsarSearch(PulsarSearch):
             # would understate them)
             save_tuning(cfg.tune_file, self._tune_scoped_key("chunked"),
                         hw_count, hw_valid, row_hw=row_hw)
+            from ..search.tuning import record_run_calibration
+
+            record_run_calibration(
+                cfg.tune_file,
+                research_s=(phases["research"] / len(all_clipped)
+                            if all_clipped else None))
         # dedispersion is fused into the chunk dispatches; when stage
         # measurement is on, time one real dedisp-only dispatch and
         # scale by the number of chunks executed
@@ -2271,6 +2287,9 @@ class MeshPulsarSearch(PulsarSearch):
                 cfg.tune_file, self._tune_scoped_key("fused"), mx_count,
                 int(counts_arr.reshape(self.ndev, -1).sum(axis=1).max()),
             )
+            from ..search.tuning import record_run_calibration
+
+            record_run_calibration(cfg.tune_file)
         timers["dedispersion"] = 0.0  # fused into the search program
         if cfg.measure_stages:
             timers["dedispersion"] = self.measure_dedispersion_stage()
@@ -2528,6 +2547,9 @@ class MeshPulsarSearch(PulsarSearch):
                 save_tuning(cfg.tune_file,
                             self._tune_scoped_key("fused"),
                             mx_count, hw_valid)
+                from ..search.tuning import record_run_calibration
+
+                record_run_calibration(cfg.tune_file)
         timers["dedispersion"] = 0.0  # fused into the search program
         timers["searching_device"] = time.time() - t0
         # ONE segmented distill across every live beam: (beam, dm) keys

@@ -42,6 +42,25 @@ the legacy size heuristic.  The section survives ``save_tuning``
 rewrites and search-key mismatches: extraction costs are a property
 of the device, not of one search.
 
+Runtime cost calibration (ROADMAP item 5, first half)
+-----------------------------------------------------
+
+:func:`pick_row_capacity`'s cost model shipped with v5e-measured
+constants (1.9e-6 s/slot/trial extraction, 2 s re-search, 20 s
+compile), so capacity picks silently regressed on other TPU
+generations.  The sidecar now carries a third search-key-INDEPENDENT
+section, ``"calibration"``: per device kind, the *measured* per-slot
+extraction cost (derived from this device's measured extraction cells
+— cell cost / capacity for the method the run actually picked), the
+measured re-search cost per clipped row, and the measured mean XLA
+compile seconds (the ``jit_compile`` stage timer fed by
+``obs.metrics.install_compile_hook``).  Each run merges its
+measurements in via an exponential moving average
+(:func:`record_run_calibration`, called where the drivers save their
+high-water marks), and :func:`calibration_constants` hands them back
+to ``pick_row_capacity`` — the hardcoded v5e constants remain the
+fallback for a fresh sidecar or an unknown device.
+
 Batch axis (ISSUE 9)
 --------------------
 
@@ -71,6 +90,15 @@ _TUNE_VERSION = 1
 
 #: the selectable peak-extraction lowerings (ops/peaks.py)
 EXTRACTION_METHODS = ("sort", "two_stage", "pallas")
+
+#: hardcoded v5e cost-model fallbacks (see ``pick_row_capacity``);
+#: overridden per device kind by the sidecar's measured calibration
+DEFAULT_SLOT_S = 1.9e-6     # s per capacity slot per accel trial
+DEFAULT_RESEARCH_S = 2.0    # s per re-searched clipped row
+DEFAULT_COMPILE_S = 20.0    # s per fresh XLA compile
+
+#: EWMA weight of the newest measurement when merging calibration
+_CAL_ALPHA = 0.5
 
 
 def load_tuning(path: str, key: str) -> dict | None:
@@ -116,11 +144,15 @@ def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
                "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}
         if row_hw is not None:
             obj["row_hw"] = [int(v) for v in row_hw]
-        # the extraction section is device-keyed, not search-keyed:
-        # carry it across rewrites (and across search-key changes)
+        # the extraction and calibration sections are device-keyed,
+        # not search-keyed: carry them across rewrites (and across
+        # search-key changes)
         extraction = load_extraction(path)
         if extraction:
             obj["extraction"] = extraction
+        calibration = load_calibration(path)
+        if calibration:
+            obj["calibration"] = calibration
         with open(tmp, "w") as f:
             json.dump(obj, f)
         os.replace(tmp, path)
@@ -133,7 +165,10 @@ def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
 
 
 def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,
-                      lo: int = 64, hi: int = 1 << 20) -> int:
+                      lo: int = 64, hi: int = 1 << 20, *,
+                      slot_s: float | None = None,
+                      research_s: float | None = None,
+                      compile_s: float | None = None) -> int:
     """Capacity minimising (modelled) run cost from per-row counts.
 
     Raising the per-spectrum capacity makes EVERY accel trial's top_k
@@ -143,16 +178,23 @@ def pick_row_capacity(row_hw, n_accel_trials: int, quantum: int = 64,
     host-path re-search (~2 s with the shared-capacity compile).  A
     single pathological row must therefore NOT set the global
     capacity; this picks argmin over the distinct candidate caps.
+
+    The three cost constants default to the v5e measurements above;
+    pass :func:`calibration_constants` values to use this device's
+    measured figures instead (self-calibrating tuner, ROADMAP item 5).
     """
     import numpy as np
 
     m = np.asarray(row_hw, np.int64)
-    slot_s = 1.9e-6 * max(n_accel_trials, 1)
+    per_slot = DEFAULT_SLOT_S if slot_s is None else float(slot_s)
+    re_s = DEFAULT_RESEARCH_S if research_s is None else float(research_s)
+    comp_s = DEFAULT_COMPILE_S if compile_s is None else float(compile_s)
+    slot_cost = per_slot * max(n_accel_trials, 1)
     best_c, best_cost = None, None
     cands = sorted({round_up(int(v) + 32, quantum, lo, hi) for v in m})
     for c in cands:
         n_re = int((m > c).sum())
-        cost = slot_s * c + 2.0 * n_re + (20.0 if n_re else 0.0)
+        cost = slot_cost * c + re_s * n_re + (comp_s if n_re else 0.0)
         if best_cost is None or cost < best_cost:
             best_c, best_cost = c, cost
     return int(min(hi, max(lo, best_c if best_c is not None else lo)))
@@ -334,6 +376,155 @@ def update_extraction(path: str, device_kind: str, stop_idx: int,
             f"could not update extraction sidecar {path!r}: {exc}",
             path=path, op="update_extraction", error=str(exc),
         )
+
+
+# --------------------------------------------------------------------------
+# runtime cost calibration (ROADMAP item 5; see module docstring)
+# --------------------------------------------------------------------------
+
+def load_calibration(path: str) -> dict:
+    """The sidecar's ``"calibration"`` section ({} when absent or
+    unreadable) — like ``"extraction"``, it ignores the
+    search-key/version gate: cost constants belong to the device."""
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except Exception:
+        return {}
+    sec = obj.get("calibration")
+    return sec if isinstance(sec, dict) else {}
+
+
+def update_calibration(path: str, device_kind: str, *,
+                       slot_s: float | None = None,
+                       research_s: float | None = None,
+                       compile_s: float | None = None) -> None:
+    """Merge one run's measured cost constants for ``device_kind``
+    into the sidecar (read-modify-write, atomic, every other key
+    preserved).  Measurements blend via an exponential moving average
+    (newest weighted :data:`_CAL_ALPHA`) so one outlier run — a cold
+    compile cache, a congested host — cannot swing the model; ``n``
+    counts the merged runs."""
+    if not path or (slot_s is None and research_s is None
+                    and compile_s is None):
+        return
+    try:
+        obj = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    obj = json.load(f)
+            except Exception:
+                obj = {}
+        if not isinstance(obj, dict):
+            obj = {}
+        sec = obj.setdefault("calibration", {})
+        cell = sec.setdefault(str(device_kind), {})
+        for name, val in (("slot_s", slot_s),
+                          ("research_s", research_s),
+                          ("compile_s", compile_s)):
+            if val is None or not val > 0:
+                continue
+            old = cell.get(name)
+            if isinstance(old, (int, float)) and old > 0:
+                cell[name] = (1 - _CAL_ALPHA) * float(old) \
+                    + _CAL_ALPHA * float(val)
+            else:
+                cell[name] = float(val)
+        cell["n"] = int(cell.get("n", 0)) + 1
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        warn_event(
+            "tune_io_error",
+            f"could not update calibration sidecar {path!r}: {exc}",
+            path=path, op="update_calibration", error=str(exc),
+        )
+
+
+def calibration_constants(path: str = "",
+                          device_kind: str | None = None) -> dict:
+    """The cost constants :func:`pick_row_capacity` should use here:
+    this device kind's measured calibration where the sidecar has one,
+    the committed v5e defaults otherwise.  ``measured`` says which."""
+    out = {"slot_s": DEFAULT_SLOT_S, "research_s": DEFAULT_RESEARCH_S,
+           "compile_s": DEFAULT_COMPILE_S, "measured": False}
+    cell = _kind_entry(load_calibration(path),
+                       device_kind or _device_kind_default())
+    if isinstance(cell, dict):
+        for name in ("slot_s", "research_s", "compile_s"):
+            val = cell.get(name)
+            if isinstance(val, (int, float)) and val > 0:
+                out[name] = float(val)
+                out["measured"] = True
+    return out
+
+
+def _measured_slot_cost(sidecar: str, device_kind: str) -> float | None:
+    """Per-slot-per-trial extraction cost implied by this device's
+    MEASURED extraction cells (cell cost / capacity for the picked —
+    else cheapest measured — method; median across cells).  Builtin
+    default costs deliberately do not count: calibration records what
+    this device was actually measured to do."""
+    cells = _kind_entry(load_extraction(sidecar), device_kind) or {}
+    vals = []
+    for key, cell in cells.items():
+        if not isinstance(cell, dict):
+            continue
+        try:
+            _bucket, cap = str(key).split("/")
+            cap = int(cap)
+        except ValueError:
+            continue
+        if cap <= 0:
+            continue
+        costs = {m: cell[m] for m in EXTRACTION_METHODS
+                 if isinstance(cell.get(m), (int, float))
+                 and cell[m] > 0}
+        if not costs:
+            continue
+        picked = cell.get("picked")
+        cost = costs.get(picked) if picked in costs else min(costs.values())
+        vals.append(float(cost) / cap)
+    if not vals:
+        return None
+    vals.sort()
+    mid = len(vals) // 2
+    return (vals[mid] if len(vals) % 2
+            else 0.5 * (vals[mid - 1] + vals[mid]))
+
+
+def record_run_calibration(sidecar: str, device_kind: str | None = None,
+                           *, research_s: float | None = None,
+                           registry=None) -> None:
+    """Record this run's measured cost constants (called by the mesh
+    drivers where they save their high-water marks; best effort).
+
+    ``compile_s`` comes from the process's ``jit_compile`` stage timer
+    (mean seconds per XLA backend compile — real measurements, via
+    ``install_compile_hook``); ``slot_s`` from the sidecar's measured
+    extraction cells (:func:`_measured_slot_cost`); ``research_s`` is
+    passed by the chunked driver as measured re-search wall-clock per
+    clipped row (None when no row clipped this run)."""
+    if not sidecar:
+        return
+    device_kind = device_kind or _device_kind_default()
+    if registry is None:
+        from ..obs.metrics import REGISTRY as registry
+    compile_s = None
+    timer = registry.snapshot().get("timers", {}).get("jit_compile")
+    if timer and timer.get("count", 0) > 0:
+        compile_s = float(timer["host_s"]) / float(timer["count"])
+    update_calibration(
+        sidecar, device_kind,
+        slot_s=_measured_slot_cost(sidecar, device_kind),
+        research_s=research_s,
+        compile_s=compile_s,
+    )
 
 
 def _device_kind_default() -> str:

@@ -24,9 +24,14 @@ beams, flaky runs and worker crashes.  This package is that layer:
   worker per host of a multi-host slice, heartbeat leases on claims,
   automatic dead-host recovery, per-host store shards and the
   aggregated fleet report;
+* :mod:`~peasoup_tpu.serve.health` — the fleet health plane: typed
+  ok/warn/crit rules + an SLO summary evaluated over the live
+  per-host telemetry time-series (obs/telemetry.py shards), embedded
+  in ``fleet_report.json`` v2 and surfaced by the ``health`` verb;
 * :mod:`~peasoup_tpu.serve.cli` — ``python -m peasoup_tpu.serve``
-  with ``submit`` / ``worker`` / ``fleet-worker`` / ``status`` /
-  ``coincidence`` / ``requeue`` verbs.
+  with ``submit`` / ``worker`` / ``fleet-worker`` / ``status``
+  (``--watch`` live dashboard) / ``health`` / ``coincidence`` /
+  ``requeue`` verbs.
 """
 
 from .fleet import (
@@ -35,6 +40,15 @@ from .fleet import (
     LeaseHeartbeat,
     fleet_report,
     write_fleet_report,
+)
+from .health import (
+    HealthContext,
+    HealthFinding,
+    build_context,
+    evaluate,
+    evaluate_spool,
+    health_rule,
+    slo_summary,
 )
 from .queue import LEASE_EXPIRED, JobRecord, JobSpool
 from .retry import (
@@ -64,4 +78,11 @@ __all__ = [
     "LeaseHeartbeat",
     "fleet_report",
     "write_fleet_report",
+    "HealthContext",
+    "HealthFinding",
+    "build_context",
+    "evaluate",
+    "evaluate_spool",
+    "health_rule",
+    "slo_summary",
 ]

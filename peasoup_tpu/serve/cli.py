@@ -6,7 +6,9 @@ Verbs::
     worker        [--drain] [--max-jobs N] [--poll S] ...
     fleet-worker  [--host-id I --host-count N] [--label L]
                   [--lease-ttl S] [--heartbeat S] + worker options
-    status        [--jobs] [--fleet]
+    status        [--jobs] [--fleet] [--watch [--interval S]]
+    health        [--json PATH] [--stale-after N] [--window S]
+                  [--slo KEY=VALUE ...]
     coincidence   [--freq-tol F] [--min-sources N] [--json PATH]
     requeue       <job_ids...> | --running | --failed | --expired
 
@@ -25,6 +27,14 @@ store shards; ``requeue`` recovers jobs from a crashed worker
 (``--running``, or ``--expired`` for lease-based recovery that only
 touches jobs whose host stopped heartbeating) or retries quarantined
 ones (``--failed``).
+
+Health plane (serve/health.py over obs/telemetry.py shards):
+``health`` evaluates every registered rule plus the SLO summary
+against the fleet's live telemetry time-series and exits nonzero on a
+crit finding — CI/cron-able; ``status --watch`` re-renders the fleet
+table and the current findings every ``--interval`` seconds (a
+terminal dashboard; ``--iterations`` bounds it for tests and one-shot
+scripts).
 """
 
 from __future__ import annotations
@@ -102,6 +112,34 @@ def build_parser() -> argparse.ArgumentParser:
     pt.add_argument("--lease-ttl", type=float, default=None,
                     help="TTL used to flag stale leases in the fleet "
                          "report")
+    pt.add_argument("--watch", action="store_true",
+                    help="live dashboard: re-render the fleet table + "
+                         "health findings every --interval seconds")
+    pt.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh interval in seconds")
+    pt.add_argument("--iterations", type=int, default=0,
+                    help="stop --watch after N refreshes (0 = forever)")
+
+    ph = sub.add_parser(
+        "health",
+        help="evaluate fleet health rules + SLOs over the live "
+             "telemetry time-series (exit 1 on a crit finding)")
+    ph.add_argument("--json", dest="json_path", default=None,
+                    help="also write the full health report to this "
+                         "JSON file")
+    ph.add_argument("--ledger", default=None,
+                    help="bench history ledger for the throughput "
+                         "baseline (default: repo "
+                         "benchmarks/history.jsonl)")
+    ph.add_argument("--stale-after", type=float, default=None,
+                    help="a host is stale after this many missed "
+                         "sampling intervals (default 5)")
+    ph.add_argument("--window", type=float, default=None,
+                    help="evaluation window in seconds (default 300)")
+    ph.add_argument("--slo", dest="slo", action="append", default=[],
+                    metavar="KEY=SECONDS",
+                    help="override an SLO target (repeatable), e.g. "
+                         "--slo queue_wait_p95_s=120")
 
     pc = sub.add_parser(
         "coincidence",
@@ -162,6 +200,10 @@ def _add_worker_args(pw) -> None:
     pw.add_argument("--history", default=None,
                     help="throughput ledger path (default: the repo "
                          "benchmarks/history.jsonl)")
+    pw.add_argument("--telemetry-interval", type=float, default=5.0,
+                    help="live telemetry sampling cadence in seconds "
+                         "(per-host fleet/ts-<host>.jsonl shard; "
+                         "0 disables the sampler)")
 
 
 def cmd_submit(spool, args) -> int:
@@ -191,6 +233,7 @@ def cmd_worker(spool, args) -> int:
         prefetch=not args.no_prefetch,
         history_path=args.history,
         batch=args.batch,
+        telemetry_interval_s=args.telemetry_interval,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -235,6 +278,7 @@ def cmd_fleet_worker(spool, args) -> int:
         prefetch=not args.no_prefetch,
         history_path=args.history,
         batch=args.batch,
+        telemetry_interval_s=args.telemetry_interval,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -268,9 +312,59 @@ def _print_fleet_table(report: dict) -> None:
         print(fmt.format(*(str(v) for v in row)))
 
 
-def cmd_status(spool, args) -> int:
+def _print_health_lines(health: dict) -> None:
+    """Non-ok findings + severity out of an embedded health section
+    (the ``--watch`` footer; the ``health`` verb prints the full
+    report)."""
+    print(f"health: {health['severity'].upper()}")
+    for f in health.get("findings", []):
+        if f["severity"] == "ok":
+            continue
+        subject = f" {f['host']}" if f.get("host") else ""
+        print(f"  [{f['severity'].upper()}] {f['rule']}{subject}: "
+              f"{f['message']}")
+
+
+def _watch_status(spool, args, sleeper=None, clock=None) -> int:
+    """``status --watch``: re-render the fleet table + health findings
+    every ``--interval`` seconds.  ``sleeper``/``clock`` are
+    injectable so tests run N iterations without wall-clock waits."""
+    from .fleet import fleet_report
+    from .queue import DEFAULT_LEASE_TTL_S
+    from .retry import pause
+
+    clock = clock or time.time
+    ttl = (args.lease_ttl if args.lease_ttl is not None
+           else DEFAULT_LEASE_TTL_S)
+    done = 0
+    try:
+        while True:
+            if sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            now = clock()
+            report = fleet_report(spool, ttl)
+            stamp = time.strftime("%H:%M:%S", time.localtime(now))
+            print(f"{stamp}  spool {spool.root}  "
+                  f"(refresh {args.interval:g}s, ctrl-c to stop)")
+            _print_fleet_table(report)
+            print("queue: " + "  ".join(
+                f"{k}={v}" for k, v in report["queue"].items()))
+            health = report.get("health")
+            if health is not None:
+                _print_health_lines(health)
+            done += 1
+            if args.iterations and done >= args.iterations:
+                return 0
+            pause(args.interval, sleeper)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_status(spool, args, sleeper=None, clock=None) -> int:
     from .store import CandidateStore
 
+    if getattr(args, "watch", False):
+        return _watch_status(spool, args, sleeper=sleeper, clock=clock)
     if args.fleet:
         from .fleet import fleet_report, write_fleet_report
         from .queue import DEFAULT_LEASE_TTL_S
@@ -317,6 +411,37 @@ def cmd_status(spool, args) -> int:
                 print(f"{state:<9}{rec.job_id}  prio={rec.priority} "
                       f"attempts={rec.attempts}  {rec.input}{extra}")
     return 0
+
+
+def cmd_health(spool, args) -> int:
+    from ..errors import ConfigError
+    from .health import (
+        build_context,
+        evaluate,
+        format_findings,
+        write_health_report,
+    )
+
+    slo = {}
+    for item in args.slo:
+        key, val = _parse_override(item)
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            raise ConfigError(
+                f"--slo {item!r}: target must be a number of seconds")
+        slo[key] = float(val)
+    kw = {}
+    if args.window is not None:
+        kw["window_s"] = args.window
+    if args.stale_after is not None:
+        kw["stale_after"] = args.stale_after
+    ctx = build_context(spool, ledger_path=args.ledger, slo=slo, **kw)
+    report = evaluate(ctx)
+    print(format_findings(report))
+    if args.json_path:
+        print(f"wrote {write_health_report(report, args.json_path)}")
+    # crit is the CI-able signal; warn still exits 0 (worth a look,
+    # but the fleet is making progress)
+    return 1 if report["severity"] == "crit" else 0
 
 
 def cmd_coincidence(spool, args) -> int:
@@ -391,6 +516,7 @@ def main(argv=None) -> int:
         "worker": cmd_worker,
         "fleet-worker": cmd_fleet_worker,
         "status": cmd_status,
+        "health": cmd_health,
         "coincidence": cmd_coincidence,
         "requeue": cmd_requeue,
     }[args.verb](spool, args)

@@ -240,7 +240,8 @@ class FleetWorker(SurveyWorker):
             "heartbeat_s": self.heartbeat_s,
             "summary": {k: summary[k] for k in (
                 "claimed", "succeeded", "failed", "elapsed_s",
-                "jobs_per_hour", "geometry_buckets") if k in summary},
+                "jobs_per_hour", "geometry_buckets",
+                "telemetry") if k in summary},
             "scheduler": sched(snap["counters"]),
             "gauges": sched(snap["gauges"]),
             "shard": os.path.basename(self.store.path),
@@ -317,8 +318,8 @@ def fleet_report(spool: JobSpool,
         "quarantined": int(sum(_tot(None, "scheduler",
                                     "quarantined"))),
     }
-    return {
-        "v": 1,
+    report = {
+        "v": 2,
         "utc": round(now, 3),
         "spool": spool.root,
         "queue": spool.counts(),
@@ -332,6 +333,21 @@ def fleet_report(spool: JobSpool,
         "hosts": hosts,
         "totals": totals,
     }
+    # v2: embed the live health evaluation (findings + SLO summary)
+    # so fleet_report.json alone answers "is the fleet ok".  Best
+    # effort — a broken shard must not take the status verb down.
+    try:
+        from .health import evaluate_spool
+
+        hp = evaluate_spool(spool, now=now)
+        report["health"] = {
+            "severity": hp["severity"],
+            "findings": hp["findings"],
+            "slo": hp["slo"],
+        }
+    except Exception:
+        report["v"] = 1
+    return report
 
 
 def write_fleet_report(spool: JobSpool, report: dict | None = None,

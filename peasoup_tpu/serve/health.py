@@ -1,0 +1,544 @@
+"""Fleet health plane: typed rules + SLO summary over live telemetry.
+
+``obs/telemetry.py`` gives every host a continuous time-series shard;
+this module is the judgement layer on top — the part that turns raw
+samples into "is the fleet healthy, and if not, what do I run".  The
+shape follows the repo's analysis engine (``analysis/rules.py``):
+
+* :class:`HealthFinding` — one typed verdict (``rule``, ``severity``
+  ok/warn/crit, human message, machine ``data``), JSON-serialisable;
+* :class:`HealthContext` — everything a rule may look at, assembled
+  once by :func:`build_context`: the merged time-series (all samples
+  + the recent evaluation window), latest sample per host, queue
+  depths, running-job lease holders, and the bench-history ledger's
+  ``kind:"serve"`` records;
+* each rule is a small **pure function** ``rule(ctx) ->
+  [HealthFinding]`` registered via the :func:`health_rule` decorator —
+  adding a rule is writing one function (see CONTRIBUTING.md);
+* :func:`slo_summary` — queue-wait and job-duration p50/p95 (weighted
+  by per-sample observation counts) against configurable targets;
+* :func:`evaluate` — run every rule, fold in the SLO verdict, and
+  return the health report dict the ``health`` CLI verb prints (and
+  ``fleet_report.json`` v2 embeds).
+
+Severity semantics (what the operator should do):
+
+* **ok** — nothing to do;
+* **warn** — worth a look, the fleet is still making progress;
+* **crit** — jobs are at risk or stalled; the ``health`` verb exits
+  nonzero so CI/cron can page on it.
+
+The stale-host rule encodes the fleet's lease model: a silent host is
+only *critical* while it still holds running-job leases (those jobs
+are going nowhere until ``requeue --expired`` reaps them); silent
+with pending work waiting is a warning (capacity loss); silent with
+an empty queue and no leases is a clean departure — drained workers
+exit, that's normal, and the fleet reports healthy again after
+recovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+
+from ..obs.history import default_ledger_path, load_history
+from ..obs.telemetry import (
+    DEFAULT_INTERVAL_S,
+    latest_by_host,
+    read_samples,
+)
+from .queue import JobSpool
+
+OK = "ok"
+WARN = "warn"
+CRIT = "crit"
+
+_SEVERITY_RANK = {OK: 0, WARN: 1, CRIT: 2}
+
+#: default evaluation window (seconds of recent samples rules sum over)
+DEFAULT_WINDOW_S = 300.0
+
+#: a host is stale after this many missed sampling intervals
+DEFAULT_STALE_AFTER = 5.0
+
+#: default SLO targets (seconds); override per-key via ``--slo`` or the
+#: ``slo=`` argument of :func:`build_context`
+DEFAULT_SLO = {
+    "queue_wait_p50_s": 60.0,
+    "queue_wait_p95_s": 600.0,
+    "job_p50_s": 900.0,
+    "job_p95_s": 3600.0,
+}
+
+#: retry/quarantine/reap thresholds for the spike rules (per window)
+RETRY_WARN = 3
+RETRY_CRIT = 10
+QUARANTINE_CRIT = 3
+REAP_CRIT = 3
+
+
+@dataclass(frozen=True)
+class HealthFinding:
+    """One rule's verdict on one subject (a host, or the fleet)."""
+
+    rule: str
+    severity: str
+    message: str
+    host: str = ""
+    data: dict = field(default_factory=dict)
+
+    def to_obj(self) -> dict:
+        return asdict(self)
+
+
+def worst_severity(severities) -> str:
+    worst = OK
+    for sev in severities:
+        if _SEVERITY_RANK.get(sev, 0) > _SEVERITY_RANK[worst]:
+            worst = sev
+    return worst
+
+
+@dataclass
+class HealthContext:
+    """Everything the rules see — plain data, so every rule is a pure
+    function and its fixtures are literal dicts."""
+
+    now: float
+    samples: list[dict]           # full merged time-series (ts-sorted)
+    recent: list[dict]            # samples within the window
+    latest: dict[str, dict]       # newest sample per host
+    queue: dict[str, int]         # spool state counts
+    running: list[dict]           # [{"job_id", "host"}] lease holders
+    ledger: list[dict]            # kind:"serve" history records
+    window_s: float = DEFAULT_WINDOW_S
+    stale_after: float = DEFAULT_STALE_AFTER
+    slo: dict = field(default_factory=lambda: dict(DEFAULT_SLO))
+
+
+def default_ts_dir(spool: JobSpool) -> str:
+    """The telemetry shard directory — the spool's ``fleet/`` dir
+    (same place as the per-host status snapshots; the ``ts-`` prefix
+    and ``.jsonl`` suffix keep the two namespaces disjoint)."""
+    return os.path.join(spool.root, "fleet")
+
+
+def build_context(spool: JobSpool, *, ts_dir: str | None = None,
+                  ledger_path: str | None = None,
+                  now: float | None = None,
+                  window_s: float = DEFAULT_WINDOW_S,
+                  stale_after: float = DEFAULT_STALE_AFTER,
+                  slo: dict | None = None) -> HealthContext:
+    """Assemble the rules' world view from the spool + shards +
+    ledger.  ``now`` is injectable for tests; every reader involved is
+    torn-tail tolerant, so a half-dead fleet still evaluates."""
+    now = time.time() if now is None else float(now)
+    ts_dir = ts_dir or default_ts_dir(spool)
+    samples = read_samples(ts_dir)
+    recent = [s for s in samples if s.get("ts", 0) >= now - window_s]
+    running = []
+    for rec in spool.jobs("running"):
+        lease = spool.lease_info(rec.job_id) or {}
+        running.append({"job_id": rec.job_id,
+                        "host": rec.host or lease.get("host", "")})
+    targets = dict(DEFAULT_SLO)
+    targets.update(slo or {})
+    return HealthContext(
+        now=now,
+        samples=samples,
+        recent=recent,
+        latest=latest_by_host(ts_dir),
+        queue=spool.counts(),
+        running=running,
+        ledger=load_history(ledger_path or default_ledger_path(),
+                            kinds=("serve",)),
+        window_s=float(window_s),
+        stale_after=float(stale_after),
+        slo=targets,
+    )
+
+
+# -- rule registry ---------------------------------------------------------
+
+RULES: list = []
+
+
+def health_rule(fn):
+    """Register a health rule: ``fn(ctx) -> list[HealthFinding]``.
+    Rules run in registration order; a crashing rule becomes a warn
+    finding, never an evaluation failure."""
+    RULES.append(fn)
+    return fn
+
+
+def _recent_counter(ctx: HealthContext, name: str) -> int:
+    """Sum of a counter's per-sample deltas across the window."""
+    return sum(int(s.get("counters", {}).get(name, 0))
+               for s in ctx.recent)
+
+
+# -- rules -----------------------------------------------------------------
+
+@health_rule
+def rule_stale_host(ctx: HealthContext) -> list[HealthFinding]:
+    """A host that stopped sampling: crit while it holds running-job
+    leases, warn if pending work is waiting for capacity, ok when it
+    departed cleanly (drained workers exit — that is normal)."""
+    leases: dict[str, int] = {}
+    for job in ctx.running:
+        host = job.get("host") or "?"
+        leases[host] = leases.get(host, 0) + 1
+    hosts = set(ctx.latest) | {h for h in leases if h != "?"}
+    if not hosts:
+        return [HealthFinding(
+            "stale_host", OK, "no telemetry shards yet",
+            data={"hosts": 0})]
+    pending = int(ctx.queue.get("pending", 0))
+    out = []
+    for host in sorted(hosts):
+        sample = ctx.latest.get(host)
+        interval = (float(sample.get("interval_s", DEFAULT_INTERVAL_S))
+                    if sample else DEFAULT_INTERVAL_S)
+        age = (ctx.now - float(sample.get("ts", 0.0))
+               if sample else float("inf"))
+        threshold = ctx.stale_after * interval
+        held = leases.get(host, 0)
+        data = {"age_s": round(age, 3) if sample else None,
+                "threshold_s": round(threshold, 3), "leases": held}
+        if age <= threshold:
+            out.append(HealthFinding(
+                "stale_host", OK,
+                f"sampled {age:.1f}s ago", host=host, data=data))
+        elif held:
+            out.append(HealthFinding(
+                "stale_host", CRIT,
+                f"silent for {age:.1f}s (> {threshold:.1f}s) while "
+                f"holding {held} running-job lease(s) — run "
+                f"'requeue --expired' to recover them",
+                host=host, data=data))
+        elif pending:
+            out.append(HealthFinding(
+                "stale_host", WARN,
+                f"silent for {age:.1f}s with {pending} pending "
+                f"job(s) waiting for capacity", host=host, data=data))
+        else:
+            out.append(HealthFinding(
+                "stale_host", OK,
+                "silent, but holds no leases and the queue is empty "
+                "(departed cleanly)", host=host, data=data))
+    return out
+
+
+@health_rule
+def rule_queue_backlog(ctx: HealthContext) -> list[HealthFinding]:
+    """Pending depth trending up across the window: warn while jobs
+    still complete, crit when the backlog grows and nothing drains."""
+    series = [int(s["queue"]["pending"]) for s in ctx.recent
+              if isinstance(s.get("queue"), dict)
+              and "pending" in s["queue"]]
+    if len(series) < 3:
+        return [HealthFinding(
+            "queue_backlog", OK,
+            f"insufficient queue samples in window ({len(series)})",
+            data={"samples": len(series)})]
+    first, last = series[0], series[-1]
+    grew = last - first
+    data = {"first": first, "last": last, "grew": grew,
+            "samples": len(series)}
+    if grew >= 2 and last > 0:
+        drained = _recent_counter(ctx, "scheduler.succeeded")
+        data["drained_in_window"] = drained
+        if drained == 0:
+            return [HealthFinding(
+                "queue_backlog", CRIT,
+                f"backlog grew {first} -> {last} with ZERO jobs "
+                f"completed in the window — workers stalled or absent",
+                data=data)]
+        return [HealthFinding(
+            "queue_backlog", WARN,
+            f"backlog grew {first} -> {last} in the window "
+            f"(submissions outpacing {drained} completion(s))",
+            data=data)]
+    return [HealthFinding(
+        "queue_backlog", OK,
+        f"backlog stable ({first} -> {last})", data=data)]
+
+
+@health_rule
+def rule_retry_spike(ctx: HealthContext) -> list[HealthFinding]:
+    """Quarantine/retry-rate spikes in the window: bad inputs or a
+    systematically failing fleet."""
+    retried = _recent_counter(ctx, "scheduler.retried")
+    quarantined = _recent_counter(ctx, "scheduler.quarantined")
+    exhausted = _recent_counter(ctx, "scheduler.exhausted")
+    terminal = quarantined + exhausted
+    data = {"retried": retried, "quarantined": quarantined,
+            "exhausted": exhausted}
+    if terminal >= QUARANTINE_CRIT or retried >= RETRY_CRIT:
+        return [HealthFinding(
+            "retry_spike", CRIT,
+            f"{terminal} job(s) quarantined/exhausted and {retried} "
+            f"retried in the window — inputs or workers are "
+            f"systematically failing", data=data)]
+    if terminal > 0 or retried >= RETRY_WARN:
+        return [HealthFinding(
+            "retry_spike", WARN,
+            f"{terminal} terminal failure(s), {retried} retry(ies) "
+            f"in the window", data=data)]
+    return [HealthFinding(
+        "retry_spike", OK, "no failure spike in the window",
+        data=data)]
+
+
+@health_rule
+def rule_throughput_regression(ctx: HealthContext) -> list[HealthFinding]:
+    """Live fleet ``jobs_per_hour`` against the ledger's serve-record
+    median — the survey-throughput regression check, evaluated on the
+    running fleet instead of post-hoc."""
+    baseline_vals = sorted(
+        float(r.get("metrics", {}).get("jobs_per_hour", 0.0))
+        for r in ctx.ledger
+        if r.get("metrics", {}).get("jobs_per_hour", 0.0) > 0)
+    if len(baseline_vals) < 3:
+        return [HealthFinding(
+            "throughput_regression", OK,
+            f"not enough serve ledger records for a baseline "
+            f"({len(baseline_vals)} < 3)",
+            data={"records": len(baseline_vals)})]
+    mid = len(baseline_vals) // 2
+    median = (baseline_vals[mid] if len(baseline_vals) % 2
+              else 0.5 * (baseline_vals[mid - 1] + baseline_vals[mid]))
+    current = 0.0
+    seen = False
+    for sample in ctx.latest.values():
+        jph = sample.get("gauges", {}).get("scheduler.jobs_per_hour")
+        if jph is not None:
+            current += float(jph)
+            seen = True
+    data = {"median_jobs_per_hour": round(median, 3),
+            "current_jobs_per_hour": round(current, 3),
+            "records": len(baseline_vals)}
+    if not seen:
+        return [HealthFinding(
+            "throughput_regression", OK,
+            "no live jobs_per_hour gauge yet (fleet idle or starting)",
+            data=data)]
+    if current < 0.25 * median:
+        return [HealthFinding(
+            "throughput_regression", CRIT,
+            f"fleet at {current:.1f} jobs/h vs ledger median "
+            f"{median:.1f} (<25%)", data=data)]
+    if current < 0.5 * median:
+        return [HealthFinding(
+            "throughput_regression", WARN,
+            f"fleet at {current:.1f} jobs/h vs ledger median "
+            f"{median:.1f} (<50%)", data=data)]
+    return [HealthFinding(
+        "throughput_regression", OK,
+        f"fleet at {current:.1f} jobs/h vs ledger median "
+        f"{median:.1f}", data=data)]
+
+
+@health_rule
+def rule_hbm_watermark(ctx: HealthContext) -> list[HealthFinding]:
+    """Per-host HBM high-water against the plan's budget: >90% warn,
+    >98% crit.  Hosts without a budget gauge (CPU runs, old samples)
+    evaluate ok — unknown is not unhealthy."""
+    out = []
+    for host in sorted(ctx.latest):
+        gauges = ctx.latest[host].get("gauges", {})
+        high = gauges.get("hbm.high_water_bytes")
+        budget = gauges.get("hbm.budget_bytes")
+        if not budget or high is None:
+            continue
+        frac = float(high) / float(budget)
+        data = {"high_water_bytes": high, "budget_bytes": budget,
+                "fraction": round(frac, 4)}
+        if frac >= 0.98:
+            sev, note = CRIT, "next escalation will OOM"
+        elif frac >= 0.90:
+            sev, note = WARN, "approaching the HBM budget"
+        else:
+            sev, note = OK, "within budget"
+        out.append(HealthFinding(
+            "hbm_watermark", sev,
+            f"HBM high-water at {100 * frac:.1f}% of budget ({note})",
+            host=host, data=data))
+    if not out:
+        return [HealthFinding(
+            "hbm_watermark", OK, "no HBM budget gauges reported",
+            data={})]
+    return out
+
+
+@health_rule
+def rule_lease_reap_burst(ctx: HealthContext) -> list[HealthFinding]:
+    """Lease reaps in the window mean hosts died mid-job: one is a
+    warning, a burst means the fleet is losing machines."""
+    reaped = _recent_counter(ctx, "scheduler.lease_reaped")
+    data = {"reaped": reaped}
+    if reaped >= REAP_CRIT:
+        return [HealthFinding(
+            "lease_reap_burst", CRIT,
+            f"{reaped} lease(s) reaped in the window — multiple hosts "
+            f"dying mid-job", data=data)]
+    if reaped > 0:
+        return [HealthFinding(
+            "lease_reap_burst", WARN,
+            f"{reaped} lease(s) reaped in the window (a host died; "
+            f"its jobs were recovered)", data=data)]
+    return [HealthFinding(
+        "lease_reap_burst", OK, "no lease reaps in the window",
+        data=data)]
+
+
+# -- SLO summary -----------------------------------------------------------
+
+def _weighted_percentile(pairs: list[tuple[float, float]],
+                         q: float) -> float | None:
+    """Percentile of (value, weight) pairs; None on no data."""
+    if not pairs:
+        return None
+    pairs = sorted(pairs)
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        return None
+    acc = 0.0
+    for value, weight in pairs:
+        acc += weight
+        if acc >= q * total:
+            return value
+    return pairs[-1][0]
+
+
+def slo_summary(ctx: HealthContext) -> dict:
+    """Queue-wait and job-duration p50/p95 vs targets.
+
+    Each telemetry sample carries timer *deltas* (count + host
+    seconds), so the per-sample mean weighted by its count is an
+    unbiased estimate over the window — good enough for SLO banding
+    without shipping every observation off-host.  Over target = warn,
+    over 2x target = crit, no data = ``no_data`` (counts as ok: an
+    idle fleet meets its SLOs vacuously).
+    """
+    metrics = {}
+    statuses = []
+    for name in ("queue_wait", "job"):
+        pairs = []
+        n = 0
+        for sample in ctx.recent:
+            delta = sample.get("timers", {}).get(name)
+            if not isinstance(delta, dict):
+                continue
+            count = float(delta.get("count", 0))
+            if count > 0:
+                pairs.append((float(delta.get("host_s", 0.0)) / count,
+                              count))
+                n += int(count)
+        p50 = _weighted_percentile(pairs, 0.50)
+        p95 = _weighted_percentile(pairs, 0.95)
+        t50 = float(ctx.slo.get(f"{name}_p50_s", float("inf")))
+        t95 = float(ctx.slo.get(f"{name}_p95_s", float("inf")))
+        if p50 is None:
+            status = "no_data"
+        elif p50 > 2 * t50 or (p95 or 0.0) > 2 * t95:
+            status = CRIT
+        elif p50 > t50 or (p95 or 0.0) > t95:
+            status = WARN
+        else:
+            status = OK
+        statuses.append(status if status in _SEVERITY_RANK else OK)
+        metrics[name] = {
+            "p50_s": round(p50, 6) if p50 is not None else None,
+            "p95_s": round(p95, 6) if p95 is not None else None,
+            "n": n,
+            "target_p50_s": t50,
+            "target_p95_s": t95,
+            "status": status,
+        }
+    return {"metrics": metrics, "status": worst_severity(statuses)}
+
+
+# -- evaluation ------------------------------------------------------------
+
+def evaluate(ctx: HealthContext) -> dict:
+    """Run every registered rule + the SLO summary; returns the health
+    report (schema below).  A crashing rule degrades to a warn finding
+    so one bad rule can never mask the others.
+
+    Report schema::
+
+        {"v": 1, "utc": <s>, "severity": "ok"|"warn"|"crit",
+         "findings": [HealthFinding...], "slo": {...},
+         "queue": {...}, "hosts": [...], "window_s": ..., }
+    """
+    findings: list[HealthFinding] = []
+    for rule in RULES:
+        try:
+            findings.extend(rule(ctx))
+        except Exception as exc:
+            findings.append(HealthFinding(
+                "rule_error", WARN,
+                f"health rule {getattr(rule, '__name__', rule)!r} "
+                f"crashed: {exc}",
+                data={"rule": str(getattr(rule, "__name__", rule))}))
+    slo = slo_summary(ctx)
+    if slo["status"] in (WARN, CRIT):
+        breached = [f"{name} p50={m['p50_s']}s/p95={m['p95_s']}s vs "
+                    f"{m['target_p50_s']}/{m['target_p95_s']}s"
+                    for name, m in slo["metrics"].items()
+                    if m["status"] in (WARN, CRIT)]
+        findings.append(HealthFinding(
+            "slo_breach", slo["status"],
+            "SLO breach: " + "; ".join(breached),
+            data={"metrics": {k: m for k, m in slo["metrics"].items()
+                              if m["status"] in (WARN, CRIT)}}))
+    return {
+        "v": 1,
+        "utc": round(ctx.now, 3),
+        "severity": worst_severity(f.severity for f in findings),
+        "findings": [f.to_obj() for f in findings],
+        "slo": slo,
+        "queue": dict(ctx.queue),
+        "hosts": sorted(ctx.latest),
+        "window_s": ctx.window_s,
+        "stale_after": ctx.stale_after,
+    }
+
+
+def evaluate_spool(spool: JobSpool, **kwargs) -> dict:
+    """One-call health evaluation (what the CLI verb runs)."""
+    return evaluate(build_context(spool, **kwargs))
+
+
+def format_findings(report: dict) -> str:
+    """Human-readable finding lines (the ``health`` verb's output)."""
+    lines = []
+    for f in report["findings"]:
+        tag = f["severity"].upper()
+        subject = f" {f['host']}" if f.get("host") else ""
+        lines.append(f"[{tag:<4}] {f['rule']}{subject}: "
+                     f"{f['message']}")
+    slo = report.get("slo", {})
+    for name, m in slo.get("metrics", {}).items():
+        if m["status"] == "no_data":
+            lines.append(f"[SLO ] {name}: no data in window")
+        else:
+            lines.append(
+                f"[SLO ] {name}: p50={m['p50_s']}s p95={m['p95_s']}s "
+                f"(targets {m['target_p50_s']}/{m['target_p95_s']}s) "
+                f"-> {m['status']}")
+    lines.append(f"fleet severity: {report['severity']}")
+    return "\n".join(lines)
+
+
+def write_health_report(report: dict, path: str) -> str:
+    """Serialise a health report atomically (``--json PATH``)."""
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    os.replace(tmp, path)
+    return path

@@ -130,7 +130,7 @@ class SurveyWorker:
                  max_devices: int | None = None, worker_id: str = "",
                  prefetch: bool = True, run_job_fn=None,
                  history_path: str | None = None, sleeper=None,
-                 batch: int = 1):
+                 batch: int = 1, telemetry_interval_s: float = 5.0):
         self.spool = spool
         self.store = store if store is not None else CandidateStore(
             os.path.join(spool.root, "candidates.jsonl"))
@@ -151,6 +151,10 @@ class SurveyWorker:
         #: same-geometry pending jobs into ONE fused device program per
         #: round trip; 1 = historical per-job dispatch
         self.batch = max(1, int(batch))
+        #: live telemetry cadence (obs/telemetry.py); 0 disables the
+        #: sampler.  The shard lands in the spool's ``fleet/`` dir so
+        #: ``health`` / ``status --watch`` see single-host workers too
+        self.telemetry_interval_s = float(telemetry_interval_s)
         self._prefetcher = ObservationPrefetcher(slots=self.batch)
         #: geometry bucket -> jobs served (program-reuse accounting)
         self.geometries: dict[tuple, int] = {}
@@ -514,32 +518,40 @@ class SurveyWorker:
         from ..obs.metrics import install_compile_hook
 
         install_compile_hook()
+        sampler = self._start_telemetry()
         t0 = time.time()
         claimed = succeeded = 0
-        while max_jobs is None or claimed < max_jobs:
-            job = self.spool.claim(self.worker_id, host=self.host_label)
-            if job is None:
-                if not wait:
-                    break
-                self._idle_poll()
-                pause(poll_s, self.sleeper)
-                continue
-            mates: list = []
-            if self.batch > 1 and self.run_job_fn is None:
-                room = self.batch - 1
-                if max_jobs is not None:
-                    room = min(room, max_jobs - claimed - 1)
-                if room > 0:
-                    mates = self._claim_batch_mates(job, room)
-            claimed += 1 + len(mates)
-            if mates:
-                succeeded += self._run_batch_jobs([job] + mates)
-            elif self.run_one(job):
-                succeeded += 1
-        elapsed = time.time() - t0
-        jobs_per_hour = (succeeded / (elapsed / 3600.0)
-                         if elapsed > 0 else 0.0)
-        METRICS.gauge("scheduler.jobs_per_hour", jobs_per_hour)
+        try:
+            while max_jobs is None or claimed < max_jobs:
+                job = self.spool.claim(self.worker_id,
+                                       host=self.host_label)
+                if job is None:
+                    if not wait:
+                        break
+                    self._idle_poll()
+                    pause(poll_s, self.sleeper)
+                    continue
+                mates: list = []
+                if self.batch > 1 and self.run_job_fn is None:
+                    room = self.batch - 1
+                    if max_jobs is not None:
+                        room = min(room, max_jobs - claimed - 1)
+                    if room > 0:
+                        mates = self._claim_batch_mates(job, room)
+                claimed += 1 + len(mates)
+                if mates:
+                    succeeded += self._run_batch_jobs([job] + mates)
+                elif self.run_one(job):
+                    succeeded += 1
+            elapsed = time.time() - t0
+            jobs_per_hour = (succeeded / (elapsed / 3600.0)
+                             if elapsed > 0 else 0.0)
+            METRICS.gauge("scheduler.jobs_per_hour", jobs_per_hour)
+        finally:
+            # stop AFTER the jobs_per_hour gauge so the final sample
+            # carries the drain's headline figure
+            if sampler is not None:
+                sampler.stop()
         summary = {
             "claimed": claimed,
             "succeeded": succeeded,
@@ -549,8 +561,32 @@ class SurveyWorker:
             "geometry_buckets": len(self.geometries),
             "batch": self.batch,
         }
+        if sampler is not None:
+            summary["telemetry"] = {
+                "samples": sampler.samples_written,
+                "overhead_s": round(sampler.overhead_s, 6),
+                "shard": sampler.path,
+            }
         self._append_throughput(summary)
         return summary
+
+    def _start_telemetry(self):
+        """Spin up the per-host telemetry sampler for this drain (None
+        when disabled).  The worker owns the obs->serve seam: it hands
+        the sampler a shard path and a queue-depth callable, so
+        obs/telemetry.py never imports serve/."""
+        if self.telemetry_interval_s <= 0:
+            return None
+        from ..obs.telemetry import TelemetrySampler, shard_path
+
+        label = self.host_label or self.worker_id
+        sampler = TelemetrySampler(
+            shard_path(os.path.join(self.spool.root, "fleet"), label),
+            label,
+            self.telemetry_interval_s,
+            extras=lambda: {"queue": self.spool.counts()},
+        )
+        return sampler.start()
 
     def _idle_poll(self) -> None:
         """Hook run on every empty poll of a waiting drain (before

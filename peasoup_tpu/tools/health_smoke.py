@@ -1,0 +1,204 @@
+"""Health-plane smoke test (``make health-smoke``).
+
+Exercises the live telemetry + health pipeline end-to-end with REAL
+worker processes — ``python -m peasoup_tpu.serve fleet-worker``
+subprocesses on fake membership — the way the fleet smoke drives the
+control plane:
+
+Phase 1 — healthy fleet: two hosts drain two good synthetic
+observations with fast telemetry (``--telemetry-interval 0.2``).
+Assert every host left a ``fleet/ts-<host>.jsonl`` shard behind, the
+merged reader sees schema-v1 samples carrying queue depths and the
+final ``jobs_per_hour`` gauge, the ``health`` verb exits 0 on the
+drained fleet, and the sampler's measured overhead stays under 1% of
+each host's drain wall-clock (read back from the per-host status
+snapshots — the plane measures its own cost).
+
+Phase 2 — dead host: submit another observation, SIGKILL the claiming
+worker mid-job, wait out the staleness threshold, and assert
+``health`` now exits NONZERO with a crit ``stale_host`` finding naming
+the dead host (it still holds the lease).  ``requeue --expired``
+recovers the job, a second host re-drains it, and ``health`` returns
+to exit 0 — the silent host departed cleanly, which is not an alert.
+
+Exit status 0 only if every assertion holds — CI-gateable like
+``fleet-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+from .fleet_smoke import FAST, _check, _write_synthetic
+
+#: fast sampling so the smoke's staleness threshold is ~1s, not ~25s
+TELEMETRY_INTERVAL = "0.2"
+
+
+def _worker_cmd(spool_dir: str, host_id: int, history: str,
+                extra: list[str] | None = None) -> list[str]:
+    return [
+        sys.executable, "-m", "peasoup_tpu.serve",
+        "--spool", spool_dir, "fleet-worker",
+        "--host-id", str(host_id), "--host-count", "2",
+        "--drain", "--single_device", "--max-attempts", "2",
+        "--backoff-base", "0", "--history", history,
+        "--lease-ttl", "60", "--heartbeat", "0.5",
+        "--telemetry-interval", TELEMETRY_INTERVAL,
+    ] + (extra or [])
+
+
+def _health(spool_dir: str, history: str, env: dict,
+            json_path: str | None = None) -> tuple[int, str]:
+    cmd = [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+           spool_dir, "health", "--ledger", history]
+    if json_path:
+        cmd += ["--json", json_path]
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=120)
+    return r.returncode, r.stdout
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-health-smoke",
+        description="Peasoup-TPU - telemetry/health-plane smoke test",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-health-smoke",
+                   help="scratch directory (wiped)")
+    args = p.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir)
+    spool_dir = os.path.join(args.dir, "jobs")
+    history = os.path.join(args.dir, "history.jsonl")
+
+    from peasoup_tpu.obs.telemetry import read_samples, shard_hosts
+    from peasoup_tpu.serve import JobSpool
+    from peasoup_tpu.serve.fleet import load_host_statuses
+    from peasoup_tpu.serve.retry import pause
+
+    spool = JobSpool(spool_dir)
+    for i in range(2):
+        spool.submit(_write_synthetic(
+            os.path.join(args.dir, f"obs{i}.fil"), seed=i), FAST)
+
+    failures: list[str] = []
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ts_dir = os.path.join(spool.root, "fleet")
+
+    # ---- phase 1: healthy two-host drain with live telemetry ---------
+    # --max-jobs 1 guarantees BOTH hosts work (and leave a shard)
+    procs = [
+        subprocess.Popen(_worker_cmd(spool_dir, h, history,
+                                     ["--max-jobs", "1"]),
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for h in (0, 1)
+    ]
+    outs = [proc.communicate(timeout=600)[0] for proc in procs]
+    for h, out in enumerate(outs):
+        print(f"---- fleet-worker host-{h} ----")
+        print(out.strip())
+
+    _check(spool.counts()["done"] == 2, "2 jobs in done/", failures)
+    _check(shard_hosts(ts_dir) == ["host-0", "host-1"],
+           "both hosts wrote ts- telemetry shards", failures)
+    samples = read_samples(ts_dir)
+    _check(len(samples) >= 4 and all(s.get("v") == 1 for s in samples),
+           f"merged reader sees schema-v1 samples ({len(samples)})",
+           failures)
+    _check(all(isinstance(s.get("queue"), dict) for s in samples),
+           "every sample carries queue depths (extras seam)", failures)
+    finals = {}
+    for s in samples:
+        finals[s["host"]] = s
+    _check(all(f["gauges"].get("scheduler.jobs_per_hour", 0) > 0
+               for f in finals.values()),
+           "final samples carry the jobs_per_hour gauge", failures)
+
+    # sampler overhead: measured by the sampler itself, surfaced in
+    # the drain summary, persisted in the host status snapshot
+    for label, doc in sorted(load_host_statuses(spool).items()):
+        summ = doc.get("summary", {})
+        telem = summ.get("telemetry", {})
+        elapsed = float(summ.get("elapsed_s", 0.0))
+        overhead = float(telem.get("overhead_s", -1.0))
+        frac = overhead / elapsed if elapsed > 0 else 1.0
+        _check(0.0 <= overhead and frac < 0.01,
+               f"{label} sampler overhead {overhead:.4f}s is <1% of "
+               f"{elapsed:.2f}s drain ({100 * frac:.3f}%)", failures)
+
+    rc, out = _health(spool_dir, history, env)
+    print(out.strip())
+    _check(rc == 0 and "fleet severity: ok" in out,
+           "health exits 0 on the drained fleet", failures)
+
+    # ---- phase 2: SIGKILL one host -> crit -> recover -> ok ----------
+    kill_rec = spool.submit(_write_synthetic(
+        os.path.join(args.dir, "obs_kill.fil"), seed=3), FAST)
+    proc = subprocess.Popen(
+        _worker_cmd(spool_dir, 0, history), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.time() + 120.0
+    while spool.counts()["running"] == 0 and time.time() < deadline:
+        pause(0.05)
+    claimed_mid_job = spool.counts()["running"] == 1
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=60)
+    _check(claimed_mid_job, "worker SIGKILLed mid-job", failures)
+
+    # wait out the staleness threshold (stale_after x interval, ~1s)
+    pause(3.0)
+    report_path = os.path.join(args.dir, "health_crit.json")
+    rc, out = _health(spool_dir, history, env, json_path=report_path)
+    print(out.strip())
+    doc = json.load(open(report_path))
+    crit_stale = [f for f in doc["findings"]
+                  if f["rule"] == "stale_host"
+                  and f["severity"] == "crit"]
+    _check(rc != 0, "health exits NONZERO on the dead host", failures)
+    _check(len(crit_stale) == 1 and crit_stale[0]["host"] == "host-0",
+           "crit stale_host finding names the killed host", failures)
+    _check("requeue --expired" in crit_stale[0]["message"],
+           "finding tells the operator the recovery verb", failures)
+
+    rq = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+         spool_dir, "requeue", "--expired", "--lease-ttl", "0"],
+        env=env, capture_output=True, text=True, timeout=120)
+    print(rq.stdout.strip())
+    _check(rq.returncode == 0 and kill_rec.job_id in rq.stdout,
+           "requeue --expired reaped the dead host's job", failures)
+
+    redrain = subprocess.run(
+        _worker_cmd(spool_dir, 1, history), env=env,
+        capture_output=True, text=True, timeout=600)
+    print(redrain.stdout.strip())
+    state, _rec = spool.get(kill_rec.job_id)
+    _check(redrain.returncode == 0 and state == "done",
+           "host-1 re-drained the recovered job", failures)
+
+    rc, out = _health(spool_dir, history, env)
+    print(out.strip())
+    _check(rc == 0 and "fleet severity: ok" in out,
+           "health back to exit 0 after recovery (silent host "
+           "departed cleanly)", failures)
+
+    if failures:
+        print(f"\nhealth-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("\nhealth-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -155,6 +155,43 @@ def trend_table(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def serve_table(ledger: str | None = None, limit: int = 12) -> str:
+    """Serve-throughput history out of the ``kind:"serve"`` ledger
+    records every worker drain appends: ``jobs_per_hour`` next to the
+    batched-dispatch engagement figures (``batch``, dispatches, mean
+    fill) and the fleet host, so "did batching engage" and "which host
+    is slow" are answerable from the default report view."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("serve",))
+    if not records:
+        return ""
+    jph = [float(r["metrics"]["jobs_per_hour"]) for r in records
+           if isinstance(r.get("metrics", {}).get("jobs_per_hour"),
+                         (int, float))]
+    lines = [f"serve throughput ({len(records)} drain record(s); "
+             f"newest last):",
+             f"  {'ts':<20}{'host':<12}{'ok/claimed':>11}"
+             f"{'jobs/h':>10}{'batch':>6}{'disp':>6}{'fill':>6}"]
+    for rec in records[-limit:]:
+        m = rec.get("metrics", {})
+        cfg = rec.get("config", {})
+        disp = int(m.get("batched_dispatches", 0))
+        fill = (f"{int(m.get('batch_fill', 0)) / disp:.2f}"
+                if disp else "-")
+        ok_claimed = (f"{int(m.get('jobs_succeeded', 0))}/"
+                      f"{int(m.get('jobs_claimed', 0))}")
+        lines.append(
+            f"  {str(rec.get('ts', ''))[:19]:<20}"
+            f"{str(cfg.get('host') or '-')[:11]:<12}"
+            f"{ok_claimed:>11}"
+            f"{float(m.get('jobs_per_hour', 0.0)):>10.4g}"
+            f"{int(m.get('batch', 1)):>6}{disp:>6}{fill:>6}")
+    if jph:
+        lines.append(f"  jobs/h trend: {sparkline(jph)}  "
+                     f"(median {_median(jph):.4g}, last {jph[-1]:.4g})")
+    return "\n".join(lines)
+
+
 def stage_table(records: list[dict]) -> str:
     """Trailing per-stage device-time and utilization figures (from the
     newest record that carries them)."""
@@ -303,6 +340,11 @@ def main(argv=None) -> int:
     if st:
         print()
         print(st)
+    if args.kind == "bench":
+        sv = serve_table(args.ledger)
+        if sv:
+            print()
+            print(sv)
     if gate_msg:
         print()
         print(gate_msg)

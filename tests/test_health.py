@@ -1,0 +1,601 @@
+"""Health-plane tests: every rule's ok/warn/crit fixtures over
+literal-dict contexts, the SLO summary's banding, crash-isolated rule
+evaluation, the ``health`` / ``status --watch`` verbs, fleet_report v2
+embedding, and a faked 3-host fleet draining with live telemetry —
+all WITHOUT real multihost (FleetMembership.fake)."""
+
+import json
+import os
+
+import pytest
+
+from peasoup_tpu.obs.history import append_history, make_history_record
+from peasoup_tpu.obs.metrics import REGISTRY
+from peasoup_tpu.serve import (
+    BackoffPolicy,
+    FleetMembership,
+    FleetWorker,
+    HealthContext,
+    HealthFinding,
+    JobSpool,
+    build_context,
+    evaluate,
+    evaluate_spool,
+    fleet_report,
+)
+from peasoup_tpu.serve.health import (
+    CRIT,
+    OK,
+    RULES,
+    WARN,
+    format_findings,
+    rule_hbm_watermark,
+    rule_lease_reap_burst,
+    rule_queue_backlog,
+    rule_retry_spike,
+    rule_stale_host,
+    rule_throughput_regression,
+    slo_summary,
+    worst_severity,
+)
+
+NOW = 100000.0
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+def _sample(host, ts, *, counters=None, gauges=None, timers=None,
+            queue=None, interval_s=5.0):
+    rec = {"v": 1, "ts": ts, "host": host, "pid": 1, "seq": 1,
+           "interval_s": interval_s, "counters": counters or {},
+           "timers": timers or {}, "gauges": gauges or {}}
+    if queue is not None:
+        rec["queue"] = queue
+    return rec
+
+
+def _ctx(samples=(), *, queue=None, running=(), ledger=(),
+         now=NOW, **kw):
+    samples = sorted(samples, key=lambda s: s["ts"])
+    latest = {}
+    for s in samples:
+        latest[s["host"]] = s
+    return HealthContext(
+        now=now, samples=samples,
+        recent=[s for s in samples if s["ts"] >= now - 300.0],
+        latest=latest,
+        queue=queue or {"pending": 0, "running": 0, "done": 0,
+                        "failed": 0},
+        running=list(running), ledger=list(ledger), **kw)
+
+
+def _by_sev(findings):
+    return worst_severity(f.severity for f in findings)
+
+
+# --------------------------------------------------------------------------
+# rule: stale_host
+# --------------------------------------------------------------------------
+
+def test_stale_host_ok_when_fresh():
+    ctx = _ctx([_sample("h0", NOW - 3.0)])
+    assert _by_sev(rule_stale_host(ctx)) == OK
+
+
+def test_stale_host_crit_while_holding_leases():
+    ctx = _ctx([_sample("h0", NOW - 120.0)],
+               running=[{"job_id": "j1", "host": "h0"}])
+    (f,) = rule_stale_host(ctx)
+    assert (f.severity, f.host) == (CRIT, "h0")
+    assert "requeue --expired" in f.message
+    assert f.data["leases"] == 1
+
+
+def test_stale_host_crit_for_leaseholder_without_any_shard():
+    """A host that died before its first sample still trips crit via
+    its lease (age is infinite, threshold finite)."""
+    ctx = _ctx([], running=[{"job_id": "j1", "host": "ghost"}])
+    found = {f.host: f for f in rule_stale_host(ctx)}
+    assert found["ghost"].severity == CRIT
+    assert found["ghost"].data["age_s"] is None
+
+
+def test_stale_host_warn_with_pending_work_waiting():
+    ctx = _ctx([_sample("h0", NOW - 120.0)],
+               queue={"pending": 4, "running": 0, "done": 0,
+                      "failed": 0})
+    (f,) = rule_stale_host(ctx)
+    assert f.severity == WARN and "4 pending" in f.message
+
+
+def test_stale_host_ok_after_clean_departure():
+    """Silent + no leases + empty queue = drained worker exited; the
+    fleet must report healthy again after recovery."""
+    ctx = _ctx([_sample("h0", NOW - 120.0)])
+    (f,) = rule_stale_host(ctx)
+    assert f.severity == OK and "departed cleanly" in f.message
+
+
+def test_stale_host_threshold_scales_with_sample_interval():
+    # 60s-old sample at interval 30 is fresh (threshold 5*30=150s)...
+    ctx = _ctx([_sample("h0", NOW - 60.0, interval_s=30.0)],
+               running=[{"job_id": "j", "host": "h0"}])
+    assert _by_sev(rule_stale_host(ctx)) == OK
+    # ...the same age at interval 5 is stale (threshold 25s)
+    ctx = _ctx([_sample("h0", NOW - 60.0, interval_s=5.0)],
+               running=[{"job_id": "j", "host": "h0"}])
+    assert _by_sev(rule_stale_host(ctx)) == CRIT
+
+
+def test_stale_host_no_shards_no_leases_is_vacuous_ok():
+    (f,) = rule_stale_host(_ctx([]))
+    assert f.severity == OK and "no telemetry" in f.message
+
+
+# --------------------------------------------------------------------------
+# rule: queue_backlog
+# --------------------------------------------------------------------------
+
+def _queue_series(depths, counters=None):
+    return [_sample("h0", NOW - 300.0 + 10.0 * i,
+                    queue={"pending": d}, counters=counters)
+            for i, d in enumerate(depths)]
+
+
+def test_queue_backlog_ok_when_stable_or_shrinking():
+    assert _by_sev(rule_queue_backlog(_ctx(_queue_series(
+        [5, 3, 1, 0])))) == OK
+    assert _by_sev(rule_queue_backlog(_ctx(_queue_series(
+        [2, 2, 2])))) == OK
+
+
+def test_queue_backlog_insufficient_samples_is_ok():
+    (f,) = rule_queue_backlog(_ctx(_queue_series([1, 9])))
+    assert f.severity == OK and "insufficient" in f.message
+
+
+def test_queue_backlog_warn_while_jobs_still_drain():
+    samples = _queue_series([1, 4, 8],
+                            counters={"scheduler.succeeded": 1})
+    (f,) = rule_queue_backlog(_ctx(samples))
+    assert f.severity == WARN and f.data["grew"] == 7
+
+
+def test_queue_backlog_crit_when_nothing_drains():
+    (f,) = rule_queue_backlog(_ctx(_queue_series([1, 4, 8])))
+    assert f.severity == CRIT and "ZERO" in f.message
+
+
+# --------------------------------------------------------------------------
+# rule: retry_spike
+# --------------------------------------------------------------------------
+
+def test_retry_spike_bands():
+    ok = _ctx([_sample("h0", NOW, counters={"scheduler.retried": 1})])
+    assert _by_sev(rule_retry_spike(ok)) == OK
+    warn = _ctx([_sample("h0", NOW,
+                         counters={"scheduler.retried": 3})])
+    assert _by_sev(rule_retry_spike(warn)) == WARN
+    warn2 = _ctx([_sample("h0", NOW,
+                          counters={"scheduler.quarantined": 1})])
+    assert _by_sev(rule_retry_spike(warn2)) == WARN
+    crit = _ctx([_sample("h0", NOW,
+                         counters={"scheduler.quarantined": 2,
+                                   "scheduler.exhausted": 1})])
+    assert _by_sev(rule_retry_spike(crit)) == CRIT
+    crit2 = _ctx([_sample("h0", NOW,
+                          counters={"scheduler.retried": 10})])
+    assert _by_sev(rule_retry_spike(crit2)) == CRIT
+
+
+def test_retry_spike_sums_across_hosts_and_window():
+    samples = [
+        _sample("h0", NOW - 10.0, counters={"scheduler.retried": 2}),
+        _sample("h1", NOW - 5.0, counters={"scheduler.retried": 1}),
+        # outside the 300s window: ignored
+        _sample("h0", NOW - 400.0,
+                counters={"scheduler.retried": 50}),
+    ]
+    (f,) = rule_retry_spike(_ctx(samples))
+    assert f.severity == WARN and f.data["retried"] == 3
+
+
+# --------------------------------------------------------------------------
+# rule: throughput_regression
+# --------------------------------------------------------------------------
+
+def _ledger(values):
+    return [{"kind": "serve", "metrics": {"jobs_per_hour": v}}
+            for v in values]
+
+
+def test_throughput_vacuous_ok_without_baseline():
+    ctx = _ctx([_sample("h0", NOW, gauges={
+        "scheduler.jobs_per_hour": 1.0})], ledger=_ledger([10.0, 12.0]))
+    (f,) = rule_throughput_regression(ctx)
+    assert f.severity == OK and "not enough" in f.message
+
+
+def test_throughput_ok_without_live_gauge():
+    ctx = _ctx([_sample("h0", NOW)],
+               ledger=_ledger([10.0, 12.0, 14.0]))
+    (f,) = rule_throughput_regression(ctx)
+    assert f.severity == OK and "no live" in f.message
+
+
+def test_throughput_bands_vs_ledger_median():
+    ledger = _ledger([10.0, 12.0, 14.0])  # median 12
+    mk = lambda jph: _ctx(
+        [_sample("h0", NOW, gauges={"scheduler.jobs_per_hour": jph})],
+        ledger=ledger)
+    assert _by_sev(rule_throughput_regression(mk(11.0))) == OK
+    assert _by_sev(rule_throughput_regression(mk(4.0))) == WARN
+    assert _by_sev(rule_throughput_regression(mk(2.0))) == CRIT
+
+
+def test_throughput_sums_fleet_hosts():
+    """Per-host gauges are summed: two hosts at 2 jobs/h each make a
+    4 jobs/h fleet, under half the 12 jobs/h ledger median -> warn."""
+    ledger = _ledger([10.0, 12.0, 14.0])
+    ctx = _ctx([
+        _sample("h0", NOW, gauges={"scheduler.jobs_per_hour": 2.0}),
+        _sample("h1", NOW, gauges={"scheduler.jobs_per_hour": 2.0}),
+    ], ledger=ledger)
+    (f,) = rule_throughput_regression(ctx)
+    assert f.severity == WARN
+    assert f.data["current_jobs_per_hour"] == 4.0
+
+
+# --------------------------------------------------------------------------
+# rule: hbm_watermark
+# --------------------------------------------------------------------------
+
+def _hbm_ctx(frac):
+    return _ctx([_sample("h0", NOW, gauges={
+        "hbm.high_water_bytes": frac * 1000.0,
+        "hbm.budget_bytes": 1000.0})])
+
+
+def test_hbm_watermark_bands():
+    assert _by_sev(rule_hbm_watermark(_hbm_ctx(0.5))) == OK
+    assert _by_sev(rule_hbm_watermark(_hbm_ctx(0.95))) == WARN
+    assert _by_sev(rule_hbm_watermark(_hbm_ctx(0.99))) == CRIT
+
+
+def test_hbm_watermark_unknown_is_not_unhealthy():
+    (f,) = rule_hbm_watermark(_ctx([_sample("h0", NOW)]))
+    assert f.severity == OK and "no HBM budget" in f.message
+
+
+# --------------------------------------------------------------------------
+# rule: lease_reap_burst
+# --------------------------------------------------------------------------
+
+def test_lease_reap_bands():
+    mk = lambda n: _ctx([_sample("h0", NOW, counters={
+        "scheduler.lease_reaped": n})] if n else [_sample("h0", NOW)])
+    assert _by_sev(rule_lease_reap_burst(mk(0))) == OK
+    assert _by_sev(rule_lease_reap_burst(mk(1))) == WARN
+    assert _by_sev(rule_lease_reap_burst(mk(3))) == CRIT
+
+
+# --------------------------------------------------------------------------
+# SLO summary
+# --------------------------------------------------------------------------
+
+def _slo_ctx(queue_wait_mean, n=4, job_mean=1.0):
+    timers = {
+        "queue_wait": {"count": n, "host_s": queue_wait_mean * n,
+                       "device_s": 0.0},
+        "job": {"count": n, "host_s": job_mean * n, "device_s": 0.0},
+    }
+    return _ctx([_sample("h0", NOW, timers=timers)])
+
+
+def test_slo_no_data_counts_as_ok():
+    s = slo_summary(_ctx([_sample("h0", NOW)]))
+    assert s["status"] == OK
+    assert s["metrics"]["queue_wait"]["status"] == "no_data"
+
+
+def test_slo_bands_against_targets():
+    ok = slo_summary(_slo_ctx(1.0))
+    assert ok["status"] == OK
+    assert ok["metrics"]["queue_wait"]["p50_s"] == pytest.approx(1.0)
+    warn = slo_summary(_slo_ctx(90.0))  # > 60s p50 target
+    assert warn["status"] == WARN
+    crit = slo_summary(_slo_ctx(200.0))  # > 2x target
+    assert crit["status"] == CRIT
+
+
+def test_slo_custom_targets_and_weighted_percentiles():
+    # two samples: 10 fast claims at 1s, 1 slow at 100s
+    timers_fast = {"queue_wait": {"count": 10, "host_s": 10.0,
+                                  "device_s": 0.0}}
+    timers_slow = {"queue_wait": {"count": 1, "host_s": 100.0,
+                                  "device_s": 0.0}}
+    ctx = _ctx([_sample("h0", NOW - 10, timers=timers_fast),
+                _sample("h1", NOW - 5, timers=timers_slow)],
+               slo={"queue_wait_p50_s": 0.5, "queue_wait_p95_s": 600.0,
+                    "job_p50_s": 900.0, "job_p95_s": 3600.0})
+    s = slo_summary(ctx)
+    m = s["metrics"]["queue_wait"]
+    assert m["p50_s"] == pytest.approx(1.0)  # weight-dominant mean
+    assert m["p95_s"] == pytest.approx(100.0)
+    assert m["n"] == 11
+    assert m["status"] == WARN  # over the 0.5s target, under 2x it
+
+
+# --------------------------------------------------------------------------
+# evaluate: rule isolation, report schema, breach folding
+# --------------------------------------------------------------------------
+
+def test_evaluate_report_schema_and_ok_fleet():
+    report = evaluate(_ctx([_sample("h0", NOW - 1.0)]))
+    assert report["v"] == 1 and report["severity"] == OK
+    assert report["hosts"] == ["h0"]
+    rules = {f["rule"] for f in report["findings"]}
+    assert {"stale_host", "queue_backlog", "retry_spike",
+            "throughput_regression", "hbm_watermark",
+            "lease_reap_burst"} <= rules
+    text = format_findings(report)
+    assert "fleet severity: ok" in text
+    assert "[SLO ]" in text
+
+
+def test_evaluate_folds_slo_breach_into_findings():
+    report = evaluate(_slo_ctx(200.0))
+    breach = [f for f in report["findings"]
+              if f["rule"] == "slo_breach"]
+    assert len(breach) == 1 and breach[0]["severity"] == CRIT
+    assert report["severity"] == CRIT
+
+
+def test_crashing_rule_degrades_to_warn_finding():
+    def _bad_rule(ctx):
+        raise RuntimeError("kaboom")
+
+    RULES.append(_bad_rule)
+    try:
+        report = evaluate(_ctx([_sample("h0", NOW)]))
+    finally:
+        RULES.remove(_bad_rule)
+    errs = [f for f in report["findings"] if f["rule"] == "rule_error"]
+    assert len(errs) == 1 and errs[0]["severity"] == WARN
+    assert "kaboom" in errs[0]["message"]
+    # one bad rule never masks the others
+    assert any(f["rule"] == "stale_host" for f in report["findings"])
+
+
+def test_finding_is_json_serialisable():
+    f = HealthFinding("r", WARN, "m", host="h", data={"n": 1})
+    assert json.loads(json.dumps(f.to_obj()))["host"] == "h"
+
+
+# --------------------------------------------------------------------------
+# build_context from a real spool
+# --------------------------------------------------------------------------
+
+def test_build_context_reads_spool_shards_and_ledger(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit("/tmp/a.fil")
+    spool.claim("w0", host="host-0")
+    ledger = str(tmp_path / "h.jsonl")
+    append_history(make_history_record(
+        "serve", {"jobs_per_hour": 33.0}), ledger)
+    append_history(make_history_record("bench", {"e2e_s": 1.0}),
+                   ledger)
+    from peasoup_tpu.obs.telemetry import TelemetrySampler, shard_path
+    s = TelemetrySampler(
+        shard_path(os.path.join(spool.root, "fleet"), "host-0"),
+        "host-0", 30.0)
+    s.sample_now()
+    ctx = build_context(spool, ledger_path=ledger, now=NOW,
+                        window_s=1e9, slo={"job_p50_s": 7.0})
+    assert ctx.queue["running"] == 1
+    assert ctx.running == [{"job_id": spool.jobs("running")[0].job_id,
+                            "host": "host-0"}]
+    assert [r["metrics"]["jobs_per_hour"] for r in ctx.ledger] == \
+        [33.0]  # kind-filtered
+    assert "host-0" in ctx.latest
+    assert ctx.slo["job_p50_s"] == 7.0
+    assert ctx.slo["queue_wait_p50_s"] == 60.0  # defaults kept
+
+
+# --------------------------------------------------------------------------
+# CLI verbs: health, status --watch
+# --------------------------------------------------------------------------
+
+def test_health_verb_ok_fleet_exits_zero(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    JobSpool(spool_dir)
+    rc = main(["--spool", spool_dir, "health",
+               "--ledger", str(tmp_path / "h.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "fleet severity: ok" in out
+
+
+def test_health_verb_crit_exits_nonzero_and_writes_json(tmp_path,
+                                                        capsys):
+    from peasoup_tpu.obs.telemetry import TelemetrySampler, shard_path
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    spool.submit("/tmp/a.fil")
+    spool.claim("w0", host="host-0")  # lease held...
+    s = TelemetrySampler(
+        shard_path(os.path.join(spool_dir, "fleet"), "host-0"),
+        "host-0", 0.05, clock=lambda: 1.0)  # ...by a long-dead host
+    s.sample_now()
+    out_json = str(tmp_path / "health.json")
+    rc = main(["--spool", spool_dir, "health", "--json", out_json,
+               "--ledger", str(tmp_path / "h.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[CRIT] stale_host host-0" in out
+    doc = json.load(open(out_json))
+    assert doc["severity"] == "crit"
+    assert any(f["rule"] == "stale_host" and f["severity"] == "crit"
+               for f in doc["findings"])
+
+
+def test_health_verb_rejects_non_numeric_slo(tmp_path):
+    from peasoup_tpu.errors import ConfigError
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    JobSpool(spool_dir)
+    with pytest.raises(ConfigError, match="number of seconds"):
+        main(["--spool", spool_dir, "health", "--slo",
+              "queue_wait_p50_s=fast"])
+
+
+def test_health_verb_custom_slo_trips_breach(tmp_path, capsys):
+    from peasoup_tpu.obs.telemetry import TelemetrySampler, shard_path
+    from peasoup_tpu.serve.cli import main
+
+    spool_dir = str(tmp_path / "jobs")
+    JobSpool(spool_dir)
+    s = TelemetrySampler(
+        shard_path(os.path.join(spool_dir, "fleet"), "host-0"),
+        "host-0", 0.05)
+    with REGISTRY.timer("queue_wait"):
+        pass  # ~0s wait, but any positive wait beats a zero target
+    s.sample_now()
+    rc = main(["--spool", spool_dir, "health",
+               "--slo", "queue_wait_p50_s=0", "--slo",
+               "queue_wait_p95_s=0",
+               "--ledger", str(tmp_path / "h.jsonl")])
+    out = capsys.readouterr().out
+    assert rc == 1  # 2x a zero target is a crit breach
+    assert "slo_breach" in out
+
+
+def test_status_watch_renders_table_and_health(tmp_path, capsys):
+    """--watch with an injected sleeper runs N iterations without
+    wall-clock waits and prints the health footer each frame."""
+    from peasoup_tpu.serve.cli import build_parser, cmd_status
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    spool.submit("/tmp/a.fil")
+    worker = FleetWorker(
+        spool, FleetMembership.fake(0, 1),
+        run_job_fn=lambda job: {"candidates": 0},
+        backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+        history_path=str(tmp_path / "h.jsonl"),
+        sleeper=lambda s: None, telemetry_interval_s=30.0)
+    assert worker.drain()["succeeded"] == 1
+
+    args = build_parser().parse_args(
+        ["--spool", spool_dir, "status", "--watch",
+         "--interval", "0.01", "--iterations", "3"])
+    slept = []
+    rc = cmd_status(spool, args, sleeper=slept.append,
+                    clock=lambda: NOW)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert len(slept) == 2  # N-1 pauses for N frames
+    assert out.count("host-0") >= 3  # table re-rendered each frame
+    assert "health:" in out
+    assert "queue:" in out
+
+
+def test_status_watch_stops_on_keyboard_interrupt(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import build_parser, cmd_status
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    args = build_parser().parse_args(
+        ["--spool", spool_dir, "status", "--watch",
+         "--interval", "0.01"])  # no --iterations: forever
+
+    def _interrupt(seconds):
+        raise KeyboardInterrupt
+
+    rc = cmd_status(spool, args, sleeper=_interrupt)
+    assert rc == 0  # ctrl-c is a clean exit, not a traceback
+
+
+# --------------------------------------------------------------------------
+# fleet_report v2 + fake 3-host fleet end-to-end
+# --------------------------------------------------------------------------
+
+def test_fleet_report_v2_embeds_health(tmp_path):
+    spool = JobSpool(str(tmp_path / "jobs"))
+    report = fleet_report(spool)
+    assert report["v"] == 2
+    assert report["health"]["severity"] == OK
+    assert {"severity", "findings", "slo"} <= set(report["health"])
+
+
+def test_three_fake_hosts_drain_with_live_telemetry(tmp_path):
+    """The ISSUE's e2e: a faked 3-host fleet drains with samplers on,
+    every host leaves a ts- shard behind, the merged series carries
+    queue depths + per-interval deltas, and the health verdict on the
+    drained fleet is ok (hosts departed cleanly)."""
+    import threading
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    for i in range(9):
+        spool.submit(f"/tmp/{i}.fil")
+    workers = [
+        FleetWorker(
+            spool, FleetMembership.fake(i, 3),
+            run_job_fn=lambda job: {"candidates": 0},
+            backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+            history_path=str(tmp_path / "h.jsonl"),
+            sleeper=lambda s: None, lease_ttl_s=60.0,
+            telemetry_interval_s=0.05)
+        for i in range(3)
+    ]
+    summaries = [None] * 3
+
+    def _drain(i):
+        summaries[i] = workers[i].drain()
+
+    ts = [threading.Thread(target=_drain, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert sum(s["succeeded"] for s in summaries) == 9
+    # every host's drain summary reports its sampler's work
+    for s in summaries:
+        assert s["telemetry"]["samples"] >= 2
+        assert s["telemetry"]["overhead_s"] < 1.0
+        assert os.path.exists(s["telemetry"]["shard"])
+
+    from peasoup_tpu.obs.telemetry import read_samples, shard_hosts
+    ts_dir = os.path.join(spool.root, "fleet")
+    assert shard_hosts(ts_dir) == ["host-0", "host-1", "host-2"]
+    samples = read_samples(ts_dir)
+    assert all("queue" in s for s in samples)
+    # every completion lands in the deltas (the fake fleet shares one
+    # in-process registry, so each host's cursor also sees the other
+    # hosts' increments; a real fleet is one process per host and each
+    # shard then carries exactly its own — see the cursor tests)
+    done = sum(s["counters"].get("scheduler.succeeded", 0)
+               for s in samples)
+    assert done >= 9
+    # final samples carry the jobs_per_hour gauge set before stop()
+    final = {s["host"]: s for s in samples}
+    assert all(v["gauges"].get("scheduler.jobs_per_hour", 0) > 0
+               for v in final.values())
+
+    report = evaluate_spool(
+        spool, ledger_path=str(tmp_path / "no-ledger.jsonl"))
+    assert report["severity"] == OK
+    assert sorted(report["hosts"]) == ["host-0", "host-1", "host-2"]
+    # fleet_report v2 embeds the same verdict
+    fr = fleet_report(spool)
+    assert fr["v"] == 2 and fr["health"]["severity"] == OK

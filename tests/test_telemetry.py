@@ -1,0 +1,369 @@
+"""Telemetry-plane tests: MetricsCursor delta snapshots (including
+under concurrent increments), event-log flood suppression, the
+TelemetrySampler's shard lifecycle (immediate first sample, extras
+seam, overhead accounting, rotation), the merged torn-tail-tolerant
+reader across skewed host clocks, and the tuning sidecar's measured
+calibration round-trip."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from peasoup_tpu.obs.events import EventLog
+from peasoup_tpu.obs.metrics import REGISTRY, MetricsCursor, MetricsRegistry
+from peasoup_tpu.obs.telemetry import (
+    TelemetrySampler,
+    latest_by_host,
+    read_samples,
+    safe_host,
+    shard_hosts,
+    shard_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+# --------------------------------------------------------------------------
+# MetricsCursor deltas
+# --------------------------------------------------------------------------
+
+def test_cursor_deltas_are_per_interval_not_totals():
+    r = MetricsRegistry()
+    cur = MetricsCursor()
+    r.inc("jobs", 3)
+    with r.timer("span"):
+        pass
+    s1 = r.snapshot(cur)
+    assert s1["deltas"]["counters"] == {"jobs": 3}
+    assert s1["deltas"]["timers"]["span"]["count"] == 1
+    # no activity between snapshots -> empty deltas, totals unchanged
+    s2 = r.snapshot(cur)
+    assert s2["deltas"] == {"counters": {}, "timers": {}}
+    assert s2["counters"]["jobs"] == 3
+    r.inc("jobs", 2)
+    assert r.snapshot(cur)["deltas"]["counters"] == {"jobs": 2}
+
+
+def test_cursor_independent_per_consumer():
+    r = MetricsRegistry()
+    a, b = MetricsCursor(), MetricsCursor()
+    r.inc("x")
+    assert r.snapshot(a)["deltas"]["counters"] == {"x": 1}
+    # b never snapshotted before: sees the full history as one delta
+    r.inc("x")
+    assert r.snapshot(b)["deltas"]["counters"] == {"x": 2}
+    assert r.snapshot(a)["deltas"]["counters"] == {"x": 1}
+
+
+def test_cursor_rebases_after_registry_reset():
+    r = MetricsRegistry()
+    cur = MetricsCursor()
+    r.inc("x", 5)
+    r.snapshot(cur)
+    r.reset()  # totals rewind below the cursor
+    r.inc("x", 2)
+    # clamped at zero, re-based: no negative delta, next delta clean
+    assert r.snapshot(cur)["deltas"]["counters"] == {}
+    r.inc("x", 3)
+    assert r.snapshot(cur)["deltas"]["counters"] == {"x": 3}
+
+
+def test_cursor_concurrent_increments_land_in_exactly_one_delta():
+    """Hammer one counter from 4 threads while a sampler thread takes
+    delta snapshots: the deltas must sum to the final total — no
+    increment lost to or double-counted across a sampling boundary."""
+    r = MetricsRegistry()
+    cur = MetricsCursor()
+    per_thread, threads = 2000, 4
+    stop = threading.Event()
+    seen = []
+
+    def _inc():
+        for _ in range(per_thread):
+            r.inc("hammer")
+
+    def _sample():
+        while not stop.is_set():
+            seen.append(r.snapshot(cur)["deltas"]["counters"].get(
+                "hammer", 0))
+
+    ts = [threading.Thread(target=_inc) for _ in range(threads)]
+    sampler = threading.Thread(target=_sample)
+    sampler.start()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    sampler.join()
+    seen.append(r.snapshot(cur)["deltas"]["counters"].get("hammer", 0))
+    assert sum(seen) == per_thread * threads
+    assert r.snapshot()["counters"]["hammer"] == per_thread * threads
+
+
+# --------------------------------------------------------------------------
+# event-log flood suppression
+# --------------------------------------------------------------------------
+
+def test_event_flood_bounds_disk_lines_not_counters(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    t = [1000.0]
+    log = EventLog(path, flood_limit=3, flood_window_s=60.0,
+                   clock=lambda: t[0])
+    for _ in range(10):
+        log.emit("overflow", "buffer overflowed")
+    # counters and the in-memory summary see all 10...
+    assert log.summary()["overflow"] == 10
+    assert REGISTRY.snapshot()["counters"]["events.overflow"] == 10
+    assert REGISTRY.snapshot()["counters"][
+        "events.flood_suppressed"] == 7
+    # ...but only flood_limit lines persist inside the window
+    lines = [json.loads(x) for x in open(path)]
+    assert [l["kind"] for l in lines] == ["overflow"] * 3
+    # window rollover emits ONE summary stating what was dropped
+    t[0] += 61.0
+    log.emit("overflow", "again")
+    lines = [json.loads(x) for x in open(path)]
+    kinds = [l["kind"] for l in lines]
+    assert kinds == ["overflow"] * 3 + ["event_flood", "overflow"]
+    flood = lines[3]
+    assert flood["data"] == {"kind": "overflow", "suppressed": 7,
+                             "window_s": 60.0}
+    log.close()
+
+
+def test_event_flood_close_flushes_pending_summary(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, flood_limit=1, flood_window_s=3600.0)
+    log.emit("spam", "x")
+    log.emit("spam", "x")
+    log.emit("spam", "x")
+    log.close()  # window never rolled over; close states the drop
+    lines = [json.loads(x) for x in open(path)]
+    assert [l["kind"] for l in lines] == ["spam", "event_flood"]
+    assert lines[1]["data"]["suppressed"] == 2
+
+
+def test_event_flood_distinct_kinds_have_independent_budgets(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, flood_limit=2, flood_window_s=60.0)
+    for _ in range(4):
+        log.emit("a", "m")
+        log.emit("b", "m")
+    kinds = [json.loads(x)["kind"] for x in open(path)]
+    assert kinds.count("a") == 2 and kinds.count("b") == 2
+    log.close()
+
+
+# --------------------------------------------------------------------------
+# TelemetrySampler
+# --------------------------------------------------------------------------
+
+def test_sampler_writes_schema_versioned_deltas_and_extras(tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    r = MetricsRegistry()
+    r.inc("scheduler.succeeded", 2)
+    r.gauge("scheduler.jobs_per_hour", 42.0)
+    s = TelemetrySampler(
+        shard_path(ts_dir, "host-0"), "host-0", 30.0, registry=r,
+        extras=lambda: {"queue": {"pending": 3, "done": 1}})
+    s.start()  # immediate first sample
+    r.inc("scheduler.succeeded", 5)
+    s.stop()  # final sample
+    samples = read_samples(ts_dir)
+    assert len(samples) == 2 == s.samples_written
+    first, last = samples
+    assert first["v"] == 1 and first["host"] == "host-0"
+    assert first["seq"] == 1 and last["seq"] == 2
+    # per-interval deltas, not totals
+    assert first["counters"]["scheduler.succeeded"] == 2
+    assert last["counters"]["scheduler.succeeded"] == 5
+    assert last["gauges"]["scheduler.jobs_per_hour"] == 42.0
+    assert last["queue"] == {"pending": 3, "done": 1}
+    assert last["overhead_s"] >= first["overhead_s"] >= 0.0
+    assert s.overhead_s > 0.0
+
+
+def test_sampler_ticks_on_interval_and_extras_failure_is_recorded(
+        tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    calls = [0]
+
+    def _extras():
+        calls[0] += 1
+        if calls[0] == 2:
+            raise RuntimeError("spool vanished")
+        return {"queue": {"pending": 0}}
+
+    with TelemetrySampler(shard_path(ts_dir, "h"), "h", 0.05,
+                          registry=MetricsRegistry(),
+                          extras=_extras) as s:
+        deadline = threading.Event()
+        while s.samples_written < 4:
+            deadline.wait(0.01)  # avoid bare sleep (PSL008)
+    samples = read_samples(ts_dir)
+    assert len(samples) >= 4
+    # the one failing extras call tainted exactly its own sample
+    errs = [x for x in samples if "extras_error" in x]
+    assert len(errs) == 1 and "spool vanished" in errs[0]["extras_error"]
+    assert all("queue" in x for x in samples if "extras_error" not in x)
+
+
+def test_sampler_rotation_bounds_shards_and_reader_merges(tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    path = shard_path(ts_dir, "h")
+    s = TelemetrySampler(path, "h", 30.0, registry=MetricsRegistry(),
+                         max_shard_bytes=400)
+    n = 0
+    while not os.path.exists(path + ".1"):
+        s.sample_now()
+        n += 1
+        assert n < 100  # a ~150-byte line must rotate a 400B shard
+    s.sample_now()
+    # bounded: exactly two generations, never a .2
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert not os.path.exists(path + ".2")
+    merged = read_samples(ts_dir)
+    # no sample lost across the rotation boundary, order preserved
+    assert [x["seq"] for x in merged] == list(range(1, n + 2))
+
+
+def test_reader_skips_torn_tail_and_corrupt_lines(tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    s = TelemetrySampler(shard_path(ts_dir, "h"), "h", 30.0,
+                         registry=MetricsRegistry())
+    s.sample_now()
+    s.sample_now()
+    with open(s.path, "a") as f:
+        f.write("not json at all\n")
+        f.write('{"v": 1, "no_ts": true}\n')  # dict without ts: dropped
+        f.write('{"v": 1, "ts": 12')  # SIGKILL mid-append
+    samples = read_samples(ts_dir)
+    assert [x["seq"] for x in samples] == [1, 2]
+    # the torn tail must not hide the host from latest_by_host either
+    assert latest_by_host(ts_dir)["h"]["seq"] == 2
+
+
+def test_reader_merges_skewed_host_clocks(tmp_path):
+    """host-b's clock runs 100s ahead: the merge is ts-sorted (so
+    cross-host order follows the skewed clocks) but each host's own
+    samples stay in seq order — the documented contract."""
+    ts_dir = str(tmp_path / "fleet")
+    ta, tb = [1000.0], [1100.0]
+    ra, rb = MetricsRegistry(), MetricsRegistry()
+    sa = TelemetrySampler(shard_path(ts_dir, "a"), "a", 30.0,
+                          registry=ra, clock=lambda: ta[0])
+    sb = TelemetrySampler(shard_path(ts_dir, "b"), "b", 30.0,
+                          registry=rb, clock=lambda: tb[0])
+    for _ in range(3):
+        sa.sample_now()
+        sb.sample_now()
+        ta[0] += 10.0
+        tb[0] += 10.0
+    assert shard_hosts(ts_dir) == ["a", "b"]
+    merged = read_samples(ts_dir)
+    assert [x["ts"] for x in merged] == sorted(x["ts"] for x in merged)
+    for host in ("a", "b"):
+        seqs = [x["seq"] for x in merged if x["host"] == host]
+        assert seqs == [1, 2, 3]
+    # all of a sorts before any of b (the skew is visible, not fatal)
+    assert [x["host"] for x in merged] == ["a"] * 3 + ["b"] * 3
+    latest = latest_by_host(ts_dir)
+    assert latest["a"]["ts"] == 1020.0 and latest["b"]["ts"] == 1120.0
+    # since= filters on the merged timeline
+    assert len(read_samples(ts_dir, since=1100.0)) == 3
+    assert len(read_samples(ts_dir, hosts=["a"])) == 3
+
+
+def test_sampler_io_failure_latches_instead_of_raising(tmp_path):
+    target = tmp_path / "fleet"
+    target.mkdir()
+    shard = target / "ts-h.jsonl"
+    shard.mkdir()  # open() for append will fail with EISDIR
+    s = TelemetrySampler(str(shard), "h", 30.0,
+                         registry=MetricsRegistry())
+    s.sample_now()  # must not raise
+    s.sample_now()
+    assert s.samples_written == 0 and s._io_failed
+
+
+def test_safe_host_sanitises_labels():
+    assert safe_host("pod a/slice:3") == "pod_a_slice_3"
+    assert safe_host("  ") == "host"
+    assert safe_host("host-0") == "host-0"
+
+
+# --------------------------------------------------------------------------
+# tuning calibration round-trip
+# --------------------------------------------------------------------------
+
+def test_calibration_roundtrip_survives_save_tuning(tmp_path):
+    from peasoup_tpu.search.tuning import (
+        DEFAULT_COMPILE_S,
+        DEFAULT_RESEARCH_S,
+        DEFAULT_SLOT_S,
+        calibration_constants,
+        save_tuning,
+        update_calibration,
+    )
+
+    path = str(tmp_path / "tune.json")
+    # no sidecar yet: hardcoded v5e-class fallbacks, flagged unmeasured
+    c = calibration_constants(path)
+    assert not c["measured"]
+    assert (c["slot_s"], c["research_s"], c["compile_s"]) == (
+        DEFAULT_SLOT_S, DEFAULT_RESEARCH_S, DEFAULT_COMPILE_S)
+
+    update_calibration(path, "tpu-v5e", slot_s=4e-6, research_s=1.0,
+                       compile_s=12.0)
+    c1 = calibration_constants(path, "tpu-v5e")
+    assert c1["measured"] and c1["slot_s"] == pytest.approx(4e-6)
+    # EWMA merge (alpha=0.5), not last-write-wins
+    update_calibration(path, "tpu-v5e", slot_s=2e-6)
+    assert calibration_constants(path, "tpu-v5e")["slot_s"] == \
+        pytest.approx(3e-6)
+    # a later capacity-tuning rewrite must not drop the calibration
+    save_tuning(path, "some|search|key", 256, 32)
+    c2 = calibration_constants(path, "tpu-v5e")
+    assert c2["measured"] and c2["slot_s"] == pytest.approx(3e-6)
+    doc = json.load(open(path))
+    assert doc["cap_hw"] == 256 and "calibration" in doc
+
+
+def test_record_run_calibration_uses_compile_timer(tmp_path):
+    from peasoup_tpu.search.tuning import (
+        calibration_constants,
+        record_run_calibration,
+    )
+
+    path = str(tmp_path / "tune.json")
+    r = MetricsRegistry()
+    with r.timer("jit_compile"):
+        pass
+    record_run_calibration(path, "cpu", research_s=0.5, registry=r)
+    c = calibration_constants(path, "cpu")
+    assert c["measured"]
+    assert c["research_s"] == pytest.approx(0.5)
+    assert c["compile_s"] < 21.0  # merged toward the tiny measurement
+
+
+def test_pick_row_capacity_honours_measured_constants():
+    import numpy as np
+
+    from peasoup_tpu.search.tuning import pick_row_capacity
+
+    row_hw = np.array([40] * 63 + [100000], np.int64)
+    # expensive re-search: cover even the pathological row
+    cap_slow = pick_row_capacity(row_hw, 1000, research_s=500.0,
+                                 compile_s=500.0, slot_s=1e-9)
+    # near-free re-search: leave the loud row to the re-search path
+    cap_fast = pick_row_capacity(row_hw, 1000, research_s=1e-6,
+                                 compile_s=0.0, slot_s=1.0)
+    assert cap_fast < cap_slow
