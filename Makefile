@@ -8,8 +8,9 @@ SERVE_SMOKE_DIR ?= /tmp/peasoup-serve-smoke
 FLEET_SMOKE_DIR ?= /tmp/peasoup-fleet-smoke
 BATCH_SMOKE_DIR ?= /tmp/peasoup-batch-smoke
 HEALTH_SMOKE_DIR ?= /tmp/peasoup-health-smoke
+PIPELINE_SMOKE_DIR ?= /tmp/peasoup-pipeline-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -92,3 +93,12 @@ batch-smoke:
 health-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.health_smoke \
 	    --dir $(HEALTH_SMOKE_DIR)
+
+# dispatch-pipeline smoke test: drain 4 chunked-driver observations at
+# pipeline_depth=1 then depth=2 and assert both drains measure a sane
+# device_duty_cycle gauge, record chunk.pipeline_depth, write a serve
+# ledger record carrying the duty gauge, and produce BIT-IDENTICAL
+# per-source candidates (the pipeline is pure scheduling)
+pipeline-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.pipeline_smoke \
+	    --dir $(PIPELINE_SMOKE_DIR)

@@ -32,6 +32,15 @@ before any number is reported.  Both drains append their own
 batch_fill metrics included), so the B=1 vs B=B ``jobs_per_hour``
 pair is trendable from the same history the serve workers feed.
 
+``--pipeline-depth [D]`` (default 2) runs the dispatch-pipeline
+throughput benchmark instead: the same synthetic observations are
+drained twice through the CHUNKED driver — serial
+(``pipeline_depth=1``, the pre-ISSUE-11 dispatch→fetch→decode loop)
+and pipelined (depth D) — with per-source store records asserted
+bit-identical before any number is reported.  Both drains report
+their measured ``device_duty_cycle`` (device seconds per wall second
+over the span ledger), the gauge the pipeline exists to raise.
+
 Every successful run appends one structured record (git sha, device,
 timers, per-stage device time, roofline utilization, compile counts,
 parity verdict) to ``benchmarks/history.jsonl`` through the shared
@@ -235,6 +244,93 @@ def run_batch_bench(b: int) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def pipeline_depth_arg(argv: list[str]) -> int | None:
+    """``--pipeline-depth [D]``: run the dispatch-pipeline throughput
+    benchmark at depth D vs the serial depth-1 reference (default 2)."""
+    if "--pipeline-depth" not in argv:
+        return None
+    i = argv.index("--pipeline-depth")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(2, int(argv[i + 1]))
+    return 2
+
+
+def run_pipeline_bench(depth: int) -> int:
+    """``bench.py --pipeline-depth D``: depth-1 vs depth-D survey
+    drains through the chunked driver over the same synthetic
+    observations; prints one JSON line with both ``jobs_per_hour`` and
+    ``device_duty_cycle`` figures plus the speedup, after asserting
+    the pipelined drain's per-source store records are bit-identical
+    to the serial reference (a pipeline that changes candidates is a
+    bug, not a speedup)."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve import CandidateStore, JobSpool, SurveyWorker
+    from peasoup_tpu.tools.batch_smoke import (
+        _store_fingerprint, _write_synthetic,
+    )
+
+    work = tempfile.mkdtemp(prefix="peasoup-pipeline-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        # dm_chunk forces the chunked driver — the pipeline's home turf
+        overrides = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0,
+                     "limit": 10, "dm_chunk": 4, "accel_block": 1}
+        obs = [
+            _write_synthetic(os.path.join(work, f"obs{i}.fil"), seed=i)
+            for i in range(4)
+        ]
+        modes = {}
+        fps = {}
+        for label, d in (("serial", 1), ("pipelined", depth)):
+            REGISTRY.reset()
+            spool = JobSpool(os.path.join(work, f"jobs_{label}"))
+            for path in obs:
+                spool.submit(path, dict(overrides, pipeline_depth=d))
+            summary = SurveyWorker(
+                spool, history_path=history, sleeper=lambda s: None,
+            ).drain()
+            snap = REGISTRY.snapshot()
+            modes[label] = {
+                "pipeline_depth": d,
+                "jobs_per_hour": summary["jobs_per_hour"],
+                "elapsed_s": summary["elapsed_s"],
+                "device_duty_cycle": snap["gauges"].get(
+                    "device_duty_cycle", 0.0),
+            }
+            if summary["succeeded"] != len(obs):
+                print(json.dumps({
+                    "metric": "pipelined_dispatch_jobs_per_hour",
+                    "value": None, "pipeline_depth": depth,
+                    "error": f"{label} drain succeeded "
+                             f"{summary['succeeded']}/{len(obs)}",
+                }))
+                return 1
+            fps[label] = _store_fingerprint(CandidateStore(os.path.join(
+                work, f"jobs_{label}", "candidates.jsonl")), obs)
+        parity_ok = fps["serial"] == fps["pipelined"]
+        out = {
+            "metric": "pipelined_dispatch_jobs_per_hour",
+            "value": modes["pipelined"]["jobs_per_hour"],
+            "unit": "jobs/h",
+            "pipeline_depth": depth,
+            "vs_serial": round(
+                modes["pipelined"]["jobs_per_hour"]
+                / max(modes["serial"]["jobs_per_hour"], 1e-9), 3),
+            "device_duty_cycle": modes["pipelined"]["device_duty_cycle"],
+            "modes": modes,
+            "parity": ("per-source candidates bit-identical"
+                       if parity_ok else "PER-SOURCE PARITY FAILED"),
+        }
+        print(json.dumps(out))
+        return 0 if parity_ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -252,6 +348,9 @@ def main() -> None:
     b = batch_arg(sys.argv[1:])
     if b is not None:
         sys.exit(run_batch_bench(b))
+    d = pipeline_depth_arg(sys.argv[1:])
+    if d is not None:
+        sys.exit(run_pipeline_bench(d))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook
@@ -431,11 +530,18 @@ def main() -> None:
             stage_device_seconds,
         )
 
+        # the last timed run's duty cycle (ISSUE 11): device seconds
+        # per wall second over the span ledger — trendable next to the
+        # wall-clock so "did the pipeline stop hiding host work" is
+        # answerable from the same history
+        duty = REGISTRY.snapshot()["gauges"].get("device_duty_cycle")
         append_history(make_history_record(
             "bench",
             metrics={"e2e_s": round(elapsed, 4),
                      "median_s": round(median_s, 4),
                      "vs_baseline": out["vs_baseline"],
+                     **({"device_duty_cycle": duty}
+                        if isinstance(duty, (int, float)) else {}),
                      **stage_metrics},
             timers={k: v for k, v in timers.items()
                     if isinstance(v, (int, float))},

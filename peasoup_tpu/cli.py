@@ -96,6 +96,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sub-band stage-2 residual smearing bound in "
                         "samples (0 = bit-identical to the direct "
                         "sweep; larger = more anchor compression)")
+    p.add_argument("--pipeline_depth", type=int, default=2,
+                   help="async dispatch pipeline depth (chunked "
+                        "driver): 2 overlaps the next chunk's dispatch "
+                        "and the async result fetch with host decode "
+                        "(default), 1 is the unpipelined A/B "
+                        "reference; candidates are bit-identical at "
+                        "every depth")
     p.add_argument("--trial_nbits", type=int, default=32,
                    choices=(8, 32),
                    help="dedispersed trial sample format: 32 keeps f32 "

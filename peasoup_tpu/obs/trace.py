@@ -292,6 +292,27 @@ def span(name: str, metric: str | None = None, **attrs):
     return _TRACER.span(name, metric=metric, **attrs)
 
 
+def span_cursor() -> int:
+    """Position cursor into the tracer's record list; pass it to
+    :func:`device_seconds` to sum device time over a window (the
+    ``device_duty_cycle`` ledger, ISSUE 11)."""
+    with _TRACER._lock:
+        return len(_TRACER._records)
+
+
+def device_seconds(since: int = 0) -> float:
+    """Total measured device (+link) seconds over the spans closed
+    since a :func:`span_cursor` checkpoint.  Spans charge device time
+    only where the host actually waited (``handle.block`` /
+    ``add_device_time``), so ``device_seconds / wall`` is the fraction
+    of the window the devices were the bottleneck — the
+    ``device_duty_cycle`` gauge both drivers and the worker drain
+    emit.  A tracer reset (or the MAX_SPANS cap) can shrink the
+    record list below ``since``; the slice is then empty, never an
+    error."""
+    return sum(r.device_s for r in _TRACER.records()[since:])
+
+
 # --------------------------------------------------------------------------
 # Chrome trace-event export
 # --------------------------------------------------------------------------

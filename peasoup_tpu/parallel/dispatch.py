@@ -1,0 +1,65 @@
+"""Reusable async dispatch pipeline (ISSUE 11).
+
+The chunked driver's double-buffer — dispatch chunk k+1 before chunk
+k's results are fetched, so host decode/distill/checkpoint hide behind
+device time (`src/pipeline_multi.cu`'s stream overlap, host-side) —
+generalised to any dispatch depth and shared between drivers:
+
+* ``dispatch(item) -> token`` enqueues device work and returns without
+  blocking (a jax dispatch is async by design);
+* ``start_fetch(token)`` (optional) begins the device->host copy of
+  the token's results immediately, so the link transfer overlaps the
+  next item's compute (``utils/hostfetch.start_fetch``);
+* ``retire(token, item) -> result`` completes the fetch and does the
+  host-side work (decode, distill, checkpoint).
+
+``depth`` is the number of dispatches in flight before the oldest is
+retired: depth=1 is the unpipelined A/B reference (dispatch, retire,
+dispatch, ...), depth=2 reproduces the chunked driver's historical
+double-buffer exactly (dispatch 0, dispatch 1, retire 0, dispatch 2,
+retire 1, ...), higher depths keep more device work queued at the cost
+of that many result buffers resident in HBM.
+
+Deliberately jax-free: tokens are opaque, so tests drive it with plain
+lists and the serve layer can import it without the mesh stack.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..errors import ConfigError
+
+
+class DispatchPipeline:
+    """Run ``items`` through dispatch -> [start_fetch] -> retire with
+    up to ``depth`` dispatches in flight; results keep item order."""
+
+    def __init__(self, dispatch, retire, *, depth: int = 2,
+                 start_fetch=None):
+        if depth < 1:
+            raise ConfigError(
+                f"pipeline depth must be >= 1, got {depth}")
+        self.dispatch = dispatch
+        self.retire = retire
+        self.depth = int(depth)
+        self.start_fetch = start_fetch
+        #: high-water of concurrently in-flight dispatches (observable
+        #: proof the requested depth was actually reached)
+        self.max_inflight = 0
+
+    def run(self, items) -> list:
+        results: list = []
+        inflight: deque = deque()
+        for item in items:
+            token = self.dispatch(item)
+            if self.start_fetch is not None:
+                self.start_fetch(token)
+            inflight.append((token, item))
+            if len(inflight) > self.max_inflight:
+                self.max_inflight = len(inflight)
+            while len(inflight) >= self.depth:
+                results.append(self.retire(*inflight.popleft()))
+        while inflight:
+            results.append(self.retire(*inflight.popleft()))
+        return results

@@ -38,16 +38,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..errors import ConfigError, HBMBudgetError
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
-from ..obs.trace import span
+from ..obs.trace import span, span_cursor
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
     quantise_trials_u8,
     split_flat_channels,
 )
+from .dispatch import DispatchPipeline
 from ..search.pipeline import (
+    FoldInputCache,
     PulsarSearch,
     SearchResult,
+    fold_epilogue_core,
     search_one_accel,
     search_one_accel_legacy,
     whiten_core,
@@ -60,7 +63,9 @@ from ..ops.peaks import segmented_unique_peaks
 
 from ..utils.hostfetch import (  # re-exported; also used below
     fetch_to_host,
+    finish_fetch,
     put_global,
+    start_fetch,
 )
 
 
@@ -737,7 +742,16 @@ def build_chunked_search(
         # every output here is trivially dm-varying, so skip the check
         check_vma=False,
     )
-    return jax.jit(mapped)
+    # the per-chunk uploads (sub-band tables + delays/accs/uidx) are
+    # consumed by exactly one dispatch each — donate their buffers so
+    # depth>=2 pipelining doesn't hold two chunks' worth of input HBM.
+    # The resident operands (data parts, resample tables, birdies) are
+    # reused by every chunk and must NOT be donated.  CPU jax can't
+    # donate (every dispatch would warn) so the hint is dropped there.
+    donate = ()
+    if jax.default_backend() != "cpu":
+        donate = tuple(range(n_parts, n_parts + len(sb_specs) + 3))
+    return jax.jit(mapped, donate_argnums=donate)
 
 
 class MeshPulsarSearch(PulsarSearch):
@@ -840,6 +854,37 @@ class MeshPulsarSearch(PulsarSearch):
         driver must not alias this one."""
         return f"{driver}:ndev={self.ndev}:" + self._tune_key()
 
+    def _expected_raw_len(self) -> int:
+        """Length of the packed raw-bytes vector ``_pack_raw`` builds
+        (f32 count at nbits=32, else ``pack_bits``'s ceil-divided byte
+        count) — the shape a prefetch-staged upload must match."""
+        n = self.fil.nsamps * self.fil.nchans
+        nbits = self.fil.header.nbits
+        if nbits == 32:
+            return n
+        spb = 8 // nbits
+        return (n + spb - 1) // spb
+
+    def _staged_raw_device(self, rep):
+        """Consume a prefetch-thread device staging (ISSUE 11): the
+        survey worker's ``ObservationPrefetcher`` packs + device_puts
+        the raw filterbank bytes while the PREVIOUS job computes
+        (``SurveyWorker._stage_observation``), parking the result on
+        ``self._staged_raw``.  Returns the replicated device array, or
+        None when nothing usable was staged (wrong geometry after a
+        header surprise, multi-process runs — where the staging thread
+        can't build the global array safely — or no worker at all)."""
+        staged = getattr(self, "_staged_raw", None)
+        if staged is None or jax.process_count() != 1:
+            return None
+        dtype = np.float32 if self.fil.header.nbits == 32 else np.uint8
+        if (getattr(staged, "shape", None) != (self._expected_raw_len(),)
+                or staged.dtype != dtype):
+            return None
+        METRICS.inc("scheduler.staged_raw_hits")
+        # no-op when the staging thread already committed this sharding
+        return jax.device_put(staged, rep)
+
     def dedisperse_sharded(self) -> jax.Array:
         """Dedisperse with the DM axis sharded across the mesh.
 
@@ -868,17 +913,19 @@ class MeshPulsarSearch(PulsarSearch):
                 delays = np.zeros((ndm_p, self.fil.nchans), np.int32)
                 delays[:ndm] = self.delays
                 nbits = self.fil.header.nbits
-                if nbits == 32:
-                    raw = np.ascontiguousarray(
-                        self.fil.data, np.float32).ravel()
-                else:
-                    raw = pack_bits(self.fil.data.ravel(), nbits)
+                raw_d = self._staged_raw_device(rep)
+                if raw_d is None:
+                    if nbits == 32:
+                        raw = np.ascontiguousarray(
+                            self.fil.data, np.float32).ravel()
+                    else:
+                        raw = pack_bits(self.fil.data.ravel(), nbits)
+                    raw_d = put_global(raw, rep)
                 km = (
                     np.asarray(self.killmask, dtype=np.float32)
                     if self.killmask is not None
                     else np.ones(self.fil.nchans, np.float32)
                 )
-                raw_d = put_global(raw, rep)
                 delays_d = put_global(delays, shard)
                 km_d = put_global(km, rep)
             nbits = self.fil.header.nbits
@@ -928,15 +975,19 @@ class MeshPulsarSearch(PulsarSearch):
             else np.ones(self.fil.nchans, np.float32)
         )
         nbits = self.fil.header.nbits
-        if nbits == 32:  # float data: nothing to pack
-            raw = np.ascontiguousarray(self.fil.data, np.float32).ravel()
-        else:
-            raw = pack_bits(self.fil.data.ravel(), nbits)
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
+        raw_d = self._staged_raw_device(rep)
+        if raw_d is None:
+            if nbits == 32:  # float data: nothing to pack
+                raw = np.ascontiguousarray(
+                    self.fil.data, np.float32).ravel()
+            else:
+                raw = pack_bits(self.fil.data.ravel(), nbits)
+            raw_d = put_global(raw, rep)
         uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
         self._dev_inputs = (
-            put_global(raw, rep),
+            raw_d,
             put_global(delays, shard),
             put_global(np.asarray(killmask, dtype=np.float32), rep),
             put_global(accs, shard),
@@ -1455,6 +1506,82 @@ class MeshPulsarSearch(PulsarSearch):
         trials = self._dedisperse_rows_device(self.delays[uniq])
         return trials, row_map
 
+    def _fused_fold_provider(self, dm_idxs):
+        """On-device fold fusion (ISSUE 11): (dm_idxs) -> (fold_program,
+        row_map) for ``_finalise``'s ``fold_fuser`` seam.
+
+        The returned program composes candidate-row dedispersion with
+        :func:`fold_epilogue_core` in ONE dispatch: unpack the resident
+        packed filterbank bytes, dedisperse just the ``len(uniq)``
+        candidate DM rows, (optionally) quantise exactly as
+        ``_maybe_quantise`` would, then whiten/resample/fold/optimise.
+        The trial lattice never leaves the device — the only
+        device->host traffic of the whole folding phase is the packed
+        optimum-per-candidate buffer, so candidates cross the link
+        once per job.  Numerically identical to the host-resident
+        path: the per-row dedisperse -> (quantise) -> epilogue chain
+        is the same jnp graph, just composed into one program."""
+        from ..ops.unpack import unpack_bits_device
+
+        uniq = sorted(set(int(i) for i in dm_idxs))
+        row_map = {dm: r for r, dm in enumerate(uniq)}
+        rep = NamedSharding(self.mesh, P())
+        nbits = self.fil.header.nbits
+        if getattr(self, "_dev_inputs", None) is not None:
+            # the fused search program's residents already hold the
+            # packed bytes and killmask — zero re-upload
+            raw_d, _dl, km_d = self._dev_inputs[:3]
+        elif getattr(self, "_dedisp_sharded_state", None) is not None:
+            _fn, raw_d, _dl, km_d = self._dedisp_sharded_state
+        else:
+            if nbits == 32:  # float data: nothing to pack
+                raw = np.ascontiguousarray(
+                    self.fil.data, np.float32).ravel()
+            else:
+                raw = pack_bits(self.fil.data.ravel(), nbits)
+            km = (
+                np.asarray(self.killmask, dtype=np.float32)
+                if self.killmask is not None
+                else np.ones(self.fil.nchans, np.float32)
+            )
+            raw_d = put_global(raw, rep)
+            km_d = put_global(km, rep)
+        delays_d = put_global(self.delays[uniq].astype(np.int32), rep)
+        nchans, nsamps_in = self.fil.nchans, self.fil.nsamps
+        out_nsamps = self.out_nsamps
+        use_km = self.killmask is not None
+        quantise = self.config.trial_nbits == 8
+
+        @partial(jax.jit, static_argnames=(
+            "bin_width", "fold_nsamps", "tsamp", "nbins", "nints",
+            "max_shift", "block", "nu", "nb", "w"))
+        def fused(raw, km, delays, packed_in, periods, *, bin_width,
+                  fold_nsamps, tsamp, nbins, nints, max_shift, block,
+                  nu, nb, w):
+            # same transient channel-major view as dedisperse_sharded
+            vals = unpack_bits_device(raw, nbits)[: nsamps_in * nchans]
+            data = vals.reshape(nsamps_in, nchans).T.astype(jnp.float32)
+            if use_km:
+                data = data * km[:, None]
+            trials = dedisperse(data, delays, out_nsamps)
+            if quantise:
+                trials = quantise_trials_u8(trials, nbits, nchans)
+            return fold_epilogue_core(
+                trials, packed_in, periods, bin_width, fold_nsamps,
+                tsamp, nbins, nints, max_shift, block, nu, nb, w)
+
+        def fold_program(packed_d, periods_d, bin_width, fold_nsamps,
+                         tsamp, nbins, nints, max_shift, block, nu,
+                         nb, w):
+            METRICS.inc("runs.fused_fold_dispatches")
+            return fused(
+                raw_d, km_d, delays_d, packed_d, periods_d,
+                bin_width=bin_width, fold_nsamps=fold_nsamps,
+                tsamp=tsamp, nbins=nbins, nints=nints,
+                max_shift=max_shift, block=block, nu=nu, nb=nb, w=w)
+
+        return fold_program, row_map
+
     def _run_chunked(self, plan, acc_lists, namax, timers, t_total, ckpt,
                      ckpt_done):
         """Bounded-HBM production driver: ONE dispatch per DM chunk.
@@ -1692,47 +1819,53 @@ class MeshPulsarSearch(PulsarSearch):
                     d0_u, pos_u, step_u, birdies_d, widths_d,
                 )
 
-        if todo:
+        hw_count = 0  # observed high-waters for the tune sidecar
+        hw_valid = 0
+        row_hw = np.zeros(ndm, np.int64)  # per-DM-row max counts
+        first_dispatch = True
+
+        def dispatch_item(item):
             # the first dispatch triggers the (possibly minutes-long
             # remote) XLA compile; charge it separately from steady
             # -state dispatch latency.  The multi-GB filterbank h2d
             # transfer (async since _device_inputs_chunked) overlaps
             # the compile; the residual wait is charged to "upload" so
             # the first chunk's fetch time stays comparable to the rest
-            out = dispatch(*todo[0])
-            phases["compile"] = time.time() - tc
-            tc = time.time()
-            # a computed scalar over every part proves the h2d upload
-            # landed (device_put'ed arrays keep a host copy, so
-            # np.asarray of them returns instantly).  The probe queues
-            # behind chunk 1's execution, so "upload" here = residual
-            # transfer after compile + one chunk's device time; the
-            # multi-GB transfer dominates it at production scale
-            np.asarray(jax.jit(
-                lambda *ps: sum(p[-1].astype(jnp.float32) for p in ps)
-            )(*data_parts))
-            phases["upload"] = time.time() - tc
-        pending = out if todo else None
-        hw_count = 0  # observed high-waters for the tune sidecar
-        hw_valid = 0
-        row_hw = np.zeros(ndm, np.int64)  # per-DM-row max counts
-        for k, (ci, rows) in enumerate(todo):
-            # double-buffer: the NEXT chunk is dispatched before this
-            # chunk's results are fetched/decoded, so host decode,
-            # distillation and checkpointing hide behind device time
-            if k + 1 < len(todo):
-                tp = time.time()
-                nxt = dispatch(*todo[k + 1])
-                phases["dispatch"] += time.time() - tp
+            nonlocal first_dispatch, tc
+            if first_dispatch:
+                first_dispatch = False
+                out = dispatch(*item)
+                phases["compile"] = time.time() - tc
+                tc = time.time()
+                # a computed scalar over every part proves the h2d
+                # upload landed (device_put'ed arrays keep a host copy,
+                # so np.asarray of them returns instantly).  The probe
+                # queues behind chunk 1's execution, so "upload" here =
+                # residual transfer after compile + one chunk's device
+                # time; the multi-GB transfer dominates at production
+                # scale
+                np.asarray(jax.jit(
+                    lambda *ps: sum(p[-1].astype(jnp.float32)
+                                    for p in ps)
+                )(*data_parts))
+                phases["upload"] = time.time() - tc
+                return out
+            tp = time.time()
+            out = dispatch(*item)
+            phases["dispatch"] += time.time() - tp
+            return out
+
+        def retire_item(token, item):
+            nonlocal hw_count, hw_valid
+            ci, rows = item
             tp = time.time()
             with span("Chunk-Fetch", chunk=int(ci)) as sp_f:
                 tf = time.time()
-                packed = fetch_to_host(pending)
+                packed = finish_fetch(token)
                 # the fetch wait IS device (+link) time: the dispatch
                 # span closed at async return, so the wait lands here
                 sp_f.add_device_time(time.time() - tf)
             phases["fetch"] += time.time() - tp
-            pending = nxt if k + 1 < len(todo) else None
             tp = time.time()
             with span("Peak-Decode", metric="peak_decode",
                       chunk=int(ci)):
@@ -1792,6 +1925,22 @@ class MeshPulsarSearch(PulsarSearch):
                       f"({time.time() - t0:.0f}s; "
                       + " ".join(f"{p}={v:.1f}" for p, v in
                                  phases.items()) + ")", flush=True)
+
+        # generalised double-buffer (ISSUE 11): at depth d the pipeline
+        # keeps up to d chunk programs in flight, retiring the oldest
+        # (fetch -> decode -> distill -> checkpoint, all host work)
+        # only when the window is full — so host post-processing hides
+        # behind device execution.  depth=2 reproduces the historical
+        # dispatch(k+1)-then-fetch(k) interleave exactly; depth=1 is
+        # the unpipelined A/B reference.  start_fetch begins the d2h
+        # copy of each chunk's packed buffer the moment its program is
+        # enqueued, so the link transfer overlaps the next dispatch.
+        depth = max(1, int(getattr(cfg, "pipeline_depth", 2) or 1))
+        METRICS.gauge("chunk.pipeline_depth", depth)
+        DispatchPipeline(
+            dispatch_item, retire_item, depth=depth,
+            start_fetch=start_fetch,
+        ).run(todo)
 
         tp = time.time()
         # drop OUR per-chunk executables before the re-search / fold
@@ -2092,6 +2241,9 @@ class MeshPulsarSearch(PulsarSearch):
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
+        # duty-cycle ledger origin: _finalise sums device seconds of
+        # every span recorded from here on (ISSUE 11)
+        self._span_cursor0 = span_cursor()
         METRICS.gauge("hbm.data_bytes", self._data_bytes())
         METRICS.gauge("search.n_dm_trials", len(self.dm_list))
         METRICS.gauge("search.fft_size", self.size)
@@ -2125,11 +2277,17 @@ class MeshPulsarSearch(PulsarSearch):
                     trials_provider=self._fold_trials_provider,
                 )
             else:
-                trials = (
-                    self._maybe_quantise(self.dedisperse_sharded())
-                    if cfg.npdmp > 0 else None
+                # fused fold (ISSUE 11): instead of materialising every
+                # DM row's trial HBM-resident just to fold a handful of
+                # candidates, _finalise hands the candidate DM set to
+                # _fused_fold_provider, whose program dedisperses ONLY
+                # those rows and folds them in the same dispatch — the
+                # (ndm, out_nsamps) trials array never exists and the
+                # only device->host traffic is the folded profiles
+                result = self._finalise(
+                    dm_cands, None, timers, t_total,
+                    fold_fuser=self._fused_fold_provider,
                 )
-                result = self._finalise(dm_cands, trials, timers, t_total)
             ckpt.remove()
             return result
         ndm_p = self._padded_trial_count()
@@ -2229,8 +2387,14 @@ class MeshPulsarSearch(PulsarSearch):
                 # ONE gather over ICI/DCN -> host; ``trials`` stays on
                 # device for the folding phase.  The fetch wait is the
                 # device (plus link) share of this stage's wall-clock.
+                # start_fetch begins the d2h copy the moment XLA
+                # finishes the packed buffer, so the link transfer
+                # overlaps whatever Python does before the blocking
+                # finish (depth=1 A/B keeps the old synchronous fetch)
+                if getattr(cfg, "pipeline_depth", 2) > 1:
+                    start_fetch(packed)
                 tf = time.time()
-                packed = fetch_to_host(packed)
+                packed = finish_fetch(packed)
                 sp.add_device_time(time.time() - tf)
             with span("Peak-Decode", metric="peak_decode"):
                 (per_dm_groups, mx_count, mx_valid, counts_arr,
@@ -2427,6 +2591,7 @@ class MeshPulsarSearch(PulsarSearch):
 
         timers: dict[str, float] = {}
         t_total = time.time()
+        self._span_cursor0 = span_cursor()  # duty-cycle ledger origin
         METRICS.gauge("search.n_dm_trials", ndm)
         METRICS.gauge("search.fft_size", self.size)
         METRICS.gauge("search.n_devices", self.ndev)
@@ -2487,9 +2652,11 @@ class MeshPulsarSearch(PulsarSearch):
                       gflops=round(fused_gflops, 3),
                       ) as sp:
                 packed, trials = program(*inputs)
+                if getattr(cfg, "pipeline_depth", 2) > 1:
+                    start_fetch(packed)  # d2h overlaps host-side prep
                 tf = time.time()
                 # (B, ndev*blk_len): row b IS the B=1 packed layout
-                packed = fetch_to_host(packed)
+                packed = finish_fetch(packed)
                 sp.add_device_time(time.time() - tf)
             beam_fail, decoded = {}, {}
             with span("Peak-Decode", metric="peak_decode", batch=B):
@@ -2588,7 +2755,7 @@ class MeshPulsarSearch(PulsarSearch):
                     if ckpts[b]:
                         ckpts[b].save(ckpt_done)
                 # folding inputs are per-beam: never share the cache
-                self._fold_input_cache = {}
+                self._fold_input_cache = FoldInputCache()
                 results[b] = self._finalise(
                     dm_cands, trials[b], dict(timers), t_total,
                     config=configs[b],

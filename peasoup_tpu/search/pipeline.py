@@ -33,7 +33,7 @@ from ..errors import ConfigError
 from ..io.sigproc import Filterbank
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
-from ..obs.trace import span
+from ..obs.trace import device_seconds, span, span_cursor
 from ..ops import (
     dedisperse,
     delay_table,
@@ -770,6 +770,7 @@ class PulsarSearch:
         from ..utils import ProgressBar
 
         install_compile_hook()
+        self._span_cursor0 = span_cursor()
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()
@@ -882,13 +883,21 @@ class PulsarSearch:
         return results
 
     def _finalise(self, dm_cands, trials, timers, t_total,
-                  trials_provider=None, config=None) -> SearchResult:
+                  trials_provider=None, config=None,
+                  fold_fuser=None) -> SearchResult:
         """Shared tail of every driver (`pipeline_multi.cu:362-391`):
         cross-DM distillation, scoring, folding, limit, result.
 
         ``trials_provider``: bounded-HBM drivers pass a callable
         (dm_idxs) -> (trials, row_map) instead of resident trials; the
         candidate DM rows are re-dedispersed only if folding runs.
+
+        ``fold_fuser``: resumed-path alternative (ISSUE 11) — a
+        callable (dm_idxs) -> (fold_program, row_map) that fuses the
+        candidate rows' dedispersion INTO the fold dispatch
+        (``MeshPulsarSearch._fused_fold_provider``), so the trial
+        lattice never exists off-device and candidates cross the link
+        exactly once.  Checked before ``trials_provider``.
 
         ``config``: batched dispatch passes the per-beam config (same
         search parameters by construction, beam-specific paths) so the
@@ -914,18 +923,28 @@ class PulsarSearch:
         t0 = time.time()
         if cfg.npdmp > 0:
             dm_row_lookup = None
-            if trials is None and trials_provider is not None:
+            fold_program = None
+            n_fold_rows = 0
+            if trials is None and (fold_fuser is not None
+                                   or trials_provider is not None):
                 # same filter fold_candidates applies — don't
                 # re-dedisperse rows that will never be folded
                 fold_dms = {
                     c.dm_idx for c in cands[: cfg.npdmp]
                     if FOLD_MIN_PERIOD < 1.0 / c.freq < FOLD_MAX_PERIOD
                 }
-                if fold_dms:
+                if fold_dms and fold_fuser is not None:
+                    fold_program, dm_row_lookup = fold_fuser(fold_dms)
+                    n_fold_rows = len(dm_row_lookup)
+                elif fold_dms:
                     trials, dm_row_lookup = trials_provider(fold_dms)
-            if trials is not None:
+            if trials is not None or fold_program is not None:
                 budget = int(cfg.hbm_budget_gb * 1e9)
-                resident = self._data_bytes() + trials.size * 4 + (2 << 30)
+                # fused fold: the candidate rows' trials are a transient
+                # inside the fold program, not a resident buffer
+                trial_bytes = (trials.size * 4 if trials is not None
+                               else n_fold_rows * self.out_nsamps * 4)
+                resident = self._data_bytes() + trial_bytes + (2 << 30)
                 free = budget - resident
                 fold_costs = getattr(self, "_stage_costs", None)
                 if free < budget // 4:
@@ -957,12 +976,23 @@ class PulsarSearch:
                         dm_row_lookup=dm_row_lookup,
                         hbm_free_bytes=max(free, 0),
                         device_cache=self.__dict__.setdefault(
-                            "_fold_input_cache", {}),
+                            "_fold_input_cache", FoldInputCache()),
+                        fold_program=fold_program,
                     )
         timers["folding"] = time.time() - t0
 
         cands = cands[: cfg.limit]
         timers["total"] = time.time() - t_total
+        # the run's device_duty_cycle (ISSUE 11): measured device/link
+        # seconds over the span ledger since run() start, per
+        # wall-clock second — 1.0 means the devices never waited on
+        # the host.  A gauge, so it lands in run_report.json and the
+        # telemetry samples automatically; the worker drain overwrites
+        # it with the drain-level figure for the serve ledger.
+        if timers["total"] > 0:
+            METRICS.gauge("device_duty_cycle", round(
+                device_seconds(getattr(self, "_span_cursor0", 0))
+                / timers["total"], 4))
         return SearchResult(
             candidates=CandidateCollection(cands),
             dm_list=self.dm_list,
@@ -995,17 +1025,52 @@ def _rewhiten_core(tim, bin_width):
 _rewhiten_for_fold = jax.jit(_rewhiten_core, static_argnames=("bin_width",))
 
 
-@partial(
-    jax.jit,
-    static_argnames=("bin_width", "fold_nsamps", "tsamp", "nbins", "nints",
-                     "max_shift", "block", "nu", "nb", "w"),
-)
-def _batched_fold_program(
+class FoldInputCache(dict):
+    """Bounded LRU for the fold's digest-keyed device inputs (ISSUE 11
+    satellite): a long-lived worker folds many distinct candidate
+    sets, and the previous plain dict pinned every packed-table upload
+    for the worker's lifetime.  ``get`` refreshes recency; inserting
+    past ``maxsize`` drops the least-recently-used entry (counted in
+    ``fold.cache_evicted``; jax refcounting frees its device buffers).
+    Still a dict, so every ``device_cache=`` call site — including
+    tests passing plain ``{}`` — keeps working."""
+
+    #: a handful of entries covers the intended hits (benchmark
+    #: reruns, checkpoint resumes); each entry pins its packed-table
+    #: device buffers, so small beats complete
+    maxsize = 8
+
+    def __init__(self, maxsize: int | None = None):
+        super().__init__()
+        if maxsize is not None:
+            self.maxsize = int(maxsize)
+
+    def get(self, key, default=None):
+        if key not in self:
+            return default
+        val = super().pop(key)
+        super().__setitem__(key, val)  # re-insert = most recent
+        return val
+
+    def __setitem__(self, key, value):
+        if key in self:
+            super().pop(key)
+        elif len(self) >= self.maxsize:
+            super().pop(next(iter(self)))
+            METRICS.inc("fold.cache_evicted")
+        super().__setitem__(key, value)
+
+
+def fold_epilogue_core(
     trials, packed_in, periods, bin_width, fold_nsamps, tsamp, nbins,
     nints, max_shift, block, nu, nb, w,
 ):
     """Re-whiten + resample + fold + optimise every candidate in ONE
     dispatch (vmapped); ships home only the optimum per candidate.
+    Plain traceable function so the mesh driver can compose it behind
+    an on-device dedispersion of the candidate rows (the fused fold
+    epilogue, ISSUE 11); ``_batched_fold_program`` below is its jitted
+    standalone face.
 
     Whitens once per DISTINCT DM row, exactly as the reference groups
     candidates by dm_idx and re-whitens each trial once
@@ -1065,9 +1130,19 @@ def _batched_fold_program(
     ])
 
 
+#: the standalone jitted fold program (the host-resident-trials path).
+#: Keeps this exact attribute name: obs/metrics.py's
+#: jit_program_cache_sizes probes it for the run report.
+_batched_fold_program = partial(
+    jax.jit,
+    static_argnames=("bin_width", "fold_nsamps", "tsamp", "nbins", "nints",
+                     "max_shift", "block", "nu", "nb", "w"),
+)(fold_epilogue_core)
+
+
 def fold_candidates(
     cands: list[Candidate],
-    trials: jax.Array,
+    trials: jax.Array | None,
     trials_nsamps: int,
     tsamp: float,
     npdmp: int,
@@ -1080,19 +1155,30 @@ def fold_candidates(
     dm_row_lookup: dict | None = None,
     hbm_free_bytes: int | None = None,
     device_cache: dict | None = None,
+    fold_program=None,
 ) -> None:
     """Fold + optimise the top ``npdmp`` candidates in place, then sort
     by max(snr, folded_snr) (`folder.hpp:424-434,25-31`).
 
     ``dm_row_lookup`` maps candidate ``dm_idx`` to a row of ``trials``
     when the caller passes a compacted trials array (the bounded-HBM
-    path re-dedisperses only the candidate DM rows)."""
+    path re-dedisperses only the candidate DM rows).
+
+    ``fold_program``: fused-fold alternative (ISSUE 11) — a callable
+    with ``_batched_fold_program``'s signature minus ``trials`` that
+    materialises the candidate rows on device itself
+    (``MeshPulsarSearch._fused_fold_provider``); ``trials`` may then
+    be None, and ``trials_nsamps`` must be the row length the program
+    produces (>= its prev_power_of_two is guaranteed)."""
+    if trials is None and fold_program is None:
+        raise ConfigError(
+            "fold_candidates needs resident trials or a fold_program")
     # both drivers hand over trials with >= prev_power_of_two(
     # trials_nsamps) real columns; a narrower caller gets zero-padded
     # so the fold FFT length stays the reference's power of two
     # (matching the old DeviceTimeSeries zero-fill semantics)
     nsamps = prev_power_of_two(trials_nsamps)
-    if nsamps > trials.shape[1]:
+    if trials is not None and nsamps > trials.shape[1]:
         trials = jnp.pad(trials, ((0, 0), (0, nsamps - trials.shape[1])))
     tobs = nsamps * tsamp
     bin_width = 1.0 / tobs
@@ -1167,6 +1253,11 @@ def fold_candidates(
     opt_folds = np.empty((n, nints, nbins), np.float32)
     opt_profs = np.empty((n, nbins), np.float32)
     cache = device_cache if device_cache is not None else {}
+    # either the caller's fused program (dedisperses the candidate
+    # rows on device) or the resident-trials epilogue — identical
+    # post-``trials`` signatures, so the loop below is agnostic
+    fp = (fold_program if fold_program is not None
+          else (lambda *a: _batched_fold_program(trials, *a)))
     for b0 in range(0, n, batch):
         b1 = min(b0 + batch, n)
         m = b1 - b0
@@ -1199,8 +1290,8 @@ def fold_candidates(
                    jnp.asarray(periods_np[b0:b1]))
             cache[pkey] = dev
         packed_d, periods_d = dev
-        packed = fetch_to_host(_batched_fold_program(
-            trials, packed_d, periods_d, bin_width, nsamps,
+        packed = fetch_to_host(fp(
+            packed_d, periods_d, bin_width, nsamps,
             float(tsamp), nbins, nints, fold_ms, fold_block,
             nu, nb_t, w,
         ))

@@ -141,6 +141,16 @@ class SearchConfig:
     # the search identity key
     events_log: str = ""
     metrics_json: str = ""
+    # async dispatch pipeline depth (parallel/dispatch.py, ISSUE 11):
+    # number of device dispatches in flight before the oldest chunk's
+    # results are fetched/decoded.  2 = the historical double-buffer
+    # (steady-state host work hides behind device time, and the packed
+    # result fetch starts async at dispatch); 1 = unpipelined A/B
+    # reference; higher keeps more result buffers HBM-resident.
+    # Scheduling-only — candidates are bit-identical at every depth —
+    # so never part of the search identity key (checkpoints and tune
+    # records survive a depth change).
+    pipeline_depth: int = 2
     # span-trace export (obs/trace.py): Chrome trace-event JSON,
     # loadable in Perfetto/chrome://tracing; multihost runs merge all
     # hosts' spans into the one file process 0 writes.  Empty =

@@ -395,6 +395,55 @@ def rule_lease_reap_burst(ctx: HealthContext) -> list[HealthFinding]:
         data=data)]
 
 
+@health_rule
+def rule_device_duty_cycle(ctx: HealthContext) -> list[HealthFinding]:
+    """Per-host device duty cycle (ISSUE 11): device seconds per wall
+    second from the span ledger.  A LOW duty cycle while jobs are
+    still queued means the accelerators are idling behind host work —
+    the round-trip wall the dispatch pipeline exists to remove: <50%
+    warn, <20% crit.  With an empty queue the hosts are expected to
+    idle, so the rule reports ok regardless of the gauge; hosts
+    without the gauge (old samples, non-search workers) are skipped —
+    unknown is not unhealthy."""
+    pending = int(ctx.queue.get("pending", 0) or 0)
+    out = []
+    for host in sorted(ctx.latest):
+        gauges = ctx.latest[host].get("gauges", {})
+        duty = gauges.get("device_duty_cycle")
+        if duty is None:
+            continue
+        duty = float(duty)
+        data = {"device_duty_cycle": round(duty, 4),
+                "queue_pending": pending}
+        if pending <= 0:
+            out.append(HealthFinding(
+                "device_duty_cycle", OK,
+                f"duty cycle {duty:.2f} with an empty queue (idle by "
+                f"design)", host=host, data=data))
+        elif duty < 0.2:
+            out.append(HealthFinding(
+                "device_duty_cycle", CRIT,
+                f"duty cycle {duty:.2f} with {pending} job(s) queued "
+                f"— devices starved behind host work", host=host,
+                data=data))
+        elif duty < 0.5:
+            out.append(HealthFinding(
+                "device_duty_cycle", WARN,
+                f"duty cycle {duty:.2f} with {pending} job(s) queued "
+                f"— dispatch pipeline not keeping devices fed",
+                host=host, data=data))
+        else:
+            out.append(HealthFinding(
+                "device_duty_cycle", OK,
+                f"duty cycle {duty:.2f} with {pending} job(s) queued",
+                host=host, data=data))
+    if not out:
+        return [HealthFinding(
+            "device_duty_cycle", OK,
+            "no device_duty_cycle gauges reported", data={})]
+    return out
+
+
 # -- SLO summary -----------------------------------------------------------
 
 def _weighted_percentile(pairs: list[tuple[float, float]],

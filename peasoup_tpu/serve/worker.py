@@ -45,7 +45,7 @@ from dataclasses import replace
 from ..errors import ConfigError
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
-from ..obs.trace import span
+from ..obs.trace import device_seconds, span, span_cursor
 from .queue import JobRecord, JobSpool
 from .retry import (
     QUARANTINE,
@@ -70,15 +70,26 @@ class ObservationPrefetcher:
     fills the NEXT batch's observations while the current batch is on
     device; when full, the oldest slot is evicted (its read result is
     simply dropped — prefetch is only ever a hint).
+
+    ``device_stage`` (ISSUE 11) extends the prefetch one stage toward
+    the device: after a successful read the same thread calls
+    ``device_stage(fil, job)`` — the worker's pack + ``device_put`` of
+    the raw bytes — so the h2d upload ALSO overlaps the previous job's
+    search.  The staged value of the most recent successful ``take``
+    is parked on ``self.last_staged`` (None on misses or when staging
+    failed; staging failures never fail the prefetch — the read result
+    alone is still a hit).
     """
 
-    def __init__(self, slots: int = 1):
+    def __init__(self, slots: int = 1, device_stage=None):
         self.slots = max(1, int(slots))
-        # path -> {"thread", "result", "error"}; insertion-ordered so
-        # eviction drops the oldest prefetch first
+        self.device_stage = device_stage
+        self.last_staged = None
+        # path -> {"thread", "result", "error", "staged", "job"};
+        # insertion-ordered so eviction drops the oldest prefetch first
         self._inflight: dict[str, dict] = {}
 
-    def start(self, path: str) -> None:
+    def start(self, path: str, job=None) -> None:
         if path in self._inflight:
             return  # already in flight (or landed) for this path
         while len(self._inflight) >= self.slots:
@@ -86,7 +97,8 @@ class ObservationPrefetcher:
             slot = self._inflight.pop(oldest)
             if slot["thread"].is_alive():
                 slot["thread"].join()  # reads are short next to a search
-        slot = {"thread": None, "result": None, "error": None}
+        slot = {"thread": None, "result": None, "error": None,
+                "staged": None, "job": job}
 
         def _read():
             from ..io.sigproc import read_filterbank
@@ -95,6 +107,13 @@ class ObservationPrefetcher:
                 slot["result"] = read_filterbank(path)
             except BaseException as exc:
                 slot["error"] = exc
+                return
+            if self.device_stage is not None and slot["job"] is not None:
+                try:
+                    slot["staged"] = self.device_stage(
+                        slot["result"], slot["job"])
+                except BaseException:
+                    pass  # a hint, never a failure: upload on claim
 
         slot["thread"] = threading.Thread(
             target=_read, daemon=True, name="serve-prefetch")
@@ -102,15 +121,36 @@ class ObservationPrefetcher:
         slot["thread"].start()
 
     def take(self, path: str):
+        self.last_staged = None
         slot = self._inflight.pop(path, None)
         if slot is None:
+            # plain slot miss (a different job won the claim): routine
+            # at the drain tail, so a counter is enough
             METRICS.inc("scheduler.prefetch_misses")
             return None
         slot["thread"].join()
         if slot["error"] is not None or slot["result"] is None:
+            # classified miss (ISSUE 11 satellite): the claimer's
+            # synchronous re-read will raise the real exception in job
+            # context, but the EVENT log should already say what the
+            # background read hit and how retry.py would class it
+            err = slot["error"]
+            kind = classify_failure(err) if err is not None else "unknown"
             METRICS.inc("scheduler.prefetch_misses")
+            METRICS.inc(f"scheduler.prefetch_miss.{kind}")
+            warn_event(
+                "prefetch_miss",
+                f"background prefetch of {path} failed "
+                f"({type(err).__name__ if err is not None else 'no result'}"
+                f"; classified {kind}); falling back to a synchronous "
+                f"read",
+                path=path, classification=kind,
+                error=(f"{type(err).__name__}: {err}"
+                       if err is not None else ""),
+            )
             return None
         METRICS.inc("scheduler.prefetch_hits")
+        self.last_staged = slot.get("staged")
         return slot["result"]
 
 
@@ -155,7 +195,17 @@ class SurveyWorker:
         #: sampler.  The shard lands in the spool's ``fleet/`` dir so
         #: ``health`` / ``status --watch`` see single-host workers too
         self.telemetry_interval_s = float(telemetry_interval_s)
-        self._prefetcher = ObservationPrefetcher(slots=self.batch)
+        #: observation-granularity pipeline depth (ISSUE 11): how many
+        #: jobs ahead the prefetcher reads (and device-stages).  Jobs
+        #: are still CLAIMED one at a time — lookahead uses peeks, so a
+        #: crashed worker never holds leases on unstarted jobs
+        self.pipeline_depth = max(1, int(getattr(
+            base_config, "pipeline_depth", 2) or 1))
+        self._prefetcher = ObservationPrefetcher(
+            slots=max(self.batch, self.pipeline_depth),
+            device_stage=(None if single_device
+                          else self._stage_observation),
+        )
         #: geometry bucket -> jobs served (program-reuse accounting)
         self.geometries: dict[tuple, int] = {}
 
@@ -211,6 +261,37 @@ class SurveyWorker:
             METRICS.inc("scheduler.plan_reuse")
         self.geometries[gkey] = self.geometries.get(gkey, 0) + 1
         return fil, search
+
+    def _stage_observation(self, fil, job: JobRecord):
+        """Prefetch device stage (ISSUE 11): pack + upload the raw
+        filterbank bytes from the prefetch thread, so the h2d transfer
+        overlaps the PREVIOUS job's device time instead of sitting on
+        the claim's critical path.  Runs the same lossless trim as
+        ``_build_search`` so the staged vector matches the geometry
+        the search will ask for (``_staged_raw_device`` re-validates
+        shape/dtype before trusting it).  Single-process only: the
+        multi-host ``put_global`` assembly is not thread-safe against
+        a concurrently dispatching main thread."""
+        import jax
+
+        if self.single_device or jax.process_count() != 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..io.sigproc import Filterbank
+        from ..parallel.mesh import MeshPulsarSearch
+        from ..utils.hostfetch import put_global
+
+        cfg = self._job_config(job)
+        search = MeshPulsarSearch(fil, cfg, max_devices=self.max_devices)
+        keep = search.size + search.max_delay + 1
+        if fil.nsamps > keep:
+            hdr = replace(fil.header, nsamples=keep)
+            fil = Filterbank(header=hdr, data=fil.data[:keep])
+        raw = search._pack_raw(fil)
+        staged = put_global(raw, NamedSharding(search.mesh, P()))
+        METRICS.inc("scheduler.staged_raw_uploads")
+        return staged
 
     # -- batched dispatch (ISSUE 9) ----------------------------------------
 
@@ -405,16 +486,23 @@ class SurveyWorker:
             os.path.join(self.spool.work_dir(job.job_id),
                          "events.jsonl"))
         fil = self._prefetcher.take(job.input) if self.prefetch else None
+        staged = self._prefetcher.last_staged if self.prefetch else None
         if fil is None:
             with span("Observation-Read", metric="obs_read",
                       input=job.input):
                 fil = read_filterbank(job.input)
         fil, search = self._build_search(fil, cfg)
-        # overlap the NEXT observation's read+unpack with this search
+        if staged is not None:
+            # prefetch-thread upload (ISSUE 11): _device_inputs /
+            # dedisperse_sharded consume it if the geometry matches
+            search._staged_raw = staged
+        # overlap the next pipeline_depth-1 observations' read+unpack
+        # (and their pack+upload, via the prefetcher's device stage)
+        # with this search; depth=1 is the unpipelined A/B reference
         if self.prefetch:
-            nxt = self.spool.peek()
-            if nxt is not None:
-                self._prefetcher.start(nxt.input)
+            for rec in self.spool.pending_jobs()[
+                    : self.pipeline_depth - 1]:
+                self._prefetcher.start(rec.input, job=rec)
         result = search.run()
         write_search_output(result, cfg.outdir)
         ingested = self.store.ingest(
@@ -520,6 +608,7 @@ class SurveyWorker:
         install_compile_hook()
         sampler = self._start_telemetry()
         t0 = time.time()
+        span_c0 = span_cursor()  # drain-level duty-cycle ledger origin
         claimed = succeeded = 0
         try:
             while max_jobs is None or claimed < max_jobs:
@@ -547,6 +636,15 @@ class SurveyWorker:
             jobs_per_hour = (succeeded / (elapsed / 3600.0)
                              if elapsed > 0 else 0.0)
             METRICS.gauge("scheduler.jobs_per_hour", jobs_per_hour)
+            # drain-level device_duty_cycle (ISSUE 11): device/link
+            # seconds across EVERY job's spans over drain wall-clock —
+            # 1.0 means the devices never idled between jobs.
+            # Overwrites the per-run figure _finalise left, so the
+            # serve ledger and the final telemetry sample carry the
+            # drain-level number (the health rule reads this gauge)
+            duty = (device_seconds(span_c0) / elapsed
+                    if elapsed > 0 else 0.0)
+            METRICS.gauge("device_duty_cycle", round(duty, 4))
         finally:
             # stop AFTER the jobs_per_hour gauge so the final sample
             # carries the drain's headline figure
@@ -625,6 +723,12 @@ class SurveyWorker:
                     counters.get("scheduler.batched_dispatches", 0)),
                 "batch_fill": int(
                     counters.get("scheduler.batch_fill", 0)),
+                # pipelined dispatch (ISSUE 11): the drain's device
+                # seconds per wall second; perf_report's serve table
+                # shows it next to jobs_per_hour
+                "device_duty_cycle": float(
+                    snap.get("gauges", {}).get("device_duty_cycle",
+                                               0.0)),
             },
             stage_device_s=stage_device_seconds(snap),
             config={

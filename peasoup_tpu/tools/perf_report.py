@@ -47,6 +47,14 @@ GATE_METRIC = "e2e_s"
 #: pre-ISSUE-6 ledgers stay green.
 STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s")
 
+#: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
+#: device seconds per wall second — a drop means the dispatch pipeline
+#: stopped hiding host work).  The gate inverts its ratio for these;
+#: they are not gated by default (CPU smoke figures are noise) but
+#: ``--stage-metrics device_duty_cycle`` gates them correctly.
+HIGHER_IS_BETTER_METRICS = ("device_duty_cycle", "vs_baseline",
+                            "jobs_per_hour")
+
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
 
@@ -159,8 +167,11 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
     """Serve-throughput history out of the ``kind:"serve"`` ledger
     records every worker drain appends: ``jobs_per_hour`` next to the
     batched-dispatch engagement figures (``batch``, dispatches, mean
-    fill) and the fleet host, so "did batching engage" and "which host
-    is slow" are answerable from the default report view."""
+    fill), the drain's ``device_duty_cycle`` (ISSUE 11 — device
+    seconds per wall second; low duty with work queued means the
+    pipeline is starving the devices) and the fleet host, so "did
+    batching engage" and "which host is slow" are answerable from the
+    default report view."""
     records = load_history(ledger or default_ledger_path(),
                            kinds=("serve",))
     if not records:
@@ -171,7 +182,8 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
     lines = [f"serve throughput ({len(records)} drain record(s); "
              f"newest last):",
              f"  {'ts':<20}{'host':<12}{'ok/claimed':>11}"
-             f"{'jobs/h':>10}{'batch':>6}{'disp':>6}{'fill':>6}"]
+             f"{'jobs/h':>10}{'batch':>6}{'disp':>6}{'fill':>6}"
+             f"{'duty':>6}"]
     for rec in records[-limit:]:
         m = rec.get("metrics", {})
         cfg = rec.get("config", {})
@@ -180,12 +192,15 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
                 if disp else "-")
         ok_claimed = (f"{int(m.get('jobs_succeeded', 0))}/"
                       f"{int(m.get('jobs_claimed', 0))}")
+        duty = m.get("device_duty_cycle")
         lines.append(
             f"  {str(rec.get('ts', ''))[:19]:<20}"
             f"{str(cfg.get('host') or '-')[:11]:<12}"
             f"{ok_claimed:>11}"
             f"{float(m.get('jobs_per_hour', 0.0)):>10.4g}"
-            f"{int(m.get('batch', 1)):>6}{disp:>6}{fill:>6}")
+            f"{int(m.get('batch', 1)):>6}{disp:>6}{fill:>6}"
+            + (f"{float(duty):>6.2f}"
+               if isinstance(duty, (int, float)) else f"{'-':>6}"))
     if jph:
         lines.append(f"  jobs/h trend: {sparkline(jph)}  "
                      f"(median {_median(jph):.4g}, last {jph[-1]:.4g})")
@@ -218,7 +233,9 @@ def regression_gate(records: list[dict], metric: str = GATE_METRIC,
                     threshold: float = 1.4) -> tuple[int, str]:
     """(exit_code, message).  0 = clean or not enough history; 1 =
     regression (head median exceeds the trailing-window median by more
-    than ``threshold`` x)."""
+    than ``threshold`` x).  Metrics in ``HIGHER_IS_BETTER_METRICS``
+    invert the ratio, so a duty-cycle COLLAPSE trips the same
+    threshold a wall-clock blow-up does."""
     vals = metric_series(records).get(metric, [])
     if len(vals) < 2:
         return 0, (f"gate: only {len(vals)} `{metric}` record(s) — "
@@ -233,11 +250,19 @@ def regression_gate(records: list[dict], metric: str = GATE_METRIC,
     base_med = _median(base_vals)
     if base_med <= 0:
         return 0, f"gate: non-positive baseline for `{metric}` (pass)"
-    ratio = head_med / base_med
+    if metric in HIGHER_IS_BETTER_METRICS:
+        if head_med <= 0:
+            return 1, (f"REGRESSION gate: {metric} collapsed to "
+                       f"{head_med:.4g} (higher is better)")
+        ratio = base_med / head_med
+    else:
+        ratio = head_med / base_med
     desc = (f"gate: {metric} head median {head_med:.4g} "
             f"(n={len(head_vals)}) vs trailing median {base_med:.4g} "
             f"(n={len(base_vals)}) -> {ratio:.2f}x "
-            f"(threshold {threshold:.2f}x)")
+            f"(threshold {threshold:.2f}x"
+            + (", inverted: higher is better)"
+               if metric in HIGHER_IS_BETTER_METRICS else ")"))
     if ratio > threshold:
         return 1, "REGRESSION " + desc
     return 0, "OK " + desc

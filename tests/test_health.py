@@ -29,6 +29,7 @@ from peasoup_tpu.serve.health import (
     RULES,
     WARN,
     format_findings,
+    rule_device_duty_cycle,
     rule_hbm_watermark,
     rule_lease_reap_burst,
     rule_queue_backlog,
@@ -282,6 +283,38 @@ def test_lease_reap_bands():
     assert _by_sev(rule_lease_reap_burst(mk(0))) == OK
     assert _by_sev(rule_lease_reap_burst(mk(1))) == WARN
     assert _by_sev(rule_lease_reap_burst(mk(3))) == CRIT
+
+
+# --------------------------------------------------------------------------
+# rule: device_duty_cycle
+# --------------------------------------------------------------------------
+
+def _duty_ctx(duty, pending):
+    return _ctx(
+        [_sample("h0", NOW, gauges={"device_duty_cycle": duty})],
+        queue={"pending": pending, "running": 0, "done": 0,
+               "failed": 0})
+
+
+def test_device_duty_cycle_bands_with_queued_work():
+    assert _by_sev(rule_device_duty_cycle(_duty_ctx(0.8, 5))) == OK
+    assert _by_sev(rule_device_duty_cycle(_duty_ctx(0.35, 5))) == WARN
+    assert _by_sev(rule_device_duty_cycle(_duty_ctx(0.05, 5))) == CRIT
+
+
+def test_device_duty_cycle_idle_queue_is_ok():
+    # a starved gauge with NOTHING queued is idle by design, not a
+    # stall — the rule must not page on a drained fleet
+    (f,) = rule_device_duty_cycle(_duty_ctx(0.0, 0))
+    assert f.severity == OK and "empty queue" in f.message
+
+
+def test_device_duty_cycle_unknown_is_not_unhealthy():
+    (f,) = rule_device_duty_cycle(
+        _ctx([_sample("h0", NOW)],
+             queue={"pending": 3, "running": 0, "done": 0,
+                    "failed": 0}))
+    assert f.severity == OK and "no device_duty_cycle" in f.message
 
 
 # --------------------------------------------------------------------------

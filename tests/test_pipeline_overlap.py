@@ -1,0 +1,293 @@
+"""ISSUE 11: depth-2 async dispatch pipeline + on-device fold fusion.
+
+Covers the DispatchPipeline scheduling contract, the trace-proven
+dispatch/fetch overlap on the chunked driver (and its absence at
+depth 1), bit-identical candidates across pipeline depths, the fused
+fold program against the resident-trials fold, the bounded
+FoldInputCache, classified prefetch misses, and the device_duty_cycle
+ledger gauge.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.parallel.dispatch import DispatchPipeline
+from peasoup_tpu.search.plan import SearchConfig
+
+
+# ---------------------------------------------------------------------------
+# DispatchPipeline unit contract (pure python, no jax)
+# ---------------------------------------------------------------------------
+
+
+def _instrumented(events, depth, items, start_fetch=False):
+    def dispatch(item):
+        events.append(("d", item))
+        return f"tok{item}"
+
+    def retire(token, item):
+        events.append(("r", item))
+        assert token == f"tok{item}"
+        return item * 10
+
+    sf = None
+    if start_fetch:
+        def sf(token):  # noqa: E306
+            events.append(("f", token))
+    pipe = DispatchPipeline(dispatch, retire, depth=depth, start_fetch=sf)
+    return pipe, pipe.run(items)
+
+
+def test_pipeline_depth1_is_serial():
+    events = []
+    _, results = _instrumented(events, 1, [0, 1, 2])
+    assert events == [("d", 0), ("r", 0), ("d", 1), ("r", 1),
+                      ("d", 2), ("r", 2)]
+    assert results == [0, 10, 20]
+
+
+def test_pipeline_depth2_keeps_one_chunk_in_flight():
+    """The historical double-buffer order: dispatch N+1 is enqueued
+    BEFORE chunk N is retired, so the device computes while the host
+    decodes."""
+    events = []
+    pipe, results = _instrumented(events, 2, [0, 1, 2])
+    assert events == [("d", 0), ("d", 1), ("r", 0), ("d", 2),
+                      ("r", 1), ("r", 2)]
+    assert results == [0, 10, 20]
+    assert pipe.max_inflight == 2
+
+
+def test_pipeline_depth3_window():
+    events = []
+    pipe, results = _instrumented(events, 3, list(range(5)))
+    assert events == [("d", 0), ("d", 1), ("d", 2), ("r", 0),
+                      ("d", 3), ("r", 1), ("d", 4), ("r", 2),
+                      ("r", 3), ("r", 4)]
+    assert results == [0, 10, 20, 30, 40]
+    assert pipe.max_inflight == 3
+
+
+def test_pipeline_start_fetch_runs_at_dispatch_time():
+    """start_fetch(token) must fire immediately after each dispatch —
+    before ANY retire of that token — so the d2h copy overlaps the
+    next chunk's compute."""
+    events = []
+    _, _ = _instrumented(events, 2, [0, 1], start_fetch=True)
+    assert events == [("d", 0), ("f", "tok0"), ("d", 1), ("f", "tok1"),
+                      ("r", 0), ("r", 1)]
+
+
+def test_pipeline_fewer_items_than_depth():
+    events = []
+    pipe, results = _instrumented(events, 4, [0, 1])
+    assert events == [("d", 0), ("d", 1), ("r", 0), ("r", 1)]
+    assert results == [0, 10]
+    assert pipe.max_inflight == 2
+
+
+def test_pipeline_empty_and_bad_depth():
+    from peasoup_tpu.errors import ConfigError
+
+    assert DispatchPipeline(lambda i: i, lambda t, i: i).run([]) == []
+    with pytest.raises(ConfigError):
+        DispatchPipeline(lambda i: i, lambda t, i: i, depth=0)
+
+
+# ---------------------------------------------------------------------------
+# Chunked-driver overlap + depth parity (small synthetic observation)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def synth_fil(tmp_path_factory):
+    """Small 8-bit observation with a pulse train (batch_smoke recipe)."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.io.sigproc import (
+        Filterbank, SigprocHeader, write_filterbank,
+    )
+
+    rng = np.random.default_rng(7)
+    nsamps, nchans = 4096, 16
+    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
+    data[::16] += 60
+    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
+                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
+    path = tmp_path_factory.mktemp("pipeline") / "synth.fil"
+    write_filterbank(str(path), Filterbank(header=hdr, data=data))
+    return read_filterbank(str(path))
+
+
+def _chunked_cfg(depth, **kw):
+    # dm_chunk=1 over the 8-device test mesh -> 3 chunks per device,
+    # enough pipeline stages to observe (or rule out) overlap
+    return SearchConfig(dm_start=0.0, dm_end=20.0, acc_start=-5.0,
+                        acc_end=5.0, acc_pulse_width=64000.0, npdmp=0,
+                        limit=10, min_snr=6.0, dm_chunk=1, accel_block=1,
+                        pipeline_depth=depth, **kw)
+
+
+def _cand_tuples(result):
+    return [(float(c.freq), float(c.snr), float(c.dm), float(c.acc),
+             int(c.nh), float(c.folded_snr))
+            for c in result.candidates]
+
+
+def _run_traced(fil, depth, path):
+    from peasoup_tpu.obs.trace import get_tracer, write_merged_trace
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.tools.trace_report import load_events, rebuild_spans
+
+    get_tracer().reset()
+    result = MeshPulsarSearch(fil, _chunked_cfg(depth)).run()
+    write_merged_trace(str(path), tracer=get_tracer(),
+                       gather=lambda b: [b], process_index=0)
+    return result, rebuild_spans(load_events(str(path)))
+
+
+def _chunk_spans(spans):
+    dispatches = {s["args"]["chunk"]: s for s in spans
+                  if s["name"].startswith("Chunked-Search-")}
+    fetches = {s["args"]["chunk"]: s for s in spans
+               if s["name"] == "Chunk-Fetch"}
+    assert set(dispatches) == set(fetches)
+    return dispatches, fetches
+
+
+def test_chunked_depth2_overlaps_depth1_does_not(synth_fil, tmp_path):
+    """The ledger proof of ISSUE 11's tentpole: at depth 2 the trace
+    shows dispatch N+1 enqueued before fetch N completes; at depth 1
+    every fetch strictly precedes the next dispatch.  And the pipeline
+    is pure scheduling — candidates are bit-identical across depths."""
+    r2, spans2 = _run_traced(synth_fil, 2, tmp_path / "d2.trace.json")
+    r1, spans1 = _run_traced(synth_fil, 1, tmp_path / "d1.trace.json")
+
+    assert _cand_tuples(r1) == _cand_tuples(r2)
+    assert len(r2.candidates) > 0
+
+    d2, f2 = _chunk_spans(spans2)
+    assert len(d2) >= 2, "need >=2 chunks to observe pipelining"
+    for ci in sorted(d2)[:-1]:
+        fetch_end = f2[ci]["ts"] + f2[ci]["dur_ms"] * 1e3
+        assert d2[ci + 1]["ts"] < fetch_end, (
+            f"depth 2 must dispatch chunk {ci + 1} before fetch "
+            f"{ci} completes")
+
+    d1, f1 = _chunk_spans(spans1)
+    for ci in sorted(d1)[:-1]:
+        assert d1[ci + 1]["ts"] >= f1[ci]["ts"] + f1[ci]["dur_ms"] * 1e3, (
+            f"depth 1 must retire chunk {ci} before dispatching "
+            f"{ci + 1}")
+
+
+def test_chunked_run_reports_duty_cycle_and_depth(synth_fil, tmp_path):
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+
+    MeshPulsarSearch(synth_fil, _chunked_cfg(2)).run()
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges.get("chunk.pipeline_depth") == 2
+    assert "device_duty_cycle" in gauges
+    assert 0.0 <= gauges["device_duty_cycle"] <= 1.5
+
+
+# ---------------------------------------------------------------------------
+# On-device fold fusion: fused program == resident-trials fold
+# ---------------------------------------------------------------------------
+
+
+def test_fused_fold_matches_resident_trials_fold(synth_fil):
+    """_fused_fold_provider's one-dispatch unpack->dedisperse->fold
+    must reproduce the resident-trials fold bit for bit (same device
+    ops on the same rows, only the materialisation point moves)."""
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.pipeline import fold_candidates
+
+    cfg = SearchConfig(dm_start=0.0, dm_end=20.0, acc_start=-5.0,
+                       acc_end=5.0, acc_pulse_width=64000.0, npdmp=0,
+                       limit=10, min_snr=6.0)
+    search = MeshPulsarSearch(synth_fil, cfg)
+    result = search.run()
+    assert len(result.candidates) >= 2
+    npdmp = min(4, len(result.candidates))
+    hdr = synth_fil.header
+
+    host = [copy.deepcopy(c) for c in result.candidates]
+    trials = search._maybe_quantise(search.dedisperse_sharded())
+    fold_candidates(host, trials, search.out_nsamps, hdr.tsamp, npdmp)
+
+    fused = [copy.deepcopy(c) for c in result.candidates]
+    dm_idxs = sorted({c.dm_idx for c in fused[:npdmp]})
+    fp, row_map = search._fused_fold_provider(dm_idxs)
+    fold_candidates(fused, None, search.out_nsamps, hdr.tsamp, npdmp,
+                    dm_row_lookup=row_map, fold_program=fp)
+
+    assert [c.folded_snr for c in fused] == [c.folded_snr for c in host]
+    assert [c.opt_period for c in fused] == [c.opt_period for c in host]
+    assert _cand_tuples_like(fused) == _cand_tuples_like(host)
+
+
+def _cand_tuples_like(cands):
+    return [(float(c.freq), float(c.snr), float(c.folded_snr))
+            for c in cands]
+
+
+# ---------------------------------------------------------------------------
+# FoldInputCache bound + eviction counter
+# ---------------------------------------------------------------------------
+
+
+def test_fold_input_cache_is_bounded_lru():
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.search.pipeline import FoldInputCache
+
+    before = REGISTRY.snapshot()["counters"].get("fold.cache_evicted", 0)
+    cache = FoldInputCache(maxsize=2)
+    cache["a"] = 1
+    cache["b"] = 2
+    assert cache.get("a") == 1  # refresh: "a" is now most-recent
+    cache["c"] = 3  # evicts "b", the least-recently-used
+    assert list(cache) == ["a", "c"]
+    assert cache.get("b") is None
+    after = REGISTRY.snapshot()["counters"].get("fold.cache_evicted", 0)
+    assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Prefetch miss classification
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_miss_records_classified_kind(tmp_path):
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve.worker import ObservationPrefetcher
+
+    bad = tmp_path / "garbage.fil"
+    bad.write_bytes(b"this is not a filterbank")
+    pf = ObservationPrefetcher(slots=1)
+    pf.start(str(bad))
+    before = REGISTRY.snapshot()["counters"]
+    assert pf.take(str(bad)) is None
+    after = REGISTRY.snapshot()["counters"]
+    assert (after.get("scheduler.prefetch_misses", 0)
+            == before.get("scheduler.prefetch_misses", 0) + 1)
+    kinds = {k for k in after
+             if k.startswith("scheduler.prefetch_miss.")
+             and after[k] > before.get(k, 0)}
+    assert len(kinds) == 1, "exactly one classified miss kind"
+
+
+def test_prefetch_never_started_is_a_silent_miss(tmp_path):
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve.worker import ObservationPrefetcher
+
+    pf = ObservationPrefetcher(slots=1)
+    before = REGISTRY.snapshot()["counters"]
+    assert pf.take(str(tmp_path / "never_started.fil")) is None
+    after = REGISTRY.snapshot()["counters"]
+    assert (after.get("scheduler.prefetch_misses", 0)
+            == before.get("scheduler.prefetch_misses", 0) + 1)
+    assert not any(k.startswith("scheduler.prefetch_miss.")
+                   and after[k] > before.get(k, 0) for k in after)
