@@ -9,8 +9,9 @@ FLEET_SMOKE_DIR ?= /tmp/peasoup-fleet-smoke
 BATCH_SMOKE_DIR ?= /tmp/peasoup-batch-smoke
 HEALTH_SMOKE_DIR ?= /tmp/peasoup-health-smoke
 PIPELINE_SMOKE_DIR ?= /tmp/peasoup-pipeline-smoke
+LOADGEN_SMOKE_DIR ?= /tmp/peasoup-loadgen-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -102,3 +103,15 @@ health-smoke:
 pipeline-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.pipeline_smoke \
 	    --dir $(PIPELINE_SMOKE_DIR)
+
+# load-observatory smoke test: an open-loop two-rate saturation sweep
+# (15 jobs/point incl. one poison job) against two real fleet-worker
+# processes — saturation_report.json must carry >=2 rate points with
+# phase-decomposed p50/p95/p99 sojourn, the poison job must be
+# quarantined WITHOUT entering the percentile pool, a kind:"loadgen"
+# ledger record must carry the detected knee, the `timeline <job_id>`
+# verb must render a waterfall whose phase sum equals the sojourn, and
+# the timeline plane's own cost must stay <1% of drain wall-clock
+loadgen-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.loadgen --smoke \
+	    --dir $(LOADGEN_SMOKE_DIR)

@@ -41,6 +41,14 @@ bit-identical before any number is reported.  Both drains report
 their measured ``device_duty_cycle`` (device seconds per wall second
 over the span ledger), the gauge the pipeline exists to raise.
 
+``--loadgen [N]`` (default 16 jobs/rate) runs the open-loop
+saturation micro-bench instead: a seeded two-rate in-process sweep
+(``tools/loadgen.py`` — one rate under the stub workers' capacity,
+one far over) reporting the detected saturation knee and per-rate
+p50/p95/p99 sojourn, and appending the ``kind="loadgen"`` ledger
+record the ``loadgen_saturation`` health rule reads its baseline
+from.
+
 Every successful run appends one structured record (git sha, device,
 timers, per-stage device time, roofline utilization, compile counts,
 parity verdict) to ``benchmarks/history.jsonl`` through the shared
@@ -331,6 +339,67 @@ def run_pipeline_bench(depth: int) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def loadgen_arg(argv: list[str]) -> int | None:
+    """``--loadgen [jobs]``: run the open-loop saturation micro-bench
+    (in-process stub workers, two offered rates straddling capacity)
+    instead of the e2e search benchmark (default 16 jobs/rate)."""
+    if "--loadgen" not in argv:
+        return None
+    i = argv.index("--loadgen")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(4, int(argv[i + 1]))
+    return 16
+
+
+def run_loadgen_bench(jobs: int) -> int:
+    """``bench.py --loadgen N``: a seeded two-rate in-process
+    saturation sweep (tools/loadgen.py) — one rate under capacity, one
+    far over — printing one JSON line with the detected knee and the
+    per-rate sojourn percentiles.  The sweep appends its own
+    ``kind="loadgen"`` ledger record (the ``loadgen_saturation``
+    health rule's baseline); ``--no-history`` routes it to a
+    throwaway ledger."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.tools.loadgen import sweep
+
+    work = tempfile.mkdtemp(prefix="peasoup-loadgen-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        # service_s 0.03 -> capacity ~33 jobs/s; 10/s keeps up, 80/s
+        # saturates, so the sweep always exhibits a knee
+        doc = sweep(work, rates=[10.0, 80.0], jobs=jobs, seed=0,
+                    history=history, timeout_s=120.0, inprocess=True,
+                    service_s=0.03, verbose=False)
+        knee = doc["knee"]
+        points = doc["points"]
+        out = {
+            "metric": "loadgen_knee_throughput",
+            "value": knee["throughput_per_s"],
+            "unit": "jobs/s",
+            "knee_rate_per_s": knee["rate_per_s"],
+            "saturated": knee["saturated"],
+            "jobs_per_rate": jobs,
+            "rates": doc["ledger_record"].get("rates", []),
+            "timeline_overhead_frac":
+                doc["timeline"]["overhead_frac"],
+        }
+        ok = (len(points) >= 2
+              and all(p["done"] == jobs for p in points)
+              and knee["throughput_per_s"] > 0)
+        if not ok:
+            out["error"] = "sweep incomplete: " + "; ".join(
+                f"rate {p['offered_rate_per_s']:g}/s -> "
+                f"{p['done']}/{p['jobs']} done"
+                for p in points)
+        print(json.dumps(out))
+        return 0 if ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -351,6 +420,9 @@ def main() -> None:
     d = pipeline_depth_arg(sys.argv[1:])
     if d is not None:
         sys.exit(run_pipeline_bench(d))
+    lg = loadgen_arg(sys.argv[1:])
+    if lg is not None:
+        sys.exit(run_loadgen_bench(lg))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

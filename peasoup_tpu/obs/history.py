@@ -36,11 +36,27 @@ width), ``batched_dispatches`` (device round trips that carried more
 than one observation) and ``batch_fill`` (total observations carried
 by those dispatches — ``batch_fill / batched_dispatches`` is the mean
 bucket fill), so the ledger can answer "did batching actually engage"
-next to the ``jobs_per_hour`` it is supposed to move.  In fleet mode
+next to the ``jobs_per_hour`` it is supposed to move.  Drains that
+completed jobs also carry the latency side of throughput —
+``sojourn_p50``/``sojourn_p95`` (submit -> done, from the per-job
+lifecycle timelines of ``obs/timeline.py``) and
+``queue_wait_p50``/``queue_wait_p95`` — plus ``timeline_marks`` /
+``timeline_overhead_s`` (the cost of writing those timelines, gated
+under 1% by ``make loadgen-smoke``).  In fleet mode
 (``serve/fleet.py``) every host appends its own record with
 ``config.host`` set to its fleet label, so per-host throughput can be
 trended — and summed — from the same ledger ``status --fleet``
 aggregates live.
+
+``kind == "loadgen"`` records are appended once per saturation sweep
+by ``tools/loadgen.py``: metrics ``rates_swept``, ``jobs_total`` /
+``jobs_done`` / ``jobs_failed``, ``knee_rate_per_s`` and
+``knee_throughput_per_s`` (the saturation knee the
+``loadgen_saturation`` health rule compares live arrival rates
+against), ``max_achieved_per_s`` and ``timeline_overhead_frac``, plus
+a top-level ``rates`` list of slim per-rate rows (offered/achieved
+rate, p50/p95/p99 sojourn, duty cycle, quarantined count) that
+``tools/perf_report.py`` renders as the rate x percentile table.
 
 Ledger I/O never raises into a benchmark run: append/load failures
 warn and return best-effort results.

@@ -1,0 +1,502 @@
+"""Per-job lifecycle timelines: durable cross-process phase marks.
+
+A job's life between ``submitted_utc`` and ``finished_utc`` used to be
+a black box once it crossed a process boundary: the spool record keeps
+three wall timestamps and the worker's span tree dies with the worker.
+This module is the Dapper-style lifecycle record (Sigelman et al.
+2010) for the serve layer — one append-only JSONL of **marks** in the
+job's own work directory (``work/<id>/timeline.jsonl``), written by
+every process that touches the job:
+
+* the spool (serve/queue.py) marks every state transition — submit,
+  claim, done, failed, release, requeue, reap;
+* the worker (serve/worker.py) marks phase boundaries — prefetch-hit /
+  stage, batch-claim, compile, read, dedisperse, dispatch, fetch,
+  decode, distill, fold, store-ingest, checkpoint-resume — by hooking
+  the existing span tree (:class:`TimelineRecorder` listens on
+  ``obs/trace.py`` span closes; no pipeline stage is re-instrumented).
+
+Mark schema (one JSON object per line; ``v`` = 1)::
+
+    {"v": 1, "phase": "<name>", "t_wall": <unix s>,
+     "t_mono": <perf_counter s>, "host": "<label>", "pid": <int>,
+     "attempt": <int>, ...attrs (dur_s, device_s, worker, ...)}
+
+Every mark carries BOTH clocks: ``t_wall`` (``time.time``) is
+comparable across hosts but can step; ``t_mono``
+(``time.perf_counter``) never steps but is only meaningful within one
+process.  The merged reader (:func:`stitch`) therefore orders marks
+**within** a writer by ``t_mono`` and aligns writers **against each
+other** by their wall clocks, clamped so a skewed clock can never
+produce a negative gap — the same reasoning that lets the spool compute
+a non-negative ``queue_wait`` from the submit mark
+(:func:`queue_wait_from`).
+
+:func:`waterfall` turns stitched marks into a partition of the job's
+sojourn: the segment between two consecutive marks is attributed to
+the LATER mark's phase, so ``sum(phase_s) == sojourn_s`` holds by
+construction (the ``timeline`` serve verb renders this as a text
+waterfall; :func:`chrome_trace_events` exports it — plus the
+span-derived device durations for jobs that ran locally — as a Chrome
+trace).
+
+Cost discipline: :func:`mark` is best-effort (never raises), appends
+one line with one ``open``/``write``, and self-accounts into the
+``timeline.marks`` counter + ``timeline_mark`` stage timer (so
+telemetry shards carry the write cost) and the process-local
+:func:`overhead` tally — ``make loadgen-smoke`` gates the total under
+1% of drain wall-clock, the telemetry-sampler precedent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .metrics import REGISTRY as METRICS
+
+#: mark-line schema version
+TIMELINE_VERSION = 1
+
+#: timeline filename inside a job's work directory
+TIMELINE_BASENAME = "timeline.jsonl"
+
+#: hard cap on span-derived marks per job attempt — a chunked search
+#: with thousands of chunk spans must degrade to dropped marks
+#: (``timeline.marks_dropped``), not an unbounded per-job file
+MAX_MARKS_PER_JOB = 512
+
+#: span name -> timeline phase for the worker-side recorder; names not
+#: listed (``Job-<id>`` envelopes, per-trial ``DM-Loop`` spans) emit no
+#: mark.  See CONTRIBUTING.md "Adding a timeline phase".
+SPAN_PHASES = {
+    "Observation-Read": "read",
+    "Dedisperse": "dedisperse",
+    "Accel-Search": "dispatch",
+    "Fused-Search": "dispatch",
+    "Chunk-Fetch": "fetch",
+    "Peak-Decode": "decode",
+    "Distill": "distill",
+    "Folding": "fold",
+    "Store-Ingest": "store-ingest",
+}
+
+#: prefix-matched span names (per-chunk spans carry their index)
+SPAN_PHASE_PREFIXES = (("Chunked-Search-", "dispatch"),)
+
+_OV_LOCK = threading.Lock()
+_OVERHEAD = {"marks": 0, "seconds": 0.0, "errors": 0}
+
+
+def timeline_path(work_dir: str) -> str:
+    """The job's timeline file under its work directory."""
+    return os.path.join(work_dir, TIMELINE_BASENAME)
+
+
+def overhead() -> dict:
+    """Process-cumulative mark accounting: ``{marks, seconds,
+    errors}``.  The loadgen smoke sums this (plus the workers'
+    ``timeline_mark`` timer deltas from their telemetry shards) to
+    gate the plane's cost against drain wall-clock."""
+    with _OV_LOCK:
+        return dict(_OVERHEAD)
+
+
+def mark(work_dir: str, phase: str, *, host: str = "",
+         attempt: int = 0, t_wall: float | None = None,
+         t_mono: float | None = None, registry=None, **attrs
+         ) -> dict | None:
+    """Append one phase mark to the job's timeline; best effort.
+
+    Never raises: a full disk or unwritable spool costs one counted
+    error (``timeline.mark_errors``), never a failed transition.
+    Returns the record written, or None on failure.
+    """
+    t0 = time.perf_counter()
+    reg = registry if registry is not None else METRICS
+    rec = {
+        "v": TIMELINE_VERSION,
+        "phase": str(phase),
+        "t_wall": round(float(t_wall) if t_wall is not None
+                        else time.time(), 6),
+        "t_mono": round(float(t_mono) if t_mono is not None
+                        else time.perf_counter(), 6),
+        "host": str(host),
+        "pid": os.getpid(),
+        "attempt": int(attempt),
+    }
+    for key, val in attrs.items():
+        rec.setdefault(str(key), val)
+    try:
+        os.makedirs(work_dir, exist_ok=True)
+        with open(timeline_path(work_dir), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except (OSError, TypeError, ValueError):
+        reg.inc("timeline.mark_errors")
+        with _OV_LOCK:
+            _OVERHEAD["errors"] += 1
+        return None
+    dt = time.perf_counter() - t0
+    reg.inc("timeline.marks")
+    reg.observe("timeline_mark", dt)
+    with _OV_LOCK:
+        _OVERHEAD["marks"] += 1
+        _OVERHEAD["seconds"] += dt
+    return rec
+
+
+def read_timeline(path_or_workdir: str) -> list[dict]:
+    """Every parseable mark in file order; torn/corrupt lines are
+    skipped (a writer killed mid-append leaves a torn tail; that must
+    never poison the merge).  Accepts the timeline file or the job's
+    work directory."""
+    path = path_or_workdir
+    if not path.endswith(".jsonl"):
+        path = timeline_path(path_or_workdir)
+    out: list[dict] = []
+    try:
+        with open(path, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (isinstance(rec, dict) and rec.get("phase")
+                        and isinstance(rec.get("t_mono"), (int, float))
+                        and isinstance(rec.get("t_wall"),
+                                       (int, float))):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+# --------------------------------------------------------------------------
+# stitching (clock-skew-tolerant cross-process merge)
+# --------------------------------------------------------------------------
+
+def _writer_key(m: dict) -> tuple:
+    return (str(m.get("host", "")), int(m.get("pid", 0)))
+
+
+def stitch(marks: list[dict]) -> list[dict]:
+    """Merge marks from multiple writer processes onto one offset
+    axis.
+
+    Within a writer (``(host, pid)``) marks are ordered by its
+    monotonic clock — exact, immune to wall steps.  Writers are placed
+    against the reference writer (the one holding the ``submit`` mark,
+    else the earliest) by their wall-clock delta at their shared spool
+    boundary, clamped at >= 0 so a host whose wall clock runs behind
+    cannot push its marks before the submit.  Returns copies of the
+    marks with an added ``"t"`` (seconds since the first mark),
+    globally sorted by ``t`` with per-writer order preserved.
+    """
+    valid = [m for m in marks if isinstance(m, dict)]
+    if not valid:
+        return []
+    groups: dict[tuple, list[dict]] = {}
+    for m in valid:
+        groups.setdefault(_writer_key(m), []).append(m)
+    for g in groups.values():
+        g.sort(key=lambda m: float(m["t_mono"]))
+    ordered = sorted(groups.values(),
+                     key=lambda g: float(g[0]["t_wall"]))
+    ref = next((g for g in ordered
+                if any(m.get("phase") == "submit" for m in g)),
+               ordered[0])
+    ref_wall0 = float(ref[0]["t_wall"])
+    out: list[tuple] = []
+    for gi, g in enumerate([ref] + [g for g in ordered if g is not ref]):
+        base = (0.0 if gi == 0
+                else max(0.0, float(g[0]["t_wall"]) - ref_wall0))
+        mono0 = float(g[0]["t_mono"])
+        for m in g:
+            rec = dict(m)
+            rec["t"] = round(base + float(m["t_mono"]) - mono0, 6)
+            out.append((rec["t"], gi, rec))
+    # ties (a clamped skewed writer lands exactly on a reference
+    # mark) resolve reference-writer-first: submit precedes the
+    # claim it enabled
+    out.sort(key=lambda item: item[:2])
+    out = [rec for _, _, rec in out]
+    # re-zero on the earliest mark so "t" always starts at 0.0
+    t0 = out[0]["t"]
+    if t0:
+        for m in out:
+            m["t"] = round(m["t"] - t0, 6)
+    return out
+
+
+def waterfall(marks: list[dict], job_id: str = "") -> dict:
+    """Stitched marks -> the job's phase-partitioned waterfall.
+
+    The interval between consecutive marks is attributed to the LATER
+    mark's phase, so the phase totals sum EXACTLY to the sojourn (last
+    mark minus first) — the invariant ``make loadgen-smoke`` asserts.
+    """
+    stitched = stitch(marks)
+    segments: list[dict] = []
+    phase_s: dict[str, float] = {}
+    for prev, cur in zip(stitched, stitched[1:]):
+        dur = max(0.0, cur["t"] - prev["t"])
+        seg = {
+            "phase": str(cur.get("phase", "")),
+            "start_s": round(prev["t"], 6),
+            "dur_s": round(dur, 6),
+            "host": str(cur.get("host", "")),
+            "attempt": int(cur.get("attempt", 0)),
+        }
+        if isinstance(cur.get("device_s"), (int, float)):
+            seg["device_s"] = round(float(cur["device_s"]), 6)
+        segments.append(seg)
+        phase_s[seg["phase"]] = phase_s.get(seg["phase"], 0.0) + dur
+    sojourn = stitched[-1]["t"] - stitched[0]["t"] if stitched else 0.0
+    writers = sorted({_writer_key(m) for m in stitched})
+    return {
+        "v": TIMELINE_VERSION,
+        "job_id": job_id,
+        "marks": stitched,
+        "segments": segments,
+        "phase_s": {k: round(v, 6) for k, v in phase_s.items()},
+        "sojourn_s": round(sojourn, 6),
+        "outcome": (str(stitched[-1].get("phase", ""))
+                    if stitched else ""),
+        "writers": [{"host": h, "pid": p} for h, p in writers],
+    }
+
+
+def sojourn_for(work_dir: str) -> float | None:
+    """Submit->terminal sojourn in seconds from the job's timeline
+    marks, or None when the timeline is absent/unusable (the caller
+    falls back to wall-clock deltas)."""
+    marks = read_timeline(work_dir)
+    if len(marks) < 2:
+        return None
+    doc = waterfall(marks)
+    return doc["sojourn_s"] if doc["sojourn_s"] > 0.0 else None
+
+
+def queue_wait_from(work_dir: str, *, host: str = "",
+                    t_mono: float | None = None,
+                    t_wall: float | None = None) -> float | None:
+    """Submit->claim wait from the submit mark, never negative.
+
+    Same writer process (host+pid match): monotonic delta — exact even
+    across wall-clock steps.  Cross-process: wall delta clamped at
+    >= 0, so a skewed claimer clock reads as "no wait", not a negative
+    wait.  None when no submit mark exists (pre-timeline records).
+    """
+    sub = next((m for m in read_timeline(work_dir)
+                if m.get("phase") == "submit"), None)
+    if sub is None:
+        return None
+    if (int(sub.get("pid", -1)) == os.getpid()
+            and str(sub.get("host", "")) == str(host)):
+        now = time.perf_counter() if t_mono is None else float(t_mono)
+        return max(0.0, now - float(sub["t_mono"]))
+    now = time.time() if t_wall is None else float(t_wall)
+    return max(0.0, now - float(sub["t_wall"]))
+
+
+# --------------------------------------------------------------------------
+# rendering / export
+# --------------------------------------------------------------------------
+
+def render_waterfall(doc: dict, width: int = 40) -> str:
+    """Text waterfall of a :func:`waterfall` document (the ``timeline``
+    serve verb's output)."""
+    sojourn = float(doc.get("sojourn_s", 0.0))
+    marks = doc.get("marks", [])
+    lines = [
+        f"job {doc.get('job_id') or '?'}: {len(marks)} mark(s) from "
+        f"{len(doc.get('writers', []))} writer(s), sojourn "
+        f"{sojourn:.3f}s -> {doc.get('outcome') or '?'}"
+    ]
+    segs = doc.get("segments", [])
+    if not segs:
+        lines.append("  (need >= 2 marks for a waterfall)")
+        return "\n".join(lines)
+    lines.append(f"  {'offset':>9}  {'dur':>9}  {'phase':<16} "
+                 f"{'host':<10} waterfall")
+    for seg in segs:
+        if sojourn > 0:
+            lo = int(seg["start_s"] / sojourn * width)
+            hi = max(lo + 1,
+                     int((seg["start_s"] + seg["dur_s"])
+                         / sojourn * width))
+        else:
+            lo, hi = 0, 1
+        bar = ("·" * lo + "█" * min(hi - lo, width - lo)).ljust(width,
+                                                                "·")
+        lines.append(
+            f"  {seg['start_s']:>8.3f}s {seg['dur_s']:>8.3f}s  "
+            f"{seg['phase']:<16} {seg['host'][:10]:<10} {bar}")
+    totals = sorted(doc.get("phase_s", {}).items(),
+                    key=lambda kv: -kv[1])
+    parts = []
+    for phase, s in totals:
+        pct = (100.0 * s / sojourn) if sojourn > 0 else 0.0
+        parts.append(f"{phase} {s:.3f}s ({pct:.1f}%)")
+    lines.append("  phase totals: " + ", ".join(parts))
+    return "\n".join(lines)
+
+
+def chrome_trace_events(doc: dict, process_index: int = 0
+                        ) -> list[dict]:
+    """The waterfall as Chrome trace events: the lifecycle partition on
+    one track, plus — for marks that carry span-derived ``dur_s`` /
+    ``device_s`` (jobs that ran in a local worker) — the merged device
+    spans on a second track, so Perfetto shows queue wait and device
+    occupancy on one absolute axis."""
+    events: list[dict] = [
+        {"ph": "M", "name": "process_name", "pid": process_index,
+         "tid": 0, "args": {"name": f"job {doc.get('job_id') or '?'}"}},
+        {"ph": "M", "name": "thread_name", "pid": process_index,
+         "tid": 0, "args": {"name": "lifecycle"}},
+        {"ph": "M", "name": "thread_name", "pid": process_index,
+         "tid": 1, "args": {"name": "worker spans"}},
+    ]
+    for seg in doc.get("segments", []):
+        ts = round(seg["start_s"] * 1e6, 3)
+        dur = round(seg["dur_s"] * 1e6, 3)
+        events.append({
+            "name": seg["phase"], "cat": "timeline", "ph": "X",
+            "ts": ts, "dur": dur, "pid": process_index, "tid": 0,
+            "args": {"host": seg.get("host", ""),
+                     "attempt": seg.get("attempt", 0)},
+        })
+    for m in doc.get("marks", []):
+        dur_s = m.get("dur_s")
+        if not isinstance(dur_s, (int, float)) or dur_s <= 0:
+            continue
+        t_end = float(m["t"])
+        events.append({
+            "name": str(m.get("phase", "")), "cat": "span", "ph": "X",
+            "ts": round(max(0.0, t_end - float(dur_s)) * 1e6, 3),
+            "dur": round(float(dur_s) * 1e6, 3),
+            "pid": process_index, "tid": 1,
+            "args": {
+                "device_ms": round(
+                    1e3 * float(m.get("device_s", 0.0) or 0.0), 3),
+                "host": m.get("host", ""),
+            },
+        })
+    return events
+
+
+def write_trace_json(path: str, doc: dict) -> str:
+    """Serialise :func:`chrome_trace_events` as a loadable Chrome
+    trace (atomic)."""
+    out = {
+        "traceEvents": chrome_trace_events(doc),
+        "displayTimeUnit": "ms",
+        "metadata": {"tool": "peasoup-tpu timeline",
+                     "job_id": doc.get("job_id", "")},
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+# --------------------------------------------------------------------------
+# worker-side span recorder
+# --------------------------------------------------------------------------
+
+def phase_for_span(name: str) -> str | None:
+    """Timeline phase for a span name, or None (span not a job
+    phase)."""
+    phase = SPAN_PHASES.get(name)
+    if phase is not None:
+        return phase
+    for prefix, ph in SPAN_PHASE_PREFIXES:
+        if name.startswith(prefix):
+            return ph
+    return None
+
+
+class TimelineRecorder:
+    """Span-close listener turning a job's worker spans into timeline
+    marks — the worker registers one around each job (or batch: every
+    beam's work dir receives the shared dispatch marks) so the existing
+    span instrumentation doubles as the cross-process lifecycle record
+    with zero new pipeline call sites.
+
+    Per closed span whose name maps through :data:`SPAN_PHASES`, one
+    mark is written at the span's END (carrying ``dur_s`` and
+    ``device_s``).  When the span observed jit compiles, a ``compile``
+    mark is interpolated at ``t_start + compile_s`` first (compilation
+    happens before execution), keeping the waterfall partition exact.
+    Marks are capped at ``max_marks`` per recorder
+    (``timeline.marks_dropped`` counts the rest).
+    """
+
+    def __init__(self, work_dirs, *, host: str = "", attempt: int = 0,
+                 tracer=None, registry=None,
+                 max_marks: int = MAX_MARKS_PER_JOB):
+        from .trace import get_tracer
+
+        self.work_dirs = ([work_dirs] if isinstance(work_dirs, str)
+                          else list(work_dirs))
+        self.host = str(host)
+        self.attempt = int(attempt)
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._registry = registry if registry is not None else METRICS
+        self.max_marks = int(max_marks)
+        self.emitted = 0
+        self.dropped = 0
+        self._compile_s0 = self._compile_host_s()
+
+    def _compile_host_s(self) -> float:
+        rec = self._registry.snapshot().get("timers", {}).get(
+            "jit_compile")
+        return float(rec.get("host_s", 0.0)) if rec else 0.0
+
+    def _emit(self, phase: str, t_mono: float, **attrs) -> None:
+        if self.emitted >= self.max_marks:
+            self.dropped += 1
+            self._registry.inc("timeline.marks_dropped")
+            return
+        t_wall = self._tracer.epoch + t_mono
+        for wd in self.work_dirs:
+            mark(wd, phase, host=self.host, attempt=self.attempt,
+                 t_wall=t_wall, t_mono=t_mono,
+                 registry=self._registry, **attrs)
+        self.emitted += 1
+
+    def on_span(self, rec) -> None:
+        """Tracer close listener (``rec`` is a SpanRecord)."""
+        phase = phase_for_span(rec.name)
+        if phase is None:
+            return
+        dur = max(0.0, rec.t_end - rec.t_start)
+        compiles = rec.attrs.get("compiles")
+        if compiles:
+            c1 = self._compile_host_s()
+            comp_s = min(max(0.0, c1 - self._compile_s0), dur)
+            self._compile_s0 = c1
+            if comp_s > 0.0:
+                self._emit("compile", rec.t_start + comp_s,
+                           dur_s=round(comp_s, 6),
+                           compiles=int(compiles))
+        self._emit(phase, rec.t_end, dur_s=round(dur, 6),
+                   device_s=round(float(rec.device_s), 6))
+
+    def __enter__(self) -> "TimelineRecorder":
+        self._tracer.add_listener(self.on_span)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer.remove_listener(self.on_span)
+        return False

@@ -163,6 +163,10 @@ class Tracer:
         self._tids: dict[int, int] = {}
         self._max_spans = max_spans
         self.dropped = 0
+        #: close-time observers (obs/timeline.py's recorder): each is
+        #: called with every closed SpanRecord; a crashing listener is
+        #: dropped from the call, never raised into the traced block
+        self._listeners: list = []
         self._profiler = None   # lazy: jax.profiler module, or False
         self._hbm_supported: bool | None = None
         self._hbm_high = 0
@@ -260,9 +264,31 @@ class Tracer:
             else:
                 self.dropped += 1
                 self._registry.inc("trace.spans_dropped")
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(rec)
+            except Exception:
+                # a broken observer must never fail the traced block
+                self._registry.inc("trace.listener_errors")
         if handle.metric:
             self._registry.observe(
                 handle.metric, handle.host_s, handle.device_s)
+
+    # -- close-time listeners ----------------------------------------------
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(record: SpanRecord)`` to be called at every
+        span close (obs/timeline.py hooks job-phase marks in here)."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     # -- access ------------------------------------------------------------
 

@@ -30,8 +30,9 @@ beams, flaky runs and worker crashes.  This package is that layer:
   in ``fleet_report.json`` v2 and surfaced by the ``health`` verb;
 * :mod:`~peasoup_tpu.serve.cli` — ``python -m peasoup_tpu.serve``
   with ``submit`` / ``worker`` / ``fleet-worker`` / ``status``
-  (``--watch`` live dashboard) / ``health`` / ``coincidence`` /
-  ``requeue`` verbs.
+  (``--watch`` live dashboard) / ``health`` / ``timeline`` (per-job
+  lifecycle waterfall from obs/timeline.py marks) / ``coincidence``
+  / ``requeue`` verbs.
 """
 
 from .fleet import (

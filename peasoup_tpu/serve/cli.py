@@ -10,6 +10,7 @@ Verbs::
     health        [--json PATH] [--stale-after N] [--window S]
                   [--slo KEY=VALUE ...]
     coincidence   [--freq-tol F] [--min-sources N] [--json PATH]
+    timeline      <job_id> [--json PATH] [--trace_json PATH]
     requeue       <job_ids...> | --running | --failed | --expired
 
 All verbs take ``--spool DIR`` (default ``./jobs``): the durable spool
@@ -27,6 +28,13 @@ store shards; ``requeue`` recovers jobs from a crashed worker
 (``--running``, or ``--expired`` for lease-based recovery that only
 touches jobs whose host stopped heartbeating) or retries quarantined
 ones (``--failed``).
+
+``timeline`` renders a job's cross-process lifecycle waterfall from
+its ``work/<id>/timeline.jsonl`` marks (obs/timeline.py: every spool
+transition + every worker phase, stitched clock-skew-tolerantly across
+hosts); ``--json`` writes the waterfall document, ``--trace_json``
+exports a Chrome/Perfetto trace that merges the worker's device spans
+for jobs that ran locally.
 
 Health plane (serve/health.py over obs/telemetry.py shards):
 ``health`` evaluates every registered rule plus the SLO summary
@@ -150,6 +158,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="distinct observations required per group")
     pc.add_argument("--json", dest="json_path", default=None,
                     help="also write the groups to this JSON file")
+
+    pl = sub.add_parser(
+        "timeline",
+        help="render one job's cross-process lifecycle waterfall "
+             "from its timeline marks")
+    pl.add_argument("job_id", help="job id (any spool state)")
+    pl.add_argument("--json", dest="json_path", default=None,
+                    help="also write the waterfall document (marks + "
+                         "segments + phase totals) to this JSON file")
+    pl.add_argument("--trace_json", dest="trace_path", default=None,
+                    help="also export a Chrome/Perfetto trace merging "
+                         "the lifecycle with the worker's device "
+                         "spans")
+    pl.add_argument("--width", type=int, default=40,
+                    help="waterfall bar width in characters")
 
     pr = sub.add_parser("requeue", help="move jobs back to pending")
     pr.add_argument("job_ids", nargs="*", help="specific job ids")
@@ -473,6 +496,34 @@ def cmd_coincidence(spool, args) -> int:
     return 0
 
 
+def cmd_timeline(spool, args) -> int:
+    import json
+
+    from ..obs import timeline
+
+    work = os.path.join(spool.root, "work", args.job_id)
+    marks = timeline.read_timeline(work)
+    if not marks:
+        print(f"no timeline marks for job {args.job_id!r} "
+              f"(looked in {timeline.timeline_path(work)})",
+              file=sys.stderr)
+        return 1
+    doc = timeline.waterfall(marks, job_id=args.job_id)
+    state = spool.get(args.job_id)
+    if state is not None:
+        doc["state"] = state[0]
+    print(timeline.render_waterfall(doc, width=args.width))
+    if args.json_path:
+        tmp = args.json_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, args.json_path)
+        print(f"wrote {args.json_path}")
+    if args.trace_path:
+        print(f"wrote {timeline.write_trace_json(args.trace_path, doc)}")
+    return 0
+
+
 def cmd_requeue(spool, args) -> int:
     if args.expired:
         from .queue import DEFAULT_LEASE_TTL_S
@@ -518,6 +569,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "health": cmd_health,
         "coincidence": cmd_coincidence,
+        "timeline": cmd_timeline,
         "requeue": cmd_requeue,
     }[args.verb](spool, args)
 

@@ -71,6 +71,10 @@ DEFAULT_SLO = {
     "queue_wait_p95_s": 600.0,
     "job_p50_s": 900.0,
     "job_p95_s": 3600.0,
+    # end-to-end sojourn (submit->done, timeline-derived): roughly
+    # queue-wait + job targets — the latency a SUBMITTER experiences
+    "sojourn_p50_s": 960.0,
+    "sojourn_p95_s": 4200.0,
 }
 
 #: retry/quarantine/reap thresholds for the spike rules (per window)
@@ -113,7 +117,7 @@ class HealthContext:
     latest: dict[str, dict]       # newest sample per host
     queue: dict[str, int]         # spool state counts
     running: list[dict]           # [{"job_id", "host"}] lease holders
-    ledger: list[dict]            # kind:"serve" history records
+    ledger: list[dict]            # kind:"serve"/"loadgen" history recs
     window_s: float = DEFAULT_WINDOW_S
     stale_after: float = DEFAULT_STALE_AFTER
     slo: dict = field(default_factory=lambda: dict(DEFAULT_SLO))
@@ -154,7 +158,7 @@ def build_context(spool: JobSpool, *, ts_dir: str | None = None,
         queue=spool.counts(),
         running=running,
         ledger=load_history(ledger_path or default_ledger_path(),
-                            kinds=("serve",)),
+                            kinds=("serve", "loadgen")),
         window_s=float(window_s),
         stale_after=float(stale_after),
         slo=targets,
@@ -444,6 +448,64 @@ def rule_device_duty_cycle(ctx: HealthContext) -> list[HealthFinding]:
     return out
 
 
+@health_rule
+def rule_loadgen_saturation(ctx: HealthContext) -> list[HealthFinding]:
+    """Live arrival rate vs the measured saturation knee (ISSUE 12).
+
+    ``tools/loadgen.py`` sweeps offered rates against a real fleet and
+    records the knee — the highest rate the fleet still kept up with —
+    as a ``kind:"loadgen"`` ledger record.  When live submissions
+    (``scheduler.submitted`` deltas over the telemetry window) arrive
+    FASTER than that measured capacity, the queue is growing without
+    bound by construction: warn above the knee, crit at 1.5x.  No
+    loadgen record means no baseline — ok, not unknown-unhealthy.
+    """
+    knee = None
+    for r in ctx.ledger:
+        if r.get("kind") != "loadgen":
+            continue
+        val = r.get("metrics", {}).get("knee_throughput_per_s")
+        if isinstance(val, (int, float)):
+            knee = float(val)  # last record wins (newest sweep)
+    if knee is None:
+        return [HealthFinding(
+            "loadgen_saturation", OK,
+            "no loadgen saturation baseline in the ledger (run "
+            "'make loadgen-smoke' or tools/loadgen.py to measure one)",
+            data={"knee_throughput_per_s": None})]
+    if knee <= 0:
+        return [HealthFinding(
+            "loadgen_saturation", OK,
+            "loadgen record carries no positive knee throughput",
+            data={"knee_throughput_per_s": knee})]
+    submits = _recent_counter(ctx, "scheduler.submitted")
+    ts = [float(s.get("ts", 0.0)) for s in ctx.recent]
+    span = max(ts) - min(ts) if len(ts) >= 2 else ctx.window_s
+    if span <= 0:
+        span = ctx.window_s
+    rate = submits / span if span > 0 else 0.0
+    ratio = rate / knee
+    data = {"arrival_rate_per_s": round(rate, 6),
+            "knee_throughput_per_s": round(knee, 6),
+            "ratio": round(ratio, 4), "submits": submits,
+            "span_s": round(span, 3)}
+    if ratio >= 1.5:
+        return [HealthFinding(
+            "loadgen_saturation", CRIT,
+            f"arrival rate {rate:.3f}/s is {ratio:.2f}x the measured "
+            f"saturation knee ({knee:.3f}/s) — queue growth is "
+            f"unbounded, shed load or add workers", data=data)]
+    if ratio > 1.0:
+        return [HealthFinding(
+            "loadgen_saturation", WARN,
+            f"arrival rate {rate:.3f}/s exceeds the measured "
+            f"saturation knee ({knee:.3f}/s)", data=data)]
+    return [HealthFinding(
+        "loadgen_saturation", OK,
+        f"arrival rate {rate:.3f}/s within the measured knee "
+        f"({knee:.3f}/s)", data=data)]
+
+
 # -- SLO summary -----------------------------------------------------------
 
 def _weighted_percentile(pairs: list[tuple[float, float]],
@@ -463,8 +525,17 @@ def _weighted_percentile(pairs: list[tuple[float, float]],
     return pairs[-1][0]
 
 
+def percentile(values, q: float) -> float:
+    """Unweighted percentile of a value list (0.0 on no data) — the
+    worker's per-drain sojourn/queue-wait ledger columns and the
+    loadgen report use this so every table quotes one definition."""
+    result = _weighted_percentile([(float(v), 1.0) for v in values], q)
+    return 0.0 if result is None else float(result)
+
+
 def slo_summary(ctx: HealthContext) -> dict:
-    """Queue-wait and job-duration p50/p95 vs targets.
+    """Queue-wait, job-duration and end-to-end sojourn p50/p95 vs
+    targets.
 
     Each telemetry sample carries timer *deltas* (count + host
     seconds), so the per-sample mean weighted by its count is an
@@ -475,11 +546,15 @@ def slo_summary(ctx: HealthContext) -> dict:
     """
     metrics = {}
     statuses = []
-    for name in ("queue_wait", "job"):
+    # (report name, telemetry timer key): sojourn is the end-to-end
+    # submit->done latency the scheduler.sojourn timer carries
+    for name, timer_key in (("queue_wait", "queue_wait"),
+                            ("job", "job"),
+                            ("sojourn", "scheduler.sojourn")):
         pairs = []
         n = 0
         for sample in ctx.recent:
-            delta = sample.get("timers", {}).get(name)
+            delta = sample.get("timers", {}).get(timer_key)
             if not isinstance(delta, dict):
                 continue
             count = float(delta.get("count", 0))

@@ -9,7 +9,9 @@ One job = one observation: an input filterbank path plus its
     <spool>/done/<job_id>.json       finished, result summary attached
     <spool>/failed/<job_id>.json     quarantined or retry-exhausted
     <spool>/work/<job_id>/           per-job scratch: checkpoint file,
-                                     output directory, failure reports
+                                     output directory, failure reports,
+                                     lifecycle timeline.jsonl
+                                     (obs/timeline.py)
     <spool>/leases/<job_id>.json     claim lease: host + worker +
                                      heartbeat time of the claimer
     <spool>/fleet/<host>.json        per-host status snapshot
@@ -49,6 +51,7 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from ..errors import ConfigError
+from ..obs import timeline
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
 
@@ -81,9 +84,13 @@ class JobRecord:
     worker: str = ""
     #: fleet host label of the claimer ("" pre-fleet / single host)
     host: str = ""
-    #: one entry per failed attempt: {utc, attempt, classification,
-    #: error, traceback, run_report}
+    #: one entry per failed attempt: {utc, t_mono, attempt,
+    #: classification, error, traceback, run_report}
     failures: list = field(default_factory=list)
+    #: submit->claim wait of the LAST claim, from timeline marks when
+    #: available (monotonic within a process, wall-clamped across
+    #: processes — never negative even across clock steps)
+    queue_wait_s: float = 0.0
     #: success summary (candidate counts, outdir) set by mark_done
     summary: dict = field(default_factory=dict)
     v: int = _RECORD_VERSION
@@ -127,6 +134,28 @@ class JobSpool:
         os.makedirs(d, exist_ok=True)
         return d
 
+    def _mark(self, rec: JobRecord, phase: str, **attrs) -> None:
+        """Best-effort lifecycle mark in the job's timeline
+        (obs/timeline.py) — every spool transition leaves one, so the
+        ``timeline`` verb can reconstruct the job's waterfall across
+        submitter/worker/reaper processes."""
+        timeline.mark(
+            os.path.join(self.root, "work", rec.job_id), phase,
+            host=rec.host, attempt=rec.attempts, **attrs)
+
+    def _observe_queue_wait(self, rec: JobRecord) -> None:
+        """Record submit->claim wait, preferring timeline marks: same
+        process uses the monotonic clock (exact across wall steps),
+        cross-process uses a wall delta clamped at >= 0.  Only the
+        pre-timeline fallback still subtracts raw wall stamps."""
+        wait = timeline.queue_wait_from(
+            os.path.join(self.root, "work", rec.job_id),
+            host=rec.host, t_wall=rec.claimed_utc)
+        if wait is None:
+            wait = max(0.0, rec.claimed_utc - rec.submitted_utc)
+        rec.queue_wait_s = round(wait, 6)
+        METRICS.observe("queue_wait", wait)
+
     # -- record I/O --------------------------------------------------------
 
     def _write(self, path: str, rec: JobRecord) -> None:
@@ -163,6 +192,8 @@ class JobSpool:
             submitted_utc=time.time(),
         )
         self._write(self._path("pending", rec.job_id), rec)
+        self._mark(rec, "submit", t_wall=rec.submitted_utc,
+                   priority=rec.priority)
         METRICS.inc("scheduler.submitted")
         return rec
 
@@ -206,11 +237,12 @@ class JobSpool:
             rec.host = host
             rec.claimed_utc = time.time()
             rec.attempts += 1
+            self._observe_queue_wait(rec)
             self._write(dst, rec)
             self.heartbeat(rec)
+            self._mark(rec, "claim", t_wall=rec.claimed_utc,
+                       worker=worker)
             METRICS.inc("scheduler.claimed")
-            METRICS.observe(
-                "queue_wait", rec.claimed_utc - rec.submitted_utc)
             return rec
         return None
 
@@ -236,11 +268,12 @@ class JobSpool:
         rec.host = host
         rec.claimed_utc = time.time()
         rec.attempts += 1
+        self._observe_queue_wait(rec)
         self._write(dst, rec)
         self.heartbeat(rec)
+        self._mark(rec, "claim", t_wall=rec.claimed_utc,
+                   worker=worker)
         METRICS.inc("scheduler.claimed")
-        METRICS.observe(
-            "queue_wait", rec.claimed_utc - rec.submitted_utc)
         return rec
 
     # -- leases (fleet hardening) ------------------------------------------
@@ -305,6 +338,7 @@ class JobSpool:
             dead_host = rec.host or (lease or {}).get("host") or "?"
             rec.failures.append({
                 "utc": round(now, 3),
+                "t_mono": round(time.perf_counter(), 6),
                 "attempt": rec.attempts,
                 "classification": LEASE_EXPIRED,
                 "error": (f"lease expired after {age:.1f}s "
@@ -318,6 +352,7 @@ class JobSpool:
             except (ConfigError, OSError):
                 continue  # another reaper won this one
             self._clear_lease(rec.job_id)
+            self._mark(rec, "reap", dead_host=dead_host)
             warn_event(
                 "job_lease_expired",
                 f"job {rec.job_id} reaped after {age:.1f}s without a "
@@ -352,6 +387,7 @@ class JobSpool:
             rec.summary = dict(summary)
         self._transition(rec, "running", "done")
         self._clear_lease(rec.job_id)
+        self._mark(rec, "done", t_wall=rec.finished_utc)
 
     def mark_failed(self, rec: JobRecord) -> None:
         """running -> failed (the failure log on the record says why:
@@ -359,12 +395,14 @@ class JobSpool:
         rec.finished_utc = time.time()
         self._transition(rec, "running", "failed")
         self._clear_lease(rec.job_id)
+        self._mark(rec, "failed", t_wall=rec.finished_utc)
 
     def release(self, rec: JobRecord) -> None:
         """running -> pending for a bounded retry (attempt count and
         failure log travel with the record)."""
         self._transition(rec, "running", "pending")
         self._clear_lease(rec.job_id)
+        self._mark(rec, "release")
 
     def requeue(self, job_id: str) -> JobRecord:
         """Recover a job from ``running/`` (crashed worker) or
@@ -377,6 +415,7 @@ class JobSpool:
                 rec.host = ""
                 self._transition(rec, state, "pending")
                 self._clear_lease(rec.job_id)
+                self._mark(rec, "requeue", from_state=state)
                 METRICS.inc("scheduler.requeued")
                 return rec
         raise ConfigError(

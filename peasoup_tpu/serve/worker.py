@@ -43,6 +43,7 @@ import traceback
 from dataclasses import replace
 
 from ..errors import ConfigError
+from ..obs import timeline
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
 from ..obs.trace import device_seconds, span, span_cursor
@@ -208,6 +209,11 @@ class SurveyWorker:
         )
         #: geometry bucket -> jobs served (program-reuse accounting)
         self.geometries: dict[tuple, int] = {}
+        #: per-drain latency samples for the serve ledger record:
+        #: submit->done sojourns (timeline-derived) and submit->claim
+        #: waits of every job this worker finished
+        self._sojourns: list[float] = []
+        self._queue_waits: list[float] = []
 
     # -- config / geometry -------------------------------------------------
 
@@ -361,8 +367,43 @@ class SurveyWorker:
             got = self.spool.claim_job(
                 rec.job_id, self.worker_id, host=self.host_label)
             if got is not None:  # lost races just shrink the batch
+                self._mark_job(got, "batch-claim",
+                               leader=leader.job_id)
                 mates.append(got)
         return mates
+
+    # -- lifecycle timeline (obs/timeline.py) ------------------------------
+
+    def _mark_job(self, job: JobRecord, phase: str, **attrs) -> None:
+        """One worker-side mark in the job's lifecycle timeline."""
+        timeline.mark(self.spool.work_dir(job.job_id), phase,
+                      host=self.host_label, attempt=job.attempts,
+                      **attrs)
+
+    def _recorder(self, jobs) -> timeline.TimelineRecorder:
+        """Span-close listener mapping this worker's pipeline spans
+        (read/dedisperse/dispatch/fetch/.../store-ingest, plus
+        interpolated compile marks) into the given jobs' timelines;
+        batched dispatch passes every batch-mate so the shared device
+        phases land in each beam's waterfall."""
+        recs = jobs if isinstance(jobs, list) else [jobs]
+        return timeline.TimelineRecorder(
+            [self.spool.work_dir(j.job_id) for j in recs],
+            host=self.host_label,
+            attempt=max((j.attempts for j in recs), default=0),
+        )
+
+    def _note_done(self, job: JobRecord) -> None:
+        """Latency accounting for a finished job: the submit->done
+        sojourn from its timeline marks (clock-step-proof), falling
+        back to wall stamps for pre-timeline records, into the
+        ``scheduler.sojourn`` timer + this drain's percentile pools."""
+        soj = timeline.sojourn_for(self.spool.work_dir(job.job_id))
+        if soj is None:
+            soj = max(0.0, job.finished_utc - job.submitted_utc)
+        METRICS.observe("scheduler.sojourn", soj)
+        self._sojourns.append(float(soj))
+        self._queue_waits.append(float(job.queue_wait_s or 0.0))
 
     def _run_batch_jobs(self, jobs: list[JobRecord]) -> int:
         """Run claimed same-bucket jobs through ONE batched dispatch;
@@ -386,9 +427,12 @@ class SurveyWorker:
                                  "events.jsonl"))
                 fil = (self._prefetcher.take(job.input)
                        if self.prefetch else None)
-                if fil is None:
-                    with span("Observation-Read", metric="obs_read",
-                              input=job.input):
+                if fil is not None:
+                    self._mark_job(job, "prefetch-hit")
+                else:
+                    with self._recorder(job), \
+                            span("Observation-Read", metric="obs_read",
+                                 input=job.input):
                         fil = read_filterbank(job.input)
                 ready.append((job, cfg, fil))
             except Exception as exc:
@@ -431,9 +475,13 @@ class SurveyWorker:
                 self._prefetcher.start(rec.input)
         B = len(js)
         try:
-            results = run_with_timeout(
-                lambda: leader.run_batch(fils, cfgs), self.timeout_s,
-                label=f"batch {js[0].job_id}+{B - 1}")
+            # the shared device phases (dedisperse/dispatch/fetch/...)
+            # land in EVERY batch-mate's timeline
+            with self._recorder(js):
+                results = run_with_timeout(
+                    lambda: leader.run_batch(fils, cfgs),
+                    self.timeout_s,
+                    label=f"batch {js[0].job_id}+{B - 1}")
         except Exception as exc:
             # whole-dispatch failure (timeout, compile error): every
             # beam classifies/retries individually
@@ -444,17 +492,20 @@ class SurveyWorker:
             METRICS.inc("scheduler.batched_dispatches")
             METRICS.inc("scheduler.batch_fill", B)
         for job, cfg, result in zip(js, cfgs, results):
-            with span(f"Job-{job.job_id}", metric="job",
-                      job_id=job.job_id, input=job.input,
-                      attempt=job.attempts, priority=job.priority,
-                      batch=B):
+            with self._recorder(job), \
+                    span(f"Job-{job.job_id}", metric="job",
+                         job_id=job.job_id, input=job.input,
+                         attempt=job.attempts, priority=job.priority,
+                         batch=B):
                 if isinstance(result, BaseException):
                     self._handle_failure(job, result)
                     continue
                 try:
                     write_search_output(result, cfg.outdir)
-                    ingested = self.store.ingest(
-                        job.job_id, job.input, result.candidates)
+                    with span("Store-Ingest", metric="store_ingest",
+                              job_id=job.job_id):
+                        ingested = self.store.ingest(
+                            job.job_id, job.input, result.candidates)
                     best = max((float(c.snr)
                                 for c in result.candidates), default=0.0)
                     summary = {
@@ -470,6 +521,7 @@ class SurveyWorker:
                     self._handle_failure(job, exc)
                     continue
             self.spool.mark_done(job, summary)
+            self._note_done(job)
             METRICS.inc("scheduler.succeeded")
             ok += 1
         return ok
@@ -491,6 +543,10 @@ class SurveyWorker:
             with span("Observation-Read", metric="obs_read",
                       input=job.input):
                 fil = read_filterbank(job.input)
+        else:
+            self._mark_job(job, "prefetch-hit")
+        if staged is not None:
+            self._mark_job(job, "stage")
         fil, search = self._build_search(fil, cfg)
         if staged is not None:
             # prefetch-thread upload (ISSUE 11): _device_inputs /
@@ -505,8 +561,10 @@ class SurveyWorker:
                 self._prefetcher.start(rec.input, job=rec)
         result = search.run()
         write_search_output(result, cfg.outdir)
-        ingested = self.store.ingest(
-            job.job_id, job.input, result.candidates)
+        with span("Store-Ingest", metric="store_ingest",
+                  job_id=job.job_id):
+            ingested = self.store.ingest(
+                job.job_id, job.input, result.candidates)
         best = max((float(c.snr) for c in result.candidates),
                    default=0.0)
         return {
@@ -536,6 +594,7 @@ class SurveyWorker:
         kind = classify_failure(exc)
         job.failures.append({
             "utc": round(time.time(), 3),
+            "t_mono": round(time.perf_counter(), 6),
             "attempt": job.attempts,
             "classification": kind,
             "error": f"{type(exc).__name__}: {exc}",
@@ -580,10 +639,13 @@ class SurveyWorker:
         """Run one claimed job through the retry machinery; True on
         success."""
         runner = self.run_job_fn or self._run_job
-        with span(f"Job-{job.job_id}", metric="job",
-                  job_id=job.job_id, input=job.input,
-                  attempt=job.attempts, priority=job.priority,
-                  batch=1):
+        resumes0 = int(METRICS.snapshot().get("counters", {}).get(
+            "checkpoint.resumes", 0))
+        with self._recorder(job), \
+                span(f"Job-{job.job_id}", metric="job",
+                     job_id=job.job_id, input=job.input,
+                     attempt=job.attempts, priority=job.priority,
+                     batch=1):
             try:
                 summary = run_with_timeout(
                     lambda: runner(job), self.timeout_s,
@@ -591,8 +653,14 @@ class SurveyWorker:
             except Exception as exc:
                 self._handle_failure(job, exc)
                 return False
+            resumed = int(METRICS.snapshot().get("counters", {}).get(
+                "checkpoint.resumes", 0)) - resumes0
+            if resumed > 0:
+                self._mark_job(job, "checkpoint-resume",
+                               resumes=resumed)
         self.spool.mark_done(job, summary if isinstance(summary, dict)
                              else {})
+        self._note_done(job)
         METRICS.inc("scheduler.succeeded")
         return True
 
@@ -607,6 +675,7 @@ class SurveyWorker:
 
         install_compile_hook()
         sampler = self._start_telemetry()
+        ov0 = timeline.overhead()  # mark-cost ledger origin
         t0 = time.time()
         span_c0 = span_cursor()  # drain-level duty-cycle ledger origin
         claimed = succeeded = 0
@@ -665,6 +734,12 @@ class SurveyWorker:
                 "overhead_s": round(sampler.overhead_s, 6),
                 "shard": sampler.path,
             }
+        ov1 = timeline.overhead()
+        summary["timeline"] = {
+            "marks": ov1["marks"] - ov0["marks"],
+            "overhead_s": round(ov1["seconds"] - ov0["seconds"], 6),
+            "errors": ov1["errors"] - ov0["errors"],
+        }
         self._append_throughput(summary)
         return summary
 
@@ -703,9 +778,11 @@ class SurveyWorker:
             make_history_record,
             stage_device_seconds,
         )
+        from .health import percentile
 
         snap = METRICS.snapshot()
         counters = snap.get("counters", {})
+        tl = summary.get("timeline", {})
         rec = make_history_record(
             "serve",
             {
@@ -729,6 +806,22 @@ class SurveyWorker:
                 "device_duty_cycle": float(
                     snap.get("gauges", {}).get("device_duty_cycle",
                                                0.0)),
+                # load observatory (ISSUE 12): end-to-end latency of
+                # the jobs this drain finished (sojourn = submit->done
+                # from timeline marks) and the cost of writing the
+                # timeline itself — perf_report's serve table shows
+                # the p95s next to jobs_per_hour
+                "sojourn_p50": round(
+                    percentile(self._sojourns, 0.50), 6),
+                "sojourn_p95": round(
+                    percentile(self._sojourns, 0.95), 6),
+                "queue_wait_p50": round(
+                    percentile(self._queue_waits, 0.50), 6),
+                "queue_wait_p95": round(
+                    percentile(self._queue_waits, 0.95), 6),
+                "timeline_marks": int(tl.get("marks", 0)),
+                "timeline_overhead_s": float(
+                    tl.get("overhead_s", 0.0)),
             },
             stage_device_s=stage_device_seconds(snap),
             config={

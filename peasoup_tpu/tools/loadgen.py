@@ -1,0 +1,672 @@
+"""Open-loop fleet load generator + saturation sweep
+(``make loadgen-smoke``).
+
+The missing half of the load observatory (ISSUE 12, ROADMAP item
+4(a)): the fleet has leases, batching, telemetry, health rules and a
+duty-cycle ledger, but nothing ever *measured* it under sustained
+traffic.  This tool submits synthetic filterbank streams at
+configurable **offered** arrival rates — open-loop, i.e. the submit
+schedule never waits for completions, exactly the regime where queues
+actually blow up (Dean & Barroso, "The Tail at Scale", CACM 2013) —
+against real ``fleet-worker`` subprocesses sharing one spool, and
+reports, per rate point:
+
+* achieved throughput vs offered rate (their ratio detects the
+  saturation knee — the highest offered rate the fleet still served
+  at >= :data:`KNEE_EFFICIENCY` efficiency);
+* p50/p95/p99 end-to-end sojourn (submit -> done, from each job's
+  lifecycle timeline — obs/timeline.py), decomposed by timeline phase
+  (per-phase mean/p95/share of sojourn);
+* quarantined (poison) jobs reported SEPARATELY so a bad input's
+  fast-fail can never flatter the latency percentiles;
+* the queue-depth trajectory and device duty cycle from the workers'
+  telemetry shards;
+* the cost of the timeline plane itself (submitter-side
+  ``obs/timeline.overhead()`` + the workers' ``timeline_mark`` timer
+  deltas), which ``--smoke`` gates under 1% of drain wall-clock — the
+  telemetry-sampler precedent.
+
+Results land in three places sharing one schema: a
+``saturation_report.json`` (the full per-point documents), one
+``kind:"loadgen"`` record in the bench history ledger
+(obs/history.py — the ``loadgen_saturation`` health rule reads the
+knee from there), and ``tools/perf_report.py``'s rate x percentile
+table.
+
+Job mixes are seeded and deterministic (same ``--seed`` -> identical
+arrival schedule, geometry buckets, priorities and poison picks), so
+a sweep is reproducible and diffable across PRs.  ``--inprocess``
+swaps the real search for a constant-service-time stub worker in this
+process — seconds instead of minutes, same queueing physics — which
+is what the saturation tests use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from .fleet_smoke import FAST, _check, _write_synthetic
+
+#: a rate point still "keeps up" while achieved/offered >= this;
+#: the knee is the last point that does
+KNEE_EFFICIENCY = 0.85
+
+#: telemetry cadence for loadgen workers (fast enough for queue-depth
+#: trajectories over bursts lasting seconds)
+TELEMETRY_INTERVAL_S = 0.2
+
+REPORT_BASENAME = "saturation_report.json"
+
+
+# --------------------------------------------------------------------------
+# deterministic mix + schedule
+# --------------------------------------------------------------------------
+
+def arrival_offsets(rate_per_s: float, n: int, rng) -> list[float]:
+    """Open-loop Poisson arrivals: cumulative offsets (seconds from
+    burst start) of ``n`` submissions at ``rate_per_s`` — seeded
+    exponential inter-arrival gaps, so the schedule is deterministic
+    per rng."""
+    if rate_per_s <= 0:
+        return [0.0] * n
+    gaps = rng.exponential(1.0 / float(rate_per_s), size=n)
+    return [round(float(t), 6) for t in np.cumsum(gaps)]
+
+
+def job_mix(n: int, rng, *, buckets=(4096,), priorities=(0,),
+            poison_fraction: float = 0.0) -> list[dict]:
+    """``n`` deterministic job specs: geometry bucket (sample count),
+    priority tier, per-job data seed, and which jobs are poisoned
+    (truncated mid-data -> typed quarantine at the worker)."""
+    n = int(n)
+    n_poison = min(n, int(round(float(poison_fraction) * n)))
+    poison = (set(rng.choice(n, size=n_poison, replace=False).tolist())
+              if n_poison else set())
+    return [{
+        "i": i,
+        "nsamps": int(buckets[int(rng.integers(0, len(buckets)))]),
+        "priority": int(priorities[int(rng.integers(0,
+                                                    len(priorities)))]),
+        "poison": i in poison,
+        "seed": int(rng.integers(0, 2**31 - 1)),
+    } for i in range(n)]
+
+
+def write_observations(specs: list[dict], obs_dir: str) -> list[dict]:
+    """Materialise each spec as a real filterbank (poisoned specs are
+    truncated 1 KiB short of their header's promise); sets
+    ``spec["path"]``."""
+    os.makedirs(obs_dir, exist_ok=True)
+    for spec in specs:
+        spec["path"] = _write_synthetic(
+            os.path.join(obs_dir, f"obs-{spec['i']:04d}.fil"),
+            nsamps=spec["nsamps"], seed=spec["seed"] % (2**16),
+            truncate_bytes=1024 if spec["poison"] else 0)
+    return specs
+
+
+def submit_burst(spool, specs: list[dict], offsets: list[float],
+                 overrides: dict | None = None, *, sleeper=None,
+                 clock=time.monotonic) -> list:
+    """Submit every spec on its open-loop schedule (sleeping out each
+    gap; a slow submitter shrinks gaps rather than re-planning — the
+    offered rate is a CEILING the report compares against what was
+    actually achieved)."""
+    from ..serve.retry import pause
+
+    t0 = clock()
+    recs = []
+    for spec, off in zip(specs, offsets):
+        delay = t0 + off - clock()
+        if delay > 0:
+            pause(delay, sleeper)
+        recs.append(spool.submit(spec["path"], dict(overrides or {}),
+                                 priority=spec["priority"]))
+    return recs
+
+
+# --------------------------------------------------------------------------
+# per-rate-point measurement
+# --------------------------------------------------------------------------
+
+def _point_stats(spool, *, offered_rate: float, n_jobs: int,
+                 elapsed_s: float, arrival_span_s: float = 0.0,
+                 timed_out: bool = False) -> dict:
+    """One rate point's report row: throughput, phase-decomposed
+    sojourn percentiles (done jobs ONLY), quarantine reported
+    separately, queue trajectory + duty cycle + timeline cost from
+    the workers' telemetry shards."""
+    from ..obs import timeline
+    from ..obs.telemetry import read_samples
+    from ..serve.health import percentile
+
+    def _latency(recs):
+        sojourns, phase_lists = [], {}
+        for rec in recs:
+            wd = os.path.join(spool.root, "work", rec.job_id)
+            doc = timeline.waterfall(timeline.read_timeline(wd),
+                                     job_id=rec.job_id)
+            soj = doc["sojourn_s"]
+            if soj <= 0:
+                soj = max(0.0, rec.finished_utc - rec.submitted_utc)
+            sojourns.append(soj)
+            for ph, s in doc["phase_s"].items():
+                phase_lists.setdefault(ph, []).append(s)
+        return sojourns, phase_lists
+
+    done = spool.jobs("done")
+    failed = spool.jobs("failed")
+    sojourns, phase_lists = _latency(done)
+    q_sojourns, _ = _latency(failed)
+    total_soj = sum(sojourns)
+    phases = {}
+    for ph, vals in sorted(phase_lists.items()):
+        tot = sum(vals)
+        phases[ph] = {
+            "mean_s": round(tot / len(vals), 6),
+            "p95_s": round(percentile(vals, 0.95), 6),
+            "share": round(tot / total_soj, 4) if total_soj > 0
+            else 0.0,
+        }
+    samples = read_samples(os.path.join(spool.root, "fleet"))
+    queue_depth = [
+        {"ts": round(float(s.get("ts", 0.0)), 3),
+         "host": s.get("host", ""),
+         "pending": int(s["queue"].get("pending", 0)),
+         "running": int(s["queue"].get("running", 0))}
+        for s in samples if isinstance(s.get("queue"), dict)
+    ]
+    device_s = mark_s = 0.0
+    marks = 0
+    for s in samples:
+        for name, delta in s.get("timers", {}).items():
+            if not isinstance(delta, dict):
+                continue
+            if name == "timeline_mark":
+                mark_s += float(delta.get("host_s", 0.0))
+                marks += int(delta.get("count", 0))
+            elif name != "job":  # job would double-count its stages
+                device_s += float(delta.get("device_s", 0.0))
+    achieved = len(done) / elapsed_s if elapsed_s > 0 else 0.0
+    # the schedule's EMPIRICAL rate: with small n the sampled
+    # exponential gaps can realize a window far from nominal, so knee
+    # detection compares achieved throughput against what was actually
+    # offered, not what was asked for
+    realized = (n_jobs / arrival_span_s if arrival_span_s > 0
+                else float(offered_rate))
+    return {
+        "offered_rate_per_s": round(float(offered_rate), 6),
+        "realized_rate_per_s": round(realized, 6),
+        "jobs": int(n_jobs),
+        "done": len(done),
+        "failed": len(failed),
+        "elapsed_s": round(elapsed_s, 3),
+        "timed_out": bool(timed_out),
+        "achieved_per_s": round(achieved, 6),
+        "sojourn": {
+            "p50_s": round(percentile(sojourns, 0.50), 6),
+            "p95_s": round(percentile(sojourns, 0.95), 6),
+            "p99_s": round(percentile(sojourns, 0.99), 6),
+            "mean_s": round(total_soj / len(sojourns), 6)
+            if sojourns else 0.0,
+            "n": len(sojourns),
+        },
+        "phases": phases,
+        # poison/quarantined jobs: their (fast) failure latency must
+        # never flatter the done-job percentiles above
+        "quarantined": {
+            "count": len(failed),
+            "sojourn_p50_s": round(percentile(q_sojourns, 0.50), 6),
+            "sojourn_p95_s": round(percentile(q_sojourns, 0.95), 6),
+        },
+        "queue_depth": queue_depth,
+        "device_duty_cycle": round(device_s / elapsed_s, 6)
+        if elapsed_s > 0 else 0.0,
+        "timeline": {"worker_marks": marks,
+                     "worker_overhead_s": round(mark_s, 6)},
+    }
+
+
+def _worker_cmd(spool_dir: str, host_id: int, host_count: int,
+                history: str) -> list[str]:
+    """A POLLING fleet worker (no ``--drain``): it claims whatever
+    arrives until the sweep terminates it — the service side of the
+    open loop."""
+    return [
+        sys.executable, "-m", "peasoup_tpu.serve",
+        "--spool", spool_dir, "fleet-worker",
+        "--host-id", str(host_id), "--host-count", str(host_count),
+        "--single_device", "--max-attempts", "2",
+        "--backoff-base", "0", "--history", history,
+        "--lease-ttl", "60", "--heartbeat", "0.5",
+        "--poll", "0.1",
+        "--telemetry-interval", str(TELEMETRY_INTERVAL_S),
+    ]
+
+
+def run_rate_point(point_dir: str, rate: float, specs: list[dict], *,
+                   workers: int = 2, overrides: dict | None = None,
+                   history: str, seed: int = 0,
+                   timeout_s: float = 900.0) -> dict:
+    """One offered-rate point against REAL fleet-worker subprocesses:
+    fresh spool, ``workers`` polling hosts, the burst submitted on its
+    open-loop schedule, then wait for the queue to drain (bounded by
+    ``timeout_s`` — a saturated point that can't drain still reports,
+    flagged ``timed_out``)."""
+    from ..serve.queue import JobSpool
+    from ..serve.retry import pause
+
+    os.makedirs(point_dir, exist_ok=True)
+    spool = JobSpool(os.path.join(point_dir, "jobs"))
+    rng = np.random.default_rng(seed)
+    offsets = arrival_offsets(rate, len(specs), rng)
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get(
+        "JAX_PLATFORMS", "cpu"))
+    logs, procs = [], []
+    for h in range(workers):
+        log = open(os.path.join(point_dir, f"worker-{h}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            _worker_cmd(spool.root, h, workers, history), env=env,
+            stdout=log, stderr=subprocess.STDOUT, text=True))
+    n = len(specs)
+    t0 = time.monotonic()
+    timed_out = False
+    try:
+        submit_burst(spool, specs, offsets, dict(FAST,
+                                                 **(overrides or {})))
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            c = spool.counts()
+            if (c["pending"] == 0 and c["running"] == 0
+                    and c["done"] + c["failed"] >= n):
+                break
+            if time.monotonic() > deadline:
+                timed_out = True
+                break
+            pause(0.1)
+    finally:
+        elapsed = time.monotonic() - t0
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=15)
+        for log in logs:
+            log.close()
+    return _point_stats(spool, offered_rate=rate, n_jobs=n,
+                        elapsed_s=elapsed,
+                        arrival_span_s=offsets[-1] if offsets else 0.0,
+                        timed_out=timed_out)
+
+
+def run_rate_point_inprocess(point_dir: str, rate: float, n: int, *,
+                             service_s: float = 0.03, seed: int = 0,
+                             timeout_s: float = 120.0) -> dict:
+    """One rate point with a constant-service-time stub worker in THIS
+    process — the real spool/claim/timeline machinery with the search
+    swapped out, so saturation tests run in seconds and the knee is
+    analytically checkable (capacity = 1/service_s)."""
+    from ..serve.queue import JobSpool
+    from ..serve.retry import pause
+    from ..serve.worker import SurveyWorker
+
+    os.makedirs(point_dir, exist_ok=True)
+    spool = JobSpool(os.path.join(point_dir, "jobs"))
+    rng = np.random.default_rng(seed)
+    specs = job_mix(n, rng)
+    for spec in specs:
+        spec["path"] = os.path.join(point_dir, f"obs-{spec['i']}.fil")
+    offsets = arrival_offsets(rate, n, rng)
+
+    def _serve(job):
+        pause(service_s)
+        return {"candidates": 0}
+
+    worker = SurveyWorker(
+        spool, prefetch=False, run_job_fn=_serve,
+        history_path=os.path.join(point_dir, "serve-history.jsonl"),
+        telemetry_interval_s=TELEMETRY_INTERVAL_S)
+    t0 = time.monotonic()
+    thread = threading.Thread(
+        target=lambda: worker.drain(max_jobs=n, wait=True,
+                                    poll_s=0.02),
+        daemon=True, name="loadgen-worker")
+    thread.start()
+    try:
+        submit_burst(spool, specs, offsets)
+        thread.join(timeout=float(timeout_s))
+    finally:
+        elapsed = time.monotonic() - t0
+    return _point_stats(spool, offered_rate=rate, n_jobs=n,
+                        elapsed_s=elapsed,
+                        arrival_span_s=offsets[-1] if offsets else 0.0,
+                        timed_out=thread.is_alive())
+
+
+# --------------------------------------------------------------------------
+# sweep + knee + report
+# --------------------------------------------------------------------------
+
+def detect_knee(points: list[dict],
+                efficiency: float = KNEE_EFFICIENCY) -> dict:
+    """The saturation knee over a sweep: the LAST offered rate (in
+    rate order) the fleet still served at >= ``efficiency`` of what
+    was offered (the REALIZED schedule rate — small bursts can sample
+    a window far from nominal); beyond it the queue grows without
+    bound.  If even the first point is saturated, the knee is that
+    point's ACHIEVED throughput — the best available capacity
+    estimate."""
+    pts = sorted(points, key=lambda p: p["offered_rate_per_s"])
+    keeping_up = [p for p in pts
+                  if p["achieved_per_s"]
+                  >= efficiency * p.get("realized_rate_per_s",
+                                        p["offered_rate_per_s"])
+                  and not p.get("timed_out")]
+    knee_pt = keeping_up[-1] if keeping_up else pts[0]
+    return {
+        "rate_per_s": knee_pt["offered_rate_per_s"],
+        "throughput_per_s": knee_pt["achieved_per_s"],
+        "saturated": len(keeping_up) < len(pts),
+        "efficiency_threshold": float(efficiency),
+    }
+
+
+def write_report(path: str, doc: dict) -> str:
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def append_loadgen_record(doc: dict, history: str | None) -> dict:
+    """One ``kind:"loadgen"`` ledger record per sweep: the knee is the
+    headline (the ``loadgen_saturation`` health rule compares live
+    arrival rates against it), plus slim per-rate rows for
+    perf_report's rate x percentile table."""
+    from ..obs.history import append_history, make_history_record
+
+    points = doc["points"]
+    knee = doc["knee"]
+    rec = make_history_record(
+        "loadgen",
+        {
+            "rates_swept": len(points),
+            "jobs_total": sum(p["jobs"] for p in points),
+            "jobs_done": sum(p["done"] for p in points),
+            "jobs_failed": sum(p["failed"] for p in points),
+            "knee_rate_per_s": knee["rate_per_s"],
+            "knee_throughput_per_s": knee["throughput_per_s"],
+            "max_achieved_per_s": max(
+                (p["achieved_per_s"] for p in points), default=0.0),
+            "timeline_overhead_frac": doc["timeline"]["overhead_frac"],
+        },
+        config=doc["config"],
+        extra={"rates": [{
+            "rate": p["offered_rate_per_s"],
+            "achieved": p["achieved_per_s"],
+            "p50_s": p["sojourn"]["p50_s"],
+            "p95_s": p["sojourn"]["p95_s"],
+            "p99_s": p["sojourn"]["p99_s"],
+            "duty": p["device_duty_cycle"],
+            "quarantined": p["quarantined"]["count"],
+        } for p in points]},
+    )
+    append_history(rec, history)
+    return rec
+
+
+def sweep(dirpath: str, rates: list[float], jobs: int, *,
+          workers: int = 2, seed: int = 0,
+          poison_fractions=None, buckets=(4096,), priorities=(0,),
+          overrides: dict | None = None, history: str | None = None,
+          timeout_s: float = 900.0, inprocess: bool = False,
+          service_s: float = 0.03, verbose: bool = True) -> dict:
+    """Run every rate point (fresh spool each), detect the knee, write
+    ``saturation_report.json`` + the ledger record; returns the full
+    report document."""
+    from ..obs import timeline
+
+    os.makedirs(dirpath, exist_ok=True)
+    if poison_fractions is None:
+        poison_fractions = [0.0] * len(rates)
+    elif not isinstance(poison_fractions, (list, tuple)):
+        poison_fractions = [float(poison_fractions)] * len(rates)
+    say = print if verbose else (lambda *a, **kw: None)
+    ov0 = timeline.overhead()
+    points = []
+    for i, rate in enumerate(rates):
+        point_dir = os.path.join(dirpath, f"rate-{i}")
+        say(f"loadgen: rate point {i} -- {rate:g} jobs/s x {jobs} "
+            f"job(s)" + (" [inprocess]" if inprocess else
+                         f" against {workers} worker(s)"))
+        if inprocess:
+            point = run_rate_point_inprocess(
+                point_dir, rate, jobs, service_s=service_s,
+                seed=seed + i, timeout_s=timeout_s)
+        else:
+            rng = np.random.default_rng(seed + i)
+            specs = write_observations(
+                job_mix(jobs, rng, buckets=buckets,
+                        priorities=priorities,
+                        poison_fraction=poison_fractions[i]),
+                os.path.join(point_dir, "obs"))
+            point = run_rate_point(
+                point_dir, rate, specs, workers=workers,
+                overrides=overrides, history=history or os.path.join(
+                    dirpath, "serve-history.jsonl"),
+                seed=seed + i, timeout_s=timeout_s)
+        say(f"loadgen: rate {rate:g}/s -> achieved "
+            f"{point['achieved_per_s']:g}/s, sojourn p50/p95/p99 = "
+            f"{point['sojourn']['p50_s']:g}/"
+            f"{point['sojourn']['p95_s']:g}/"
+            f"{point['sojourn']['p99_s']:g}s "
+            f"({point['done']} done, {point['failed']} failed)")
+        points.append(point)
+    ov1 = timeline.overhead()
+    wall = sum(p["elapsed_s"] for p in points)
+    overhead_s = (ov1["seconds"] - ov0["seconds"]) + sum(
+        p["timeline"]["worker_overhead_s"] for p in points)
+    doc = {
+        "v": 1,
+        "seed": int(seed),
+        "points": points,
+        "knee": detect_knee(points),
+        "timeline": {
+            "submitter_marks": ov1["marks"] - ov0["marks"],
+            "overhead_s": round(overhead_s, 6),
+            "overhead_frac": round(overhead_s / wall, 6)
+            if wall > 0 else 0.0,
+        },
+        "config": {
+            "jobs_per_rate": int(jobs),
+            "workers": int(workers),
+            "inprocess": bool(inprocess),
+            "buckets": list(buckets),
+            "priorities": list(priorities),
+            "poison_fractions": [float(f) for f in poison_fractions],
+            **({"service_s": service_s} if inprocess else {}),
+        },
+    }
+    doc["report_path"] = write_report(
+        os.path.join(dirpath, REPORT_BASENAME), doc)
+    doc["ledger_record"] = append_loadgen_record(doc, history)
+    return doc
+
+
+# --------------------------------------------------------------------------
+# smoke (make loadgen-smoke)
+# --------------------------------------------------------------------------
+
+def run_smoke(dirpath: str) -> int:
+    """Two-worker, two-rate saturation smoke with one poison job —
+    the ISSUE 12 acceptance gate.  Real ``fleet-worker`` subprocesses,
+    real searches, real timelines; every assertion prints PASS/FAIL
+    and the exit status is 0 only if all hold."""
+    shutil.rmtree(dirpath, ignore_errors=True)
+    os.makedirs(dirpath)
+    history = os.path.join(dirpath, "history.jsonl")
+    jobs = 15
+    failures: list[str] = []
+
+    doc = sweep(dirpath, rates=[1.0, 8.0], jobs=jobs, workers=2,
+                seed=7, poison_fractions=[1.0 / jobs, 0.0],
+                history=history, timeout_s=900.0)
+    points = doc["points"]
+
+    _check(os.path.exists(doc["report_path"]) and len(points) >= 2,
+           "saturation_report.json with >= 2 rate points", failures)
+    _check(all(not p["timed_out"] for p in points),
+           "both rate points drained inside the budget", failures)
+    _check(all(p["sojourn"]["n"] > 0
+               and p["sojourn"]["p50_s"] <= p["sojourn"]["p95_s"]
+               <= p["sojourn"]["p99_s"] for p in points),
+           "phase-decomposed p50<=p95<=p99 sojourn at every point",
+           failures)
+    _check(all(p["phases"] for p in points),
+           "every point decomposes sojourn by timeline phase",
+           failures)
+    _check(points[0]["quarantined"]["count"] == 1
+           and points[1]["quarantined"]["count"] == 0
+           and points[0]["done"] == jobs - 1
+           and points[0]["sojourn"]["n"] == jobs - 1,
+           "1 poison job quarantined and excluded from the "
+           "percentile pool (reported separately)", failures)
+    knee = doc["knee"]
+    _check(knee["throughput_per_s"] > 0,
+           f"saturation knee detected ({knee['rate_per_s']:g}/s "
+           f"offered -> {knee['throughput_per_s']:g}/s achieved)",
+           failures)
+
+    from peasoup_tpu.obs.history import load_history
+
+    lrecs = load_history(history, kinds=["loadgen"])
+    _check(len(lrecs) == 1 and lrecs[0]["metrics"][
+        "knee_throughput_per_s"] == knee["throughput_per_s"],
+        "kind:\"loadgen\" ledger record carries the knee", failures)
+
+    # -- the timeline verb: waterfall whose phase sum == sojourn -------
+    from peasoup_tpu.serve.queue import JobSpool
+
+    spool = JobSpool(os.path.join(dirpath, "rate-0", "jobs"))
+    done = spool.jobs("done")
+    job_id = done[0].job_id if done else ""
+    wf_json = os.path.join(dirpath, "waterfall.json")
+    trace_json = os.path.join(dirpath, "trace.json")
+    tl = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+         spool.root, "timeline", job_id, "--json", wf_json,
+         "--trace_json", trace_json],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=120)
+    print(tl.stdout.strip())
+    _check(tl.returncode == 0 and "sojourn" in tl.stdout
+           and "phase totals:" in tl.stdout,
+           "timeline verb renders the waterfall", failures)
+    wf = json.load(open(wf_json)) if os.path.exists(wf_json) else {}
+    phase_sum = sum(wf.get("phase_s", {}).values())
+    sojourn = wf.get("sojourn_s", 0.0)
+    _check(sojourn > 0
+           and abs(phase_sum - sojourn) <= 0.01 * sojourn + 1e-6,
+           f"waterfall phase sum ({phase_sum:.4f}s) ~= sojourn "
+           f"({sojourn:.4f}s)", failures)
+    _check(any(m.get("phase") in ("dispatch", "fold", "store-ingest")
+               for m in wf.get("marks", [])),
+           "worker span phases present in the merged timeline",
+           failures)
+    trace = (json.load(open(trace_json))
+             if os.path.exists(trace_json) else {})
+    _check(any(e.get("ph") == "X" and e.get("tid") == 1
+               for e in trace.get("traceEvents", [])),
+           "chrome export merges the worker's device spans", failures)
+
+    # -- the plane's own cost: <1% of drain wall-clock -----------------
+    frac = doc["timeline"]["overhead_frac"]
+    _check(0.0 <= frac < 0.01,
+           f"timeline overhead {100 * frac:.3f}% of drain wall-clock "
+           f"(< 1%)", failures)
+
+    if failures:
+        print(f"\nloadgen-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("\nloadgen-smoke: all checks passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-loadgen",
+        description="Peasoup-TPU - open-loop fleet load generator / "
+                    "saturation sweep",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-loadgen",
+                   help="scratch directory (one subdir per rate "
+                        "point; --smoke wipes it)")
+    p.add_argument("--rates", default="0.5,1,2,4",
+                   help="comma-separated offered rates (jobs/s)")
+    p.add_argument("--jobs", type=int, default=20,
+                   help="jobs per rate point")
+    p.add_argument("--workers", type=int, default=2,
+                   help="fleet-worker subprocesses per point")
+    p.add_argument("--seed", type=int, default=0,
+                   help="mix + schedule seed (same seed -> identical "
+                        "sweep)")
+    p.add_argument("--poison-fraction", type=float, default=0.0,
+                   help="fraction of each point's jobs truncated "
+                        "mid-data (quarantine path)")
+    p.add_argument("--buckets", default="4096",
+                   help="comma-separated geometry buckets (nsamps)")
+    p.add_argument("--priorities", default="0",
+                   help="comma-separated priority tiers")
+    p.add_argument("--history", default=None,
+                   help="bench history ledger for the kind:\"loadgen\" "
+                        "record (default: repo "
+                        "benchmarks/history.jsonl)")
+    p.add_argument("--timeout", type=float, default=900.0,
+                   help="per-point drain budget in seconds")
+    p.add_argument("--inprocess", action="store_true",
+                   help="stub constant-service worker in this process "
+                        "(seconds, not minutes; queueing physics "
+                        "only)")
+    p.add_argument("--service-s", type=float, default=0.03,
+                   help="--inprocess stub service time per job")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the loadgen-smoke acceptance gate "
+                        "instead of a custom sweep")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(args.dir)
+    rates = [float(r) for r in args.rates.split(",") if r.strip()]
+    doc = sweep(
+        args.dir, rates, args.jobs, workers=args.workers,
+        seed=args.seed, poison_fractions=args.poison_fraction,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        priorities=tuple(int(x) for x in args.priorities.split(",")),
+        history=args.history, timeout_s=args.timeout,
+        inprocess=args.inprocess, service_s=args.service_s)
+    knee = doc["knee"]
+    print(f"knee: {knee['rate_per_s']:g}/s offered -> "
+          f"{knee['throughput_per_s']:g}/s achieved"
+          + (" (fleet saturates beyond this)" if knee["saturated"]
+             else " (never saturated in this sweep)"))
+    print(f"wrote {doc['report_path']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

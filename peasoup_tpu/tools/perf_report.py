@@ -53,7 +53,7 @@ STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s")
 #: they are not gated by default (CPU smoke figures are noise) but
 #: ``--stage-metrics device_duty_cycle`` gates them correctly.
 HIGHER_IS_BETTER_METRICS = ("device_duty_cycle", "vs_baseline",
-                            "jobs_per_hour")
+                            "jobs_per_hour", "knee_throughput_per_s")
 
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
@@ -169,9 +169,11 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
     batched-dispatch engagement figures (``batch``, dispatches, mean
     fill), the drain's ``device_duty_cycle`` (ISSUE 11 — device
     seconds per wall second; low duty with work queued means the
-    pipeline is starving the devices) and the fleet host, so "did
-    batching engage" and "which host is slow" are answerable from the
-    default report view."""
+    pipeline is starving the devices), the end-to-end latency tail
+    (``sojourn_p95``/``queue_wait_p95``, from the per-job lifecycle
+    timelines — obs/timeline.py) and the fleet host, so "did batching
+    engage" and "which host is slow" are answerable from the default
+    report view."""
     records = load_history(ledger or default_ledger_path(),
                            kinds=("serve",))
     if not records:
@@ -179,11 +181,17 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
     jph = [float(r["metrics"]["jobs_per_hour"]) for r in records
            if isinstance(r.get("metrics", {}).get("jobs_per_hour"),
                          (int, float))]
+
+    def _sec(m, key):
+        v = m.get(key)
+        return f"{float(v):>7.3g}" if isinstance(v, (int, float)) \
+            else f"{'-':>7}"
+
     lines = [f"serve throughput ({len(records)} drain record(s); "
              f"newest last):",
              f"  {'ts':<20}{'host':<12}{'ok/claimed':>11}"
              f"{'jobs/h':>10}{'batch':>6}{'disp':>6}{'fill':>6}"
-             f"{'duty':>6}"]
+             f"{'duty':>6}{'soj95':>7}{'qw95':>7}"]
     for rec in records[-limit:]:
         m = rec.get("metrics", {})
         cfg = rec.get("config", {})
@@ -200,10 +208,54 @@ def serve_table(ledger: str | None = None, limit: int = 12) -> str:
             f"{float(m.get('jobs_per_hour', 0.0)):>10.4g}"
             f"{int(m.get('batch', 1)):>6}{disp:>6}{fill:>6}"
             + (f"{float(duty):>6.2f}"
-               if isinstance(duty, (int, float)) else f"{'-':>6}"))
+               if isinstance(duty, (int, float)) else f"{'-':>6}")
+            + _sec(m, "sojourn_p95") + _sec(m, "queue_wait_p95"))
     if jph:
         lines.append(f"  jobs/h trend: {sparkline(jph)}  "
                      f"(median {_median(jph):.4g}, last {jph[-1]:.4g})")
+    return "\n".join(lines)
+
+
+def loadgen_table(ledger: str | None = None) -> str:
+    """The newest saturation sweep (``kind:"loadgen"`` ledger record,
+    ``tools/loadgen.py``) as a rate x percentile table: offered vs
+    achieved throughput with the phase-decomposed sojourn tail per
+    rate point, the detected knee, and the knee-throughput trend
+    across sweeps."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("loadgen",))
+    if not records:
+        return ""
+    rec = records[-1]
+    m = rec.get("metrics", {})
+    lines = [f"loadgen saturation ({len(records)} sweep(s); newest "
+             f"from {str(rec.get('ts', ''))[:19]}):",
+             f"  {'rate/s':>8}{'ach/s':>8}{'p50_s':>9}{'p95_s':>9}"
+             f"{'p99_s':>9}{'duty':>6}{'quar':>6}"]
+    for row in rec.get("rates", []):
+        if not isinstance(row, dict):
+            continue
+        lines.append(
+            f"  {float(row.get('rate', 0.0)):>8.4g}"
+            f"{float(row.get('achieved', 0.0)):>8.4g}"
+            f"{float(row.get('p50_s', 0.0)):>9.4g}"
+            f"{float(row.get('p95_s', 0.0)):>9.4g}"
+            f"{float(row.get('p99_s', 0.0)):>9.4g}"
+            f"{float(row.get('duty', 0.0)):>6.2f}"
+            f"{int(row.get('quarantined', 0)):>6}")
+    knee_r = m.get("knee_rate_per_s")
+    knee_t = m.get("knee_throughput_per_s")
+    if isinstance(knee_t, (int, float)):
+        lines.append(f"  knee: {float(knee_r or 0.0):.4g}/s offered "
+                     f"-> {float(knee_t):.4g}/s achieved")
+    knees = [float(r["metrics"]["knee_throughput_per_s"])
+             for r in records
+             if isinstance(r.get("metrics", {}).get(
+                 "knee_throughput_per_s"), (int, float))]
+    if len(knees) > 1:
+        lines.append(f"  knee trend: {sparkline(knees)}  "
+                     f"(median {_median(knees):.4g}, "
+                     f"last {knees[-1]:.4g})")
     return "\n".join(lines)
 
 
@@ -370,6 +422,10 @@ def main(argv=None) -> int:
         if sv:
             print()
             print(sv)
+        lg = loadgen_table(args.ledger)
+        if lg:
+            print()
+            print(lg)
     if gate_msg:
         print()
         print(gate_msg)

@@ -32,6 +32,7 @@ from peasoup_tpu.serve.health import (
     rule_device_duty_cycle,
     rule_hbm_watermark,
     rule_lease_reap_burst,
+    rule_loadgen_saturation,
     rule_queue_backlog,
     rule_retry_spike,
     rule_stale_host,
@@ -632,3 +633,72 @@ def test_three_fake_hosts_drain_with_live_telemetry(tmp_path):
     # fleet_report v2 embeds the same verdict
     fr = fleet_report(spool)
     assert fr["v"] == 2 and fr["health"]["severity"] == OK
+
+
+# --------------------------------------------------------------------------
+# rule: loadgen_saturation (ISSUE 12)
+# --------------------------------------------------------------------------
+
+def _loadgen_rec(knee):
+    return {"kind": "loadgen",
+            "metrics": {"knee_throughput_per_s": knee}}
+
+
+def _arrival_ctx(submits_per_sample, *, ledger):
+    """Two shard samples spanning 100 s with per-sample submit deltas:
+    arrival rate = 2 * submits_per_sample / 100."""
+    return _ctx(
+        [_sample("h0", NOW - 100.0,
+                 counters={"scheduler.submitted": submits_per_sample}),
+         _sample("h0", NOW,
+                 counters={"scheduler.submitted": submits_per_sample})],
+        ledger=ledger)
+
+
+def test_loadgen_saturation_ok_without_baseline():
+    """No loadgen sweep in the ledger is normal, not unhealthy."""
+    (f,) = rule_loadgen_saturation(_arrival_ctx(50, ledger=[]))
+    assert f.severity == OK
+    assert f.data["knee_throughput_per_s"] is None
+    assert "loadgen-smoke" in f.message
+
+
+def test_loadgen_saturation_ok_under_the_knee():
+    # 2 * 50 / 100s = 1.0/s against a 2.0/s knee -> ratio 0.5
+    ctx = _arrival_ctx(50, ledger=[_loadgen_rec(2.0)])
+    (f,) = rule_loadgen_saturation(ctx)
+    assert f.severity == OK
+    assert f.data["ratio"] == pytest.approx(0.5)
+
+
+def test_loadgen_saturation_warn_above_knee():
+    # 2 * 125 / 100s = 2.5/s -> ratio 1.25: growing, not yet runaway
+    ctx = _arrival_ctx(125, ledger=[_loadgen_rec(2.0)])
+    (f,) = rule_loadgen_saturation(ctx)
+    assert f.severity == WARN
+    assert f.data["arrival_rate_per_s"] == pytest.approx(2.5)
+
+
+def test_loadgen_saturation_crit_and_newest_sweep_wins():
+    # 2 * 200 / 100s = 4.0/s -> ratio 2.0 against the NEWEST knee;
+    # the stale 100/s sweep earlier in the ledger must be ignored
+    ctx = _arrival_ctx(200, ledger=[_loadgen_rec(100.0),
+                                    _loadgen_rec(2.0)])
+    (f,) = rule_loadgen_saturation(ctx)
+    assert f.severity == CRIT
+    assert f.data["knee_throughput_per_s"] == pytest.approx(2.0)
+    assert "shed load" in f.message
+
+
+def test_loadgen_saturation_nonpositive_knee_is_ok():
+    """A sweep that never found a sustainable rate carries knee 0.0 —
+    no usable baseline, so the rule stays quiet rather than dividing
+    by zero."""
+    ctx = _arrival_ctx(200, ledger=[_loadgen_rec(0.0)])
+    (f,) = rule_loadgen_saturation(ctx)
+    assert f.severity == OK
+    assert f.data["knee_throughput_per_s"] == 0.0
+
+
+def test_loadgen_saturation_registered_in_rule_set():
+    assert rule_loadgen_saturation in RULES

@@ -1,0 +1,307 @@
+"""Load-observatory tests: seeded loadgen determinism, timeline mark
+schema round-trip + torn-tail tolerance, the clock-skew-tolerant
+two-host merge, the exact waterfall partition invariant, the
+``timeline`` serve verb, and a two-rate in-process saturation sweep
+with knee detection and monotone sojourn growth."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.obs import timeline
+from peasoup_tpu.obs.history import load_history
+from peasoup_tpu.obs.metrics import REGISTRY
+from peasoup_tpu.serve import JobSpool
+from peasoup_tpu.tools import loadgen
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+# --------------------------------------------------------------------------
+# deterministic mix + schedule
+# --------------------------------------------------------------------------
+
+def test_arrival_offsets_seeded_and_monotone():
+    a = loadgen.arrival_offsets(4.0, 32, np.random.default_rng(11))
+    b = loadgen.arrival_offsets(4.0, 32, np.random.default_rng(11))
+    assert a == b  # same seed -> identical schedule
+    assert all(y >= x for x, y in zip(a, a[1:]))
+    assert len(a) == 32
+    # mean inter-arrival ~ 1/rate (loose: 32 samples)
+    assert 0.1 < a[-1] / 32 < 0.6
+    c = loadgen.arrival_offsets(4.0, 8, np.random.default_rng(12))
+    assert a[:8] != c  # different seed -> different schedule
+
+
+def test_arrival_offsets_zero_rate_is_instant_burst():
+    assert loadgen.arrival_offsets(0.0, 5,
+                                   np.random.default_rng(0)) == [0.0] * 5
+
+
+def test_job_mix_deterministic_with_buckets_and_poison():
+    kw = dict(buckets=(2048, 4096), priorities=(0, 5),
+              poison_fraction=0.25)
+    a = loadgen.job_mix(16, np.random.default_rng(7), **kw)
+    b = loadgen.job_mix(16, np.random.default_rng(7), **kw)
+    assert a == b
+    assert [s["i"] for s in a] == list(range(16))
+    assert sum(s["poison"] for s in a) == 4  # round(0.25 * 16)
+    assert {s["nsamps"] for s in a} <= {2048, 4096}
+    assert {s["priority"] for s in a} <= {0, 5}
+
+
+def test_job_mix_poison_capped_at_n():
+    specs = loadgen.job_mix(3, np.random.default_rng(0),
+                            poison_fraction=5.0)
+    assert sum(s["poison"] for s in specs) == 3
+
+
+# --------------------------------------------------------------------------
+# timeline: schema round-trip, torn tail, skewed merge, partition
+# --------------------------------------------------------------------------
+
+def test_mark_roundtrip_schema(tmp_path):
+    wd = str(tmp_path / "work" / "j1")
+    rec = timeline.mark(wd, "submit", host="h0", attempt=0,
+                        t_wall=1000.0, priority=3)
+    assert rec["v"] == timeline.TIMELINE_VERSION
+    (m,) = timeline.read_timeline(wd)
+    assert m["phase"] == "submit"
+    assert m["t_wall"] == 1000.0
+    assert isinstance(m["t_mono"], float)
+    assert (m["host"], m["pid"], m["attempt"]) == ("h0", os.getpid(), 0)
+    assert m["priority"] == 3  # attrs ride along
+    ov = timeline.overhead()
+    assert ov["marks"] >= 1 and ov["seconds"] > 0
+
+
+def test_read_timeline_skips_torn_tail_and_garbage(tmp_path):
+    wd = str(tmp_path / "j")
+    timeline.mark(wd, "submit", t_wall=1.0)
+    timeline.mark(wd, "claim", t_wall=2.0)
+    with open(timeline.timeline_path(wd), "a") as f:
+        f.write("not json\n")
+        f.write('{"phase": "bad-no-clocks"}\n')
+        f.write('{"phase": "done", "t_wall": 3.0, "t_mo')  # torn tail
+    phases = [m["phase"] for m in timeline.read_timeline(wd)]
+    assert phases == ["submit", "claim"]
+
+
+def test_read_timeline_missing_file_is_empty(tmp_path):
+    assert timeline.read_timeline(str(tmp_path / "nope")) == []
+
+
+def _two_host_marks(wd, *, claim_wall):
+    """submit on host a; claim+done on host b whose wall clock is
+    ``claim_wall`` (5 monotonic seconds of service either way)."""
+    timeline.mark(wd, "submit", host="a", t_wall=1000.0, t_mono=50.0)
+    timeline.mark(wd, "claim", host="b", t_wall=claim_wall,
+                  t_mono=10.0)
+    timeline.mark(wd, "done", host="b", t_wall=claim_wall + 5.0,
+                  t_mono=15.0)
+    return timeline.read_timeline(wd)
+
+
+def test_stitch_two_hosts_aligned_by_wall_delta(tmp_path):
+    # host b's clock agrees: claimed 2s after submit
+    marks = _two_host_marks(str(tmp_path / "j"), claim_wall=1002.0)
+    stitched = timeline.stitch(marks)
+    assert [(m["phase"], m["t"]) for m in stitched] == [
+        ("submit", 0.0), ("claim", 2.0), ("done", 7.0)]
+
+
+def test_stitch_skewed_host_clamps_never_time_travels(tmp_path):
+    # host b's wall clock runs 3s BEHIND: raw delta would put the
+    # claim before the submit; the clamp pins it at the submit instead
+    marks = _two_host_marks(str(tmp_path / "j"), claim_wall=997.0)
+    stitched = timeline.stitch(marks)
+    assert [(m["phase"], m["t"]) for m in stitched] == [
+        ("submit", 0.0), ("claim", 0.0), ("done", 5.0)]
+    assert all(m["t"] >= 0 for m in stitched)
+
+
+def test_waterfall_phase_sum_equals_sojourn_exactly(tmp_path):
+    wd = str(tmp_path / "j")
+    marks = _two_host_marks(wd, claim_wall=1002.0)
+    doc = timeline.waterfall(marks, job_id="j")
+    assert doc["sojourn_s"] == pytest.approx(7.0)
+    assert sum(doc["phase_s"].values()) == pytest.approx(
+        doc["sojourn_s"], abs=1e-9)  # exact partition, not approx
+    assert doc["phase_s"]["claim"] == pytest.approx(2.0)  # queue wait
+    assert doc["phase_s"]["done"] == pytest.approx(5.0)   # service
+    assert doc["outcome"] == "done"
+    assert {"host": "a", "pid": os.getpid()} in doc["writers"]
+
+
+def test_queue_wait_from_clamps_backwards_wall(tmp_path):
+    wd = str(tmp_path / "j")
+    # submit stamped by a host whose clock is AHEAD of the claimer's
+    timeline.mark(wd, "submit", host="a", t_wall=2000.0, t_mono=1.0)
+    wait = timeline.queue_wait_from(wd, host="b", t_wall=1990.0)
+    assert wait == 0.0  # clock step cannot produce a negative wait
+    assert timeline.queue_wait_from(str(tmp_path / "empty")) is None
+
+
+def test_chrome_trace_events_lifecycle_and_span_rows(tmp_path):
+    wd = str(tmp_path / "j")
+    timeline.mark(wd, "submit", host="a", t_wall=1000.0, t_mono=1.0)
+    timeline.mark(wd, "dispatch", host="a", t_wall=1002.0, t_mono=3.0,
+                  dur_s=1.5, device_s=0.5)
+    doc = timeline.waterfall(timeline.read_timeline(wd), job_id="j")
+    events = timeline.chrome_trace_events(doc)
+    tids = {e.get("tid") for e in events if e.get("ph") == "X"}
+    assert {0, 1} <= tids  # lifecycle row + span-derived row
+
+
+def test_span_phase_mapping():
+    assert timeline.phase_for_span("Folding") == "fold"
+    assert timeline.phase_for_span("Chunked-Search-3") == "dispatch"
+    assert timeline.phase_for_span("Job-abc123") is None
+
+
+# --------------------------------------------------------------------------
+# spool integration + the timeline verb
+# --------------------------------------------------------------------------
+
+def _drain_one(tmp_path, sojourn_sleeper=None):
+    from peasoup_tpu.serve import SurveyWorker
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/obs.fil", priority=2)
+    worker = SurveyWorker(
+        spool, prefetch=False,
+        run_job_fn=lambda job: {"candidates": 0},
+        history_path=str(tmp_path / "h.jsonl"),
+        telemetry_interval_s=0.0, sleeper=lambda s: None)
+    worker.drain()
+    return spool, rec
+
+
+def test_spool_transitions_write_marks_and_queue_wait(tmp_path):
+    spool, rec = _drain_one(tmp_path)
+    marks = timeline.read_timeline(spool.work_dir(rec.job_id))
+    phases = [m["phase"] for m in marks]
+    assert phases[0] == "submit" and "claim" in phases
+    assert phases[-1] == "done"
+    assert marks[0]["priority"] == 2
+    done = spool.jobs("done")[0]
+    assert done.queue_wait_s >= 0.0
+    soj = timeline.sojourn_for(spool.work_dir(rec.job_id))
+    assert soj is not None and soj >= 0.0
+
+
+def test_timeline_verb_renders_waterfall(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import main
+
+    spool, rec = _drain_one(tmp_path)
+    wf_json = str(tmp_path / "wf.json")
+    trace_json = str(tmp_path / "trace.json")
+    code = main(["--spool", spool.root, "timeline", rec.job_id,
+                 "--json", wf_json, "--trace_json", trace_json])
+    out = capsys.readouterr().out
+    assert code == 0
+    assert rec.job_id in out and "sojourn" in out
+    assert "phase totals:" in out
+    doc = json.load(open(wf_json))
+    assert sum(doc["phase_s"].values()) == pytest.approx(
+        doc["sojourn_s"], abs=1e-6)
+    assert doc["state"] == "done"
+    trace = json.load(open(trace_json))
+    assert any(e.get("ph") == "X" for e in trace["traceEvents"])
+
+
+def test_timeline_verb_unknown_job_exits_nonzero(tmp_path, capsys):
+    from peasoup_tpu.serve.cli import main
+
+    JobSpool(str(tmp_path / "jobs"))
+    code = main(["--spool", str(tmp_path / "jobs"), "timeline",
+                 "no-such-job"])
+    assert code == 1
+    assert "no timeline marks" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------
+# saturation sweep (in-process stub workers)
+# --------------------------------------------------------------------------
+
+def test_detect_knee_orders_and_thresholds():
+    points = [
+        {"offered_rate_per_s": 1.0, "realized_rate_per_s": 1.0,
+         "achieved_per_s": 0.99},
+        {"offered_rate_per_s": 4.0, "realized_rate_per_s": 4.0,
+         "achieved_per_s": 3.8},
+        {"offered_rate_per_s": 16.0, "realized_rate_per_s": 16.0,
+         "achieved_per_s": 5.0},
+    ]
+    knee = loadgen.detect_knee(points)
+    assert knee["rate_per_s"] == 4.0
+    assert knee["throughput_per_s"] == 3.8
+    assert knee["saturated"] is True
+
+
+def test_detect_knee_all_saturated_reports_first_point_capacity():
+    points = [{"offered_rate_per_s": 8.0, "realized_rate_per_s": 8.0,
+               "achieved_per_s": 2.0}]
+    knee = loadgen.detect_knee(points)
+    assert knee["rate_per_s"] == 8.0
+    assert knee["throughput_per_s"] == 2.0
+    assert knee["saturated"] is True
+
+
+def test_two_rate_inprocess_sweep_knee_and_monotone_sojourn(tmp_path):
+    """One rate well under the stub capacity (1/service_s = 50/s),
+    one far over: the sweep must keep up at the low rate, saturate at
+    the high one, and show sojourn growing with offered load."""
+    history = str(tmp_path / "history.jsonl")
+    doc = loadgen.sweep(
+        str(tmp_path / "sweep"), rates=[8.0, 200.0], jobs=12, seed=5,
+        history=history, timeout_s=60.0, inprocess=True,
+        service_s=0.02, verbose=False)
+    lo, hi = doc["points"]
+    assert lo["done"] == 12 and hi["done"] == 12
+    assert not lo["timed_out"] and not hi["timed_out"]
+    # the saturated point's sojourn dominates the underloaded one's
+    assert hi["sojourn"]["p50_s"] > lo["sojourn"]["p50_s"]
+    assert hi["sojourn"]["p95_s"] > lo["sojourn"]["p95_s"]
+    # knee = the low rate point (the high one can't keep up)
+    assert doc["knee"]["rate_per_s"] == 8.0
+    assert doc["knee"]["saturated"] is True
+    assert doc["knee"]["throughput_per_s"] == lo["achieved_per_s"]
+    # percentile ordering within every point
+    for p in (lo, hi):
+        s = p["sojourn"]
+        assert s["p50_s"] <= s["p95_s"] <= s["p99_s"]
+        assert p["phases"]  # phase decomposition present
+        assert sum(ph["mean_s"] * s["n"] for ph in
+                   p["phases"].values()) == pytest.approx(
+            s["mean_s"] * s["n"], rel=1e-3)
+    # report written + ledger record with the knee
+    report = json.load(open(os.path.join(str(tmp_path / "sweep"),
+                                         loadgen.REPORT_BASENAME)))
+    assert len(report["points"]) == 2
+    (rec,) = load_history(history, kinds=["loadgen"])
+    assert rec["metrics"]["knee_throughput_per_s"] == \
+        doc["knee"]["throughput_per_s"]
+    assert rec["metrics"]["jobs_total"] == 24
+    assert len(rec["rates"]) == 2
+
+
+def test_sweep_is_seed_deterministic_in_schedule(tmp_path):
+    """Same seed -> same specs and offsets (the timing measurements
+    differ run to run; the INPUTS must not)."""
+    rng1 = np.random.default_rng(21)
+    rng2 = np.random.default_rng(21)
+    specs1 = loadgen.job_mix(20, rng1, buckets=(2048, 4096),
+                             poison_fraction=0.1)
+    specs2 = loadgen.job_mix(20, rng2, buckets=(2048, 4096),
+                             poison_fraction=0.1)
+    assert specs1 == specs2
+    assert loadgen.arrival_offsets(3.0, 20, rng1) == \
+        loadgen.arrival_offsets(3.0, 20, rng2)

@@ -574,3 +574,61 @@ def test_batched_drain_quarantines_failing_beam(tmp_path):
         # per-beam checkpoints were consumed on success, not leaked
         assert not os.path.exists(
             os.path.join(spool.work_dir(rec.job_id), "search.ckpt"))
+
+
+# --------------------------------------------------------------------------
+# load observatory (ISSUE 12): dual-clock failure history and the
+# drain ledger's latency percentiles
+# --------------------------------------------------------------------------
+
+
+def test_failure_history_carries_monotonic_clock(tmp_path):
+    """Every failure entry records BOTH clocks: ``utc`` (wall, for
+    humans and cross-host merging) and ``t_mono`` (monotonic, so
+    per-process failure spacing survives NTP steps)."""
+    spool = JobSpool(str(tmp_path / "jobs"))
+    spool.submit(_write_fil(tmp_path / "obs.fil"), FAST)
+
+    def _explode(job):
+        raise ConfigError("injected config failure")
+
+    worker = SurveyWorker(spool, single_device=True, prefetch=False,
+                          run_job_fn=_explode, sleeper=lambda s: None,
+                          history_path=str(tmp_path / "h.jsonl"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        worker.drain()
+
+    entry = spool.jobs("failed")[0].failures[-1]
+    assert entry["classification"] == QUARANTINE
+    assert "utc" in entry
+    assert isinstance(entry["t_mono"], float) and entry["t_mono"] > 0
+
+
+def test_drain_ledger_records_sojourn_percentiles(tmp_path):
+    """A drain's serve ledger record carries the end-to-end latency of
+    the jobs it finished (sojourn/queue-wait p95 from the per-job
+    timelines) plus the timeline's own bookkeeping cost."""
+    from peasoup_tpu.obs.history import load_history
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    for i in range(3):
+        spool.submit(_write_fil(tmp_path / f"obs{i}.fil", seed=i), FAST)
+    history = str(tmp_path / "h.jsonl")
+    worker = SurveyWorker(spool, single_device=True, prefetch=False,
+                          run_job_fn=lambda job: {"candidates": 0},
+                          sleeper=lambda s: None, history_path=history)
+    summary = worker.drain()
+    assert summary["succeeded"] == 3
+
+    (rec,) = load_history(history, kinds=["serve"])
+    m = rec["metrics"]
+    for key in ("sojourn_p50", "sojourn_p95",
+                "queue_wait_p50", "queue_wait_p95"):
+        assert isinstance(m[key], float), key
+    # sojourn includes the queue wait, so the p95s must be ordered
+    assert m["sojourn_p95"] >= m["queue_wait_p95"] >= 0.0
+    assert m["sojourn_p95"] > 0.0
+    # the worker self-accounts its OWN marks (claim + done per job;
+    # the submit mark belongs to the submitter's ledger)
+    assert m["timeline_marks"] >= 6
+    assert m["timeline_overhead_s"] >= 0.0
