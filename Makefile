@@ -10,8 +10,9 @@ BATCH_SMOKE_DIR ?= /tmp/peasoup-batch-smoke
 HEALTH_SMOKE_DIR ?= /tmp/peasoup-health-smoke
 PIPELINE_SMOKE_DIR ?= /tmp/peasoup-pipeline-smoke
 LOADGEN_SMOKE_DIR ?= /tmp/peasoup-loadgen-smoke
+JERK_SMOKE_DIR ?= /tmp/peasoup-jerk-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -115,3 +116,14 @@ pipeline-smoke:
 loadgen-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.loadgen --smoke \
 	    --dir $(LOADGEN_SMOKE_DIR)
+
+# jerk-search smoke test (ISSUE 13): zero-jerk runs must be
+# bit-identical to the accel-only default; a {-j, 0, +j} jerk grid
+# must recover a synthetic jerk-smeared pulse the accel-only grid
+# misses; forced u8/bf16 trial lattices must keep the recovery and
+# write a parity-gated lattice sidecar that `auto` resolution honors
+# (and refuses when a verdict fails); a kind:"jerk_smoke" ledger
+# record must round-trip
+jerk-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.jerk_smoke \
+	    --dir $(JERK_SMOKE_DIR)

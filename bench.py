@@ -41,6 +41,14 @@ bit-identical before any number is reported.  Both drains report
 their measured ``device_duty_cycle`` (device seconds per wall second
 over the span ledger), the gauge the pipeline exists to raise.
 
+``--jerk [N]`` (default 5 trials) runs the jerk-axis benchmark
+instead: an accel-only search vs the same search with an N-trial jerk
+grid over one synthetic observation, asserting every accel-only
+candidate survives in the jerked run (the grid contains the zero
+trial) before reporting the per-trial cost ratio; appends a
+``kind="jerk"`` ledger record with per-stage device seconds and the
+resolved trial lattice.
+
 ``--loadgen [N]`` (default 16 jobs/rate) runs the open-loop
 saturation micro-bench instead: a seeded two-rate in-process sweep
 (``tools/loadgen.py`` — one rate under the stub workers' capacity,
@@ -400,6 +408,119 @@ def run_loadgen_bench(jobs: int) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def jerk_arg(argv: list[str]) -> int | None:
+    """``--jerk [N]``: run the jerk-axis benchmark with an N-trial jerk
+    grid (default 5; forced odd so the grid contains the exact zero
+    trial the parity check relies on)."""
+    if "--jerk" not in argv:
+        return None
+    i = argv.index("--jerk")
+    n = 5
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        n = max(3, int(argv[i + 1]))
+    return n if n % 2 else n + 1
+
+
+def run_jerk_bench(njerk: int) -> int:
+    """``bench.py --jerk N``: accel-only vs accel x N-jerk searches over
+    the same synthetic observation (ISSUE 13).  The jerk grid contains
+    the zero trial, so every candidate the accel-only search finds must
+    survive in the jerked run (a grid that loses its own zero slice is
+    broken, not bigger); that containment is asserted before any number
+    is reported.  Prints one JSON line with both wall-clocks, the trial
+    multiplier, and the per-trial cost ratio, and appends a
+    ``kind="jerk"`` ledger record carrying per-stage device seconds and
+    the resolved trial lattice so the tuner's pick is trendable."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.obs.costmodel import get_run_costs
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.search.plan import SearchConfig
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.tools.batch_smoke import _write_synthetic
+
+    work = tempfile.mkdtemp(prefix="peasoup-jerk-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        path = _write_synthetic(os.path.join(work, "obs.fil"), seed=0)
+        base = dict(dm_end=20.0, acc_start=-5.0, acc_end=5.0,
+                    min_snr=6.0, npdmp=0, limit=32)
+        half = (njerk - 1) // 2
+        step = 10.0
+        modes = {}
+        cands = {}
+        for label, extra in (
+            ("accel_only", {}),
+            ("jerk", dict(jerk_start=-half * step,
+                          jerk_end=half * step, jerk_step=step)),
+        ):
+            REGISTRY.reset()
+            fil = read_filterbank(path)
+            search = MeshPulsarSearch(fil, SearchConfig(**base, **extra))
+            search.run()  # warm-up absorbs compilation
+            t0 = time.time()
+            result = search.run()
+            elapsed = time.time() - t0
+            snap = REGISTRY.snapshot()
+            geom = get_run_costs()["geometry"]
+            cands[label] = [(round(c.freq, 9), round(float(c.dm), 3))
+                            for c in result.candidates]
+            modes[label] = {
+                "elapsed_s": round(elapsed, 4),
+                "n_trials_total": int(geom.n_trials_total),
+                "njerk": int(geom.njerk),
+                "trial_lattice": str(search.lattice),
+                "s_per_ktrial": round(
+                    1e3 * elapsed / max(geom.n_trials_total, 1), 4),
+                "stage_device_s": {
+                    k: round(rec.get("device_s", 0.0), 6)
+                    for k, rec in snap["timers"].items()
+                    if rec.get("device_s", 0.0) > 0.0},
+            }
+        missing = [c for c in cands["accel_only"]
+                   if c not in cands["jerk"]]
+        parity_ok = not missing
+        mult = (modes["jerk"]["n_trials_total"]
+                / max(modes["accel_only"]["n_trials_total"], 1))
+        out = {
+            "metric": "jerk_grid_s_per_ktrial",
+            "value": modes["jerk"]["s_per_ktrial"],
+            "unit": "s/ktrial",
+            "njerk": njerk,
+            "trial_multiplier": round(mult, 3),
+            "wallclock_ratio": round(
+                modes["jerk"]["elapsed_s"]
+                / max(modes["accel_only"]["elapsed_s"], 1e-9), 3),
+            "trial_lattice": modes["jerk"]["trial_lattice"],
+            "modes": modes,
+            "parity": ("accel-only candidates all survive the jerk "
+                       "grid" if parity_ok else
+                       f"JERK GRID LOST {len(missing)} ACCEL-ONLY "
+                       f"CANDIDATES"),
+        }
+        print(json.dumps(out))
+        from peasoup_tpu.obs.history import (
+            append_history, make_history_record,
+        )
+
+        append_history(make_history_record(
+            "jerk",
+            metrics={"jerk_s_per_ktrial": out["value"],
+                     "jerk_wallclock_ratio": out["wallclock_ratio"],
+                     "jerk_trial_multiplier": out["trial_multiplier"],
+                     "njerk": njerk},
+            stage_device_s=modes["jerk"]["stage_device_s"],
+            parity=out["parity"],
+            extra={"trial_lattice": modes["jerk"]["trial_lattice"]},
+        ), path=history)
+        return 0 if parity_ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -423,6 +544,9 @@ def main() -> None:
     lg = loadgen_arg(sys.argv[1:])
     if lg is not None:
         sys.exit(run_loadgen_bench(lg))
+    jk = jerk_arg(sys.argv[1:])
+    if jk is not None:
+        sys.exit(run_jerk_bench(jk))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

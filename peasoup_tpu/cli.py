@@ -51,6 +51,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "driver's 0.5 m/s/s grid, src/pipeline.cpp:287); "
                         "0 = tolerance-stepped DM-dependent grid")
     p.add_argument("--acc_pulse_width", type=float, default=64.0)
+    p.add_argument("--jerk_start", type=float, default=0.0,
+                   help="jerk (accel-derivative) grid start, m/s^3; "
+                        "start=end=0 (default) disables the jerk axis")
+    p.add_argument("--jerk_end", type=float, default=0.0,
+                   help="jerk grid end, m/s^3")
+    p.add_argument("--jerk_step", type=float, default=0.0,
+                   help="fixed jerk step, m/s^3 (required nonzero when "
+                        "start != end); the grid always includes 0 "
+                        "when the range straddles it")
     p.add_argument("--boundary_5_freq", type=float, default=0.05)
     p.add_argument("--boundary_25_freq", type=float, default=0.5)
     p.add_argument("-n", "--nharmonics", type=int, default=4)
@@ -109,6 +118,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "sums (default; strictly more information), 8 "
                         "reproduces the reference's uint8 trial "
                         "quantisation (dedisp out_nbits=8) exactly")
+    p.add_argument("--trial_lattice", default="auto",
+                   choices=("auto", "f32", "u8", "bf16"),
+                   help="dedispersed trial storage lattice: auto "
+                        "(default) consults the tuner sidecar's "
+                        "parity-gated pick for this device/geometry "
+                        "and falls back to f32; f32/u8/bf16 force a "
+                        "dtype (u8 requires nbits<=8 input)")
     p.add_argument("--measure_stages",
                    action=argparse.BooleanOptionalAction, default=False,
                    help="clock a dedicated dedispersion dispatch so "

@@ -18,6 +18,10 @@ class Candidate:
     dm: float = 0.0
     dm_idx: int = 0
     acc: float = 0.0
+    #: acceleration derivative (m/s^3) of the trial that produced this
+    #: detection; 0.0 for accel-only searches (and for every candidate
+    #: deserialised from a pre-jerk checkpoint)
+    jerk: float = 0.0
     nh: int = 0
     snr: float = 0.0
     freq: float = 0.0

@@ -157,15 +157,18 @@ def fft_real_flops(n: int) -> float:
 # -- per-call unit costs ----------------------------------------------------
 
 def dedisperse_cost(n_dm: int, nchans: int, out_nsamps: int,
-                    in_itemsize: int = 4) -> StageCost:
+                    in_itemsize: int = 4,
+                    out_itemsize: int = _F32) -> StageCost:
     """Direct delay sweep: one add per (DM row, channel, output sample).
     Each row re-reads the band at shifted offsets; the input traffic is
-    counted at the stored sample width (u8 for packed filterbanks)."""
+    counted at the stored sample width (u8 for packed filterbanks) and
+    the output at the trial-lattice width (ISSUE 13: u8/bf16 lattices
+    shrink the written trial rows the search stage streams back in)."""
     elems = float(n_dm) * nchans * out_nsamps
     return StageCost(
         flops=elems,
         bytes_read=elems * in_itemsize,
-        bytes_written=float(n_dm) * out_nsamps * _F32,
+        bytes_written=float(n_dm) * out_nsamps * out_itemsize,
     )
 
 
@@ -183,14 +186,15 @@ def whiten_cost(n: int) -> StageCost:
     )
 
 
-def accel_spectrum_cost(n: int) -> StageCost:
+def accel_spectrum_cost(n: int, trial_itemsize: int = _F32) -> StageCost:
     """One acceleration trial's spectrum formation: shift-select
     resample (1 flop/sample), rfft, interbin (~9 flops/bin), normalise
-    (2 flops/bin)."""
+    (2 flops/bin).  The trial time series is read once at the lattice
+    width (f32/bf16/u8) plus one f32 pass for the resample gather."""
     nb = n // 2 + 1
     return StageCost(
         flops=n + fft_real_flops(n) + 11.0 * nb,
-        bytes_read=2 * n * _F32 + nb * 8,
+        bytes_read=n * trial_itemsize + n * _F32 + nb * 8,
         bytes_written=n * _F32 + nb * (8 + _F32),
     )
 
@@ -304,26 +308,42 @@ class PipelineGeometry:
     #: every stage's flops/bytes scale linearly in B and roofline
     #: utilization stays meaningful for the batched program
     batch: int = 1
+    #: jerk trials per (DM, accel) slot (ISSUE 13): 1 for accel-only
+    #: searches; already folded into ``n_trials_total``, kept here so
+    #: reports can show the axis explicitly
+    njerk: int = 1
+    #: resolved trial-lattice element size in bytes (f32=4, bf16=2,
+    #: u8=1) — the width the dedisperse stage writes trial rows at and
+    #: the spectrum stage streams them back in at
+    trial_itemsize: int = _F32
 
     @classmethod
     def from_search(cls, search, acc_lists=None,
                     batch: int = 1) -> "PipelineGeometry":
         """Build from a ``PulsarSearch``-like driver.  ``acc_lists``
-        (per-DM accel arrays) skips regenerating the trial grid when
-        the caller already holds it."""
+        (per-DM accel arrays — COMBINED accel x jerk lists when the
+        mesh driver holds a jerk grid) skips regenerating the trial
+        grid when the caller already holds it."""
         from ..search.plan import (
             FOLD_NBINS,
             FOLD_NINTS,
             prev_power_of_two,
             trial_grid_geometry,
         )
+        from ..search.tuning import LATTICE_ITEMSIZE
 
         cfg = search.config
+        jerk_plan = getattr(search, "jerk_plan", None)
+        njerk = int(jerk_plan.njerk) if jerk_plan is not None else 1
         if acc_lists is not None:
+            # mesh drivers pass combined (accel, jerk) lists — the sum
+            # already counts the full trial product
             n_trials = int(sum(len(a) for a in acc_lists))
         else:
             n_trials = trial_grid_geometry(
-                search.dm_list, search.acc_plan).n_trials_total
+                search.dm_list, search.acc_plan,
+                jerk_plan=jerk_plan).n_trials_total
+        lattice = str(getattr(search, "lattice", "f32"))
         peaks_method = "sort"
         try:
             # the deepest level searches the largest prefix and
@@ -335,6 +355,8 @@ class PipelineGeometry:
         return cls(
             peaks_method=str(peaks_method),
             batch=int(batch),
+            njerk=njerk,
+            trial_itemsize=int(LATTICE_ITEMSIZE.get(lattice, _F32)),
             n_dm=int(len(search.dm_list)),
             nchans=int(search.fil.nchans),
             out_nsamps=int(search.out_nsamps),
@@ -353,7 +375,8 @@ class PipelineGeometry:
         out = {k: int(getattr(self, k)) for k in (
             "n_dm", "nchans", "out_nsamps", "in_itemsize", "size",
             "nharmonics", "peak_capacity", "n_trials_total", "npdmp",
-            "fold_nsamps", "fold_nbins", "fold_nints", "batch")}
+            "fold_nsamps", "fold_nbins", "fold_nints", "batch",
+            "njerk", "trial_itemsize")}
         out["peaks_method"] = str(self.peaks_method)
         return out
 
@@ -369,14 +392,16 @@ def pipeline_costs(geom: PipelineGeometry) -> dict[str, StageCost]:
     nb = geom.size // 2 + 1
     nlevels = geom.nharmonics + 1
     spectrum = (whiten_cost(geom.size).scaled(geom.n_dm)
-                + accel_spectrum_cost(geom.size).scaled(
+                + accel_spectrum_cost(
+                    geom.size, geom.trial_itemsize).scaled(
                     geom.n_trials_total))
     peaks = peaks_cost(nb, geom.peak_capacity,
                        geom.peaks_method).scaled(
         nlevels * geom.n_trials_total)
     stages = {
         "dedisperse": dedisperse_cost(
-            geom.n_dm, geom.nchans, geom.out_nsamps, geom.in_itemsize),
+            geom.n_dm, geom.nchans, geom.out_nsamps, geom.in_itemsize,
+            out_itemsize=geom.trial_itemsize),
         "spectrum": spectrum,
         "harmonics": harmonics_cost(nb, geom.nharmonics).scaled(
             geom.n_trials_total),
@@ -413,7 +438,7 @@ def record_run_costs(search, acc_lists=None, batch: int = 1) -> dict:
     # peak extraction) and per-DM-row work (whiten + dedisp row), in
     # Gflops — the scalars Accel-Search / Chunked-Search spans attach
     nb = geom.size // 2 + 1
-    per_trial = (accel_spectrum_cost(geom.size)
+    per_trial = (accel_spectrum_cost(geom.size, geom.trial_itemsize)
                  + harmonics_cost(nb, geom.nharmonics)
                  + peaks_cost(nb, geom.peak_capacity,
                               geom.peaks_method).scaled(

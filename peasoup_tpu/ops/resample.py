@@ -48,21 +48,47 @@ def resample(tim: jnp.ndarray, accel, tsamp) -> jnp.ndarray:
     return tim[jnp.clip(idx, 0, n - 1)]
 
 
-def resample2_max_shift(max_accel, tsamp, n: int) -> int:
-    """Static bound on |read_index - i| for kernel-II resampling:
-    |af| * max_i i*(n-i) = |af| * n^2/4, plus one for rounding."""
-    import numpy as np
+def _jerk_fact(jerk, tsamp) -> jnp.ndarray:
+    return (
+        jnp.asarray(jerk, jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64 (module docstring)
+        * jnp.asarray(tsamp, jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
+        * jnp.asarray(tsamp, jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
+        / (6.0 * SPEED_OF_LIGHT)
+    )
 
+
+#: max_i |i*(i-n)*(i+n)| over [0, n] is 2 n^3 / (3 sqrt(3)), attained
+#: at i = n/sqrt(3) (the cubic jerk ramp's peak displacement)
+_JERK_PEAK_COEFF = 2.0 / (3.0 * np.sqrt(3.0))
+
+
+def resample2_max_shift(max_accel, tsamp, n: int, max_jerk=0.0) -> int:
+    """Static bound on |read_index - i| for kernel-II resampling:
+    |af| * max_i i*(n-i) = |af| * n^2/4 for the quadratic accel term,
+    plus |jf| * 2 n^3 / (3 sqrt(3)) for the cubic jerk term (peak of
+    |i*(i-n)*(i+n)| at i = n/sqrt(3)), plus one for rounding."""
     af = abs(float(max_accel)) * float(tsamp) / (2.0 * SPEED_OF_LIGHT)
-    return int(np.ceil(af * float(n) * float(n) / 4.0)) + 1
+    jf = (abs(float(max_jerk)) * float(tsamp) * float(tsamp)
+          / (6.0 * SPEED_OF_LIGHT))
+    fn = float(n)
+    return int(np.ceil(af * fn * fn / 4.0
+                       + jf * _JERK_PEAK_COEFF * fn * fn * fn)) + 1
 
 
 # above this many shifted copies the select chain loses to the gather
 _SELECT_MAX_SHIFT = 64
 
 
-def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None
-              ) -> jnp.ndarray:
+def _static_zero(val) -> bool:
+    """True iff ``val`` is a concrete (non-tracer) exact zero."""
+    try:
+        return float(val) == 0.0
+    except Exception:
+        return False
+
+
+def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None,
+              jerk=0.0) -> jnp.ndarray:
     """Kernel-II resampling (zero shift at both ends); the search path.
 
     When ``max_shift`` (a static bound from ``resample2_max_shift``) is
@@ -71,13 +97,24 @@ def resample2(tim: jnp.ndarray, accel, tsamp, max_shift: int | None = None
     statically-shifted copies: the read index differs from ``i`` by at
     most a few samples for realistic accelerations, and elementwise
     selects fuse where a 23M-element gather cannot.
+
+    ``jerk`` adds the acceleration-derivative axis as a cubic term of
+    the same zero-at-both-ends family: ``i*jf*(i-n)*(i+n)`` with
+    ``jf = jerk * tsamp^2 / (6c)`` — zero at i=0 and i=n like the
+    quadratic accel term, so the trial's period normalisation is
+    unchanged.  A static zero jerk skips the term entirely, keeping
+    the accel-only expression bit-identical to the pre-jerk build.
     """
     n = tim.shape[0]
     af = _accel_fact(accel, tsamp)
     i = jnp.arange(n, dtype=jnp.float64)  # psl: disable=PSL003 -- index ramp needs true f64
     # round the SUM like the reference (half-to-even ties depend on the
     # integer part, so rint(i + x) != i + rint(x) exactly at ties)
-    idx = jnp.rint(i + i * af * (i - jnp.float64(n)))  # psl: disable=PSL003 -- index ramp needs true f64
+    ramp = i + i * af * (i - jnp.float64(n))  # psl: disable=PSL003 -- index ramp needs true f64
+    if not _static_zero(jerk):
+        jf = _jerk_fact(jerk, tsamp)
+        ramp = ramp + i * jf * (i - jnp.float64(n)) * (i + jnp.float64(n))  # psl: disable=PSL003 -- index ramp needs true f64
+    idx = jnp.rint(ramp)
     if max_shift is None or max_shift > _SELECT_MAX_SHIFT:
         return tim[jnp.clip(idx.astype(jnp.int32), 0, n - 1)]
     d = (idx - i).astype(jnp.int32)
@@ -96,6 +133,24 @@ def residual_width(max_shift: int, block: int, n: int) -> int:
     independent roundings at the block base and the element.  Single
     source of truth for the table builders and the block chooser."""
     return int(np.ceil(4.0 * max_shift * block / n)) + 2
+
+
+def residual_width_jerk(max_accel, max_jerk, tsamp, block: int,
+                        n: int) -> int:
+    """Jerk-aware static per-block residual width.
+
+    The accel-only :func:`residual_width` bounds the in-block step
+    count via max|d'| = |af|*n = 4*max_shift/n, which UNDERESTIMATES
+    once a cubic jerk term joins the ramp (its derivative peaks at
+    2*|jf|*n^2, larger than the jerk term's share of max_shift implies)
+    — so jerk table builders must use this bound instead:
+    max|d'| = |af|*n + 2*|jf|*n^2, times the block length, + 2 for the
+    two independent roundings."""
+    af = abs(float(max_accel)) * float(tsamp) / (2.0 * SPEED_OF_LIGHT)
+    jf = (abs(float(max_jerk)) * float(tsamp) * float(tsamp)
+          / (6.0 * SPEED_OF_LIGHT))
+    fn = float(n)
+    return int(np.ceil((af * fn + 2.0 * jf * fn * fn) * block)) + 2
 
 
 def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
@@ -199,18 +254,104 @@ def _staircase_tables_np(afs: np.ndarray, n: int, max_shift: int,
     return d0, pos_t, step_t
 
 
+def _staircase_tables_direct_np(afs: np.ndarray, jfs: np.ndarray, n: int,
+                                max_shift: int, block: int, m: int):
+    """Host-side (exact IEEE f64) per-block index tables by DIRECT
+    evaluation of the full kernel-II ramp — the jerk-capable builder.
+
+    The bisection of :func:`_staircase_tables_np` assumes the rounded
+    staircase is monotone with unit steps on each side of n/2, which
+    the quadratic accel ramp guarantees but the cubic jerk term breaks
+    (up to three monotone pieces, and steps can exceed one sample per
+    position once |d'| > 1 locally).  This builder instead evaluates
+    the exact rounded offset d(i) for every i, one trial at a time
+    (bounded host memory), and encodes each non-zero first difference
+    as |step| unit entries at its position — the device-side table
+    format (:func:`resample2_from_tables`) already supports multiple
+    unit steps at one position slot.
+
+    ``m`` is the caller's static residual width (from
+    :func:`residual_width_jerk` at the GLOBAL accel/jerk bounds, so
+    every chunk's tables share one shape).  Returns the same
+    (d0[A, nb], pos[A, nb, m], step[A, nb, m]) int32 layout as the
+    bisection builder.
+    """
+    afs = np.atleast_1d(np.asarray(afs, np.float64))
+    jfs = np.atleast_1d(np.asarray(jfs, np.float64))
+    A = afs.shape[0]
+    nb = n // block
+    i = np.arange(n, dtype=np.float64)
+    d0 = np.zeros((A, nb), np.int32)
+    pos_t = np.full((A, nb, m), n, np.int32)
+    step_t = np.zeros((A, nb, m), np.int32)
+    for a in range(A):
+        ramp = i + i * afs[a] * (i - np.float64(n))
+        if jfs[a] != 0.0:
+            ramp = ramp + (i * jfs[a] * (i - np.float64(n))
+                           * (i + np.float64(n)))
+        d = (np.rint(ramp) - i).astype(np.int64)
+        peak = int(np.abs(d).max(initial=0))
+        if peak > max_shift:
+            raise DomainError(
+                f"true peak shift {peak} exceeds max_shift={max_shift}; "
+                f"pass a bound from resample2_max_shift() for the "
+                f"largest |accel|/|jerk| in the batch"
+            )
+        d0[a] = d[::block].astype(np.int32)
+        diff = np.diff(d)
+        chg = np.nonzero(diff)[0] + 1     # step takes effect AT i=chg
+        active = chg % block != 0         # block-base changes live in d0
+        chg, steps = chg[active], diff[chg[active] - 1]
+        # expand multi-sample steps into |step| unit entries (the
+        # device select counts unit slots)
+        reps = np.abs(steps).astype(np.int64)
+        bounds = np.repeat(chg, reps)
+        units = np.repeat(np.sign(steps).astype(np.int32), reps)
+        blk = bounds // block
+        rank = np.arange(len(bounds)) - np.searchsorted(
+            blk, blk, side="left")
+        if len(rank) and rank.max() >= m:
+            raise AssertionError(
+                "staircase step density exceeded static bound")
+        pos_t[a, blk, rank] = bounds
+        step_t[a, blk, rank] = units
+    return d0, pos_t, step_t
+
+
 def _afs(accels, tsamp) -> np.ndarray:
     return (np.atleast_1d(np.asarray(accels, np.float64))
             * np.float64(tsamp) / (2.0 * SPEED_OF_LIGHT))
 
 
+def _jfs(jerks, tsamp) -> np.ndarray:
+    return (np.atleast_1d(np.asarray(jerks, np.float64))
+            * np.float64(tsamp) * np.float64(tsamp)
+            / (6.0 * SPEED_OF_LIGHT))
+
+
 def resample2_tables(accels, tsamp, n: int, max_shift: int,
-                     block: int = 4096):
+                     block: int = 4096, jerks=None, width: int | None = None):
     """Exact host-side kernel-II index tables for a batch of accel
     trials: (d0[A, nb], pos[A, nb, m], step[A, nb, m]), ready to vmap
-    :func:`resample2_from_tables` over."""
-    return _staircase_tables_np(_afs(accels, tsamp), n, max_shift, block,
-                                kernel=2)
+    :func:`resample2_from_tables` over.
+
+    ``jerks`` (per-trial jerk values, same length as ``accels``)
+    switches to the jerk-capable direct builder; ``width`` fixes its
+    static residual width (pass :func:`residual_width_jerk` at the
+    run's global bounds so chunked callers get shape-stable tables).
+    ``jerks=None`` keeps the accel-only bisection builder, bit-exact
+    with the pre-jerk build."""
+    if jerks is None:
+        return _staircase_tables_np(_afs(accels, tsamp), n, max_shift,
+                                    block, kernel=2)
+    afs = _afs(accels, tsamp)
+    jfs = _jfs(jerks, tsamp)
+    if width is None:
+        amax = float(np.abs(np.atleast_1d(accels)).max(initial=0.0))
+        jmax = float(np.abs(np.atleast_1d(jerks)).max(initial=0.0))
+        width = residual_width_jerk(amax, jmax, tsamp, block, n)
+    return _staircase_tables_direct_np(afs, jfs, n, max_shift, block,
+                                       int(width))
 
 
 def resample1_tables(accels, tsamp, n: int, max_shift: int,
@@ -221,7 +362,8 @@ def resample1_tables(accels, tsamp, n: int, max_shift: int,
 
 
 def resample2_unique_tables(accs_grid, tsamp, n: int, max_shift: int,
-                            block: int = 4096):
+                            block: int = 4096, jerks_grid=None,
+                            width: int | None = None):
     """Tables for a NaN-padded (ndm, namax) accel grid, deduplicated.
 
     Accel values repeat heavily across DM trials (0 is in every list,
@@ -229,12 +371,27 @@ def resample2_unique_tables(accs_grid, tsamp, n: int, max_shift: int,
     grid maps to rows via ``uidx``.  NaN padding slots map to the 0.0
     row (their outputs are masked anyway).
 
+    ``jerks_grid`` (same shape, the combined trial axis's per-slot
+    jerk) switches the dedup to unique (accel, jerk) PAIRS and the
+    build to the jerk-capable direct builder — the jerk value is baked
+    into each unique table row, so the device program body needs no
+    jerk input at all on the table path.
+
     Returns (d0_u[U, nb], pos_u[U, nb, m], step_u[U, nb, m],
     uidx[ndm, namax] int32).
     """
     grid = np.nan_to_num(np.asarray(accs_grid, np.float64))
-    uniq, inv = np.unique(grid, return_inverse=True)
-    d0, pos, step = resample2_tables(uniq, tsamp, n, max_shift, block=block)
+    if jerks_grid is None:
+        uniq, inv = np.unique(grid, return_inverse=True)
+        d0, pos, step = resample2_tables(uniq, tsamp, n, max_shift,
+                                         block=block)
+        return d0, pos, step, inv.reshape(grid.shape).astype(np.int32)
+    jgrid = np.nan_to_num(np.asarray(jerks_grid, np.float64))
+    pairs = np.stack([grid.ravel(), jgrid.ravel()], axis=1)
+    uniq, inv = np.unique(pairs, axis=0, return_inverse=True)
+    d0, pos, step = resample2_tables(
+        uniq[:, 0], tsamp, n, max_shift, block=block, jerks=uniq[:, 1],
+        width=width)
     return d0, pos, step, inv.reshape(grid.shape).astype(np.int32)
 
 

@@ -42,6 +42,7 @@ from ..obs.trace import span, span_cursor
 from ..ops.dedisperse import (
     dedisperse,
     dedisperse_flat,
+    quantise_trials_bf16,
     quantise_trials_u8,
     split_flat_channels,
 )
@@ -292,7 +293,8 @@ def build_fused_search(
     max_shift: int | None = None,
     block: int | None = None,
     dedisp_pallas: tuple | None = None,
-    quantise: bool = False,
+    lattice: str = "f32",
+    use_jerks: bool = False,
     peaks_methods: tuple | None = None,
     compact_method: str = "xla",
     batch: int = 1,
@@ -340,6 +342,19 @@ def build_fused_search(
     the vmapped dynamic_slice lowers to a batched gather).  Requires
     per-shard DM rows divisible by dm_tile and nbits <= 8.
 
+    ``lattice``: the RESOLVED trial dtype (``PulsarSearch.lattice``,
+    see search/tuning.py): ``"u8"`` applies the dedisp out_nbits=8
+    staircase, ``"bf16"`` the half-bandwidth round-trip cast, ``"f32"``
+    nothing.
+
+    ``use_jerks``: jerk-axis search on the LEGACY (``block=None``)
+    resampler — an extra trailing ``jerks`` input (same (ndm, namax)
+    shape/sharding as ``accs``, the combined trial axis's per-slot
+    jerk) is vmapped into :func:`search_one_accel_legacy`.  The table
+    path never needs it: unique (accel, jerk) pair tables bake the
+    cubic term host-side (``resample2_unique_tables``), so the program
+    body is byte-identical with or without a jerk axis there.
+
     ``batch``: leading observation axis B (ISSUE 9).  ``batch == 1``
     is byte-for-byte the historical single-observation program.  For
     ``batch > 1`` the ``raw`` input becomes ``(B, rawlen)`` packed
@@ -358,9 +373,10 @@ def build_fused_search(
 
     nlevels = nharms + 1
     use_tables = block is not None
+    take_jerks = use_jerks and not use_tables
 
     def one_obs(raw, delays, killmask, accs, uidx, d0_u, pos_u, step_u,
-                birdies, widths):
+                birdies, widths, jerks=None):
         vals = unpack_bits_device(raw, nbits)[: nsamps * nchans]
         # full-width trials are returned for the folding phase (which
         # must see prev_power_of_two(out_nsamps) real samples exactly
@@ -389,8 +405,10 @@ def build_fused_search(
             if use_killmask:
                 data = data * killmask[:, None]
             trials = dedisperse(data, delays, out_nsamps)
-        if quantise:  # trial_nbits=8: dedisp's uint8 lattice
+        if lattice == "u8":  # dedisp's out_nbits=8 staircase
             trials = quantise_trials_u8(trials, nbits, nchans)
+        elif lattice == "bf16":
+            trials = quantise_trials_bf16(trials)
         if out_nsamps >= size:
             trials_sz = trials[:, :size]
         else:
@@ -421,6 +439,14 @@ def build_fused_search(
             )
             idxs, snrs, counts = jax.vmap(search)(
                 tw_f, mean_f, std_f, uidx.reshape(-1))
+        elif take_jerks:
+            search = lambda t, m, s, a, j: search_one_accel_legacy(
+                t, jnp.nan_to_num(a), m, s, tsamp, nharms, bounds,
+                capacity, min_snr, max_shift, peaks_methods,
+                jnp.nan_to_num(j),
+            )
+            idxs, snrs, counts = jax.vmap(search)(
+                tw_f, mean_f, std_f, accs_f, jerks.reshape(-1))
         else:
             search = lambda t, m, s, a: search_one_accel_legacy(
                 t, jnp.nan_to_num(a), m, s, tsamp, nharms, bounds,
@@ -443,9 +469,9 @@ def build_fused_search(
         out_specs = (P("dm"), P("dm", None))
     else:
         def shard_fn(raw, delays, killmask, accs, uidx, d0_u, pos_u,
-                     step_u, birdies, widths):
+                     step_u, birdies, widths, jerks=None):
             outs = [one_obs(raw[b], delays, killmask, accs, uidx, d0_u,
-                            pos_u, step_u, birdies, widths)
+                            pos_u, step_u, birdies, widths, jerks)
                     for b in range(batch)]
             packed = jnp.stack([o[0] for o in outs])
             trials = jnp.stack([o[1] for o in outs])
@@ -462,7 +488,7 @@ def build_fused_search(
         in_specs=(
             P(), P("dm", None), P(), P("dm", None), P("dm", None),
             P(), P(), P(), P(), P(),
-        ),
+        ) + ((P("dm", None),) if take_jerks else ()),
         out_specs=out_specs,
         # pallas_call out_shapes carry no varying-mesh-axes annotation
         # (same waiver as build_chunked_search)
@@ -503,6 +529,8 @@ def build_chunked_search(
     n_parts: int = 1,
     subband: tuple | None = None,
     quantise_nbits: int = 0,
+    lattice: str = "f32",
+    use_jerks: bool = False,
     peaks_methods: tuple | None = None,
     compact_method: str = "xla",
 ):
@@ -539,6 +567,13 @@ def build_chunked_search(
     The table args are always required; with ``block=None`` they are
     unused dummies (see ``MeshPulsarSearch._resample_tables``).
 
+    ``lattice`` selects the resolved trial dtype exactly like
+    :func:`build_fused_search` (``quantise_nbits`` is the INPUT nbits
+    the u8 staircase scales by, only read when ``lattice="u8"``), and
+    ``use_jerks`` + ``block=None`` adds a per-slot ``jerks`` input
+    between ``accs`` and ``uidx`` for the legacy resampler — the table
+    path bakes jerk into the unique (accel, jerk) pair tables instead.
+
     ``subband``: optional static 9-tuple (bounds, L1, n_anchor_p,
     slack, csub, t_sub, k_sub, dm_tile, kernel2) —
     two-stage sub-band dedispersion (``_plan_subband_chunks``): three
@@ -568,6 +603,7 @@ def build_chunked_search(
     assert subband is None or n_chunks == 1, \
         "sub-band mode needs one chunk per dispatch (the driver's shape)"
     use_tables = block is not None
+    take_jerks = use_jerks and not use_tables
 
     def shard_fn(*args):
         # data arrives AND STAYS flat, split into int32-indexable
@@ -582,8 +618,13 @@ def build_chunked_search(
             rest = args[n_parts + 3:]
         else:
             rest = args[n_parts:]
-        (delays, accs, uidx, d0_u, pos_u, step_u, birdies,
-         widths) = rest
+        if take_jerks:
+            (delays, accs, jerks, uidx, d0_u, pos_u, step_u, birdies,
+             widths) = rest
+        else:
+            jerks = None
+            (delays, accs, uidx, d0_u, pos_u, step_u, birdies,
+             widths) = rest
         nsamps_dev = sum(p.shape[0] for p in parts) // nchans
 
         if subband is not None:
@@ -640,6 +681,10 @@ def build_chunked_search(
             uidx_c = lax.dynamic_slice(
                 uidx, (ci * dm_chunk, z), (dm_chunk, namax)
             )
+            if take_jerks:
+                jerks_c = lax.dynamic_slice(
+                    jerks, (ci * dm_chunk, z), (dm_chunk, namax)
+                )
             if subband is not None:
                 trials = subband_trials()
             elif dedisp_method == "pallas":
@@ -652,9 +697,11 @@ def build_chunked_search(
             else:
                 trials = dedisperse_flat(
                     parts, delays_c, nsamps_dev, out_nsamps)
-            if quantise_nbits:  # trial_nbits=8: dedisp's u8 lattice
+            if lattice == "u8":  # dedisp's out_nbits=8 staircase
                 trials = quantise_trials_u8(
                     trials, quantise_nbits, nchans)
+            elif lattice == "bf16":
+                trials = quantise_trials_bf16(trials)
             if out_nsamps >= size:
                 trials_sz = trials[:, :size]
             else:
@@ -671,7 +718,8 @@ def build_chunked_search(
             # one at a time); accel_block bounds the live spectra per
             # step for the HBM budget
             def row_body(_, row_in):
-                tim, arow, urow = row_in
+                tim, arow, urow = row_in[:3]
+                jrow = row_in[3] if take_jerks else None
                 tw, m, s = whiten_core(
                     tim, birdies, widths, bin_width, b5, b25, use_zap
                 )
@@ -688,6 +736,15 @@ def build_chunked_search(
                             max_shift, block, peaks_methods,
                         )
                         i2, s2, c2 = jax.vmap(search)(u_blk)
+                    elif take_jerks:
+                        j_blk = lax.dynamic_slice(
+                            jrow, (ai * accel_block,), (accel_block,))
+                        search = lambda a, j: search_one_accel_legacy(
+                            tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
+                            bounds, capacity, min_snr, max_shift,
+                            peaks_methods, jnp.nan_to_num(j),
+                        )
+                        i2, s2, c2 = jax.vmap(search)(a_blk, j_blk)
                     else:
                         search = lambda a: search_one_accel_legacy(
                             tw, jnp.nan_to_num(a), m, s, tsamp, nharms,
@@ -711,7 +768,9 @@ def build_chunked_search(
                 )
 
             _, (bi, bs, bc) = lax.scan(
-                row_body, 0, (trials_sz, accs_c, uidx_c)
+                row_body, 0,
+                (trials_sz, accs_c, uidx_c)
+                + ((jerks_c,) if take_jerks else ()),
             )
             return 0, (bi, bs, bc)
 
@@ -731,26 +790,28 @@ def build_chunked_search(
         sb_specs = (P("dm", None), P("dm", None), P("dm"))
     else:
         sb_specs = (P("dm", None), P("dm"), P("dm", None))
+    n_rowspecs = 4 if take_jerks else 3
     mapped = _shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(P(),) * n_parts + sb_specs + (
-            P("dm", None), P("dm", None), P("dm", None),
-            P(), P(), P(), P(), P()),
+        in_specs=(P(),) * n_parts + sb_specs
+        + (P("dm", None),) * n_rowspecs + (P(), P(), P(), P(), P()),
         out_specs=P("dm"),
         # pallas_call out_shapes carry no varying-mesh-axes annotation;
         # every output here is trivially dm-varying, so skip the check
         check_vma=False,
     )
-    # the per-chunk uploads (sub-band tables + delays/accs/uidx) are
-    # consumed by exactly one dispatch each — donate their buffers so
-    # depth>=2 pipelining doesn't hold two chunks' worth of input HBM.
-    # The resident operands (data parts, resample tables, birdies) are
-    # reused by every chunk and must NOT be donated.  CPU jax can't
-    # donate (every dispatch would warn) so the hint is dropped there.
+    # the per-chunk uploads (sub-band tables + delays/accs/[jerks/]
+    # uidx) are consumed by exactly one dispatch each — donate their
+    # buffers so depth>=2 pipelining doesn't hold two chunks' worth of
+    # input HBM.  The resident operands (data parts, resample tables,
+    # birdies) are reused by every chunk and must NOT be donated.  CPU
+    # jax can't donate (every dispatch would warn) so the hint is
+    # dropped there.
     donate = ()
     if jax.default_backend() != "cpu":
-        donate = tuple(range(n_parts, n_parts + len(sb_specs) + 3))
+        donate = tuple(
+            range(n_parts, n_parts + len(sb_specs) + n_rowspecs))
     return jax.jit(mapped, donate_argnums=donate)
 
 
@@ -766,6 +827,30 @@ class MeshPulsarSearch(PulsarSearch):
     def _padded_trial_count(self) -> int:
         ndm = len(self.dm_list)
         return int(np.ceil(ndm / self.ndev)) * self.ndev
+
+    def _trial_lists(self, acc_lists):
+        """Combined (accel, jerk) per-DM trial lists (ISSUE 13).
+
+        Returns ``(trial_accs, trial_jerks)`` where each DM row's lists
+        flatten the accel x jerk product with accel varying fastest
+        (``search/plan.py:combine_trials``).  Jerk-free plans return
+        the accel lists UNCHANGED with ``trial_jerks=None``, so every
+        downstream grid, table and compiled program is bit-identical
+        to the accel-only search."""
+        if self.jerk_plan.max_abs == 0.0:
+            return acc_lists, None
+        from ..search.plan import combine_trials
+
+        jl = self.jerk_plan.jerk_list()
+        pairs = [combine_trials(a, jl) for a in acc_lists]
+        return [p[0] for p in pairs], [p[1] for p in pairs]
+
+    def _legacy_jerks(self) -> bool:
+        """True when the legacy (table-free) resampler must receive an
+        explicit per-slot jerks input — the table path bakes jerk into
+        the unique (accel, jerk) pair tables instead."""
+        return (self.jerk_plan.max_abs > 0.0
+                and self.resample_block is None)
 
     def compact_method_for(self, compact_k: int) -> str:
         """Lowering of the whole-buffer stream compaction
@@ -948,20 +1033,30 @@ class MeshPulsarSearch(PulsarSearch):
         fn, raw_d, delays_d, km_d = cached
         return fn(raw_d, delays_d, km_d)
 
-    def _device_inputs(self, acc_lists, ndm_p: int, namax: int):
+    def _device_inputs(self, acc_lists, ndm_p: int, namax: int,
+                       jerk_lists=None):
         """Build (once) and cache the device-resident static inputs.
 
         The filterbank bytes, delay table, killmask and accel grid are
         constant for a given search object, so they live in HBM across
         ``run()`` calls — re-uploading them per run costs more than the
         entire device search on a remote-attached TPU.
+
+        ``acc_lists``/``jerk_lists`` are the COMBINED trial lists
+        (``_trial_lists``): jerk is folded into the unique-pair
+        resample tables, and a trailing jerks grid joins the residents
+        only on the legacy table-free path (``_legacy_jerks``).
         """
         if getattr(self, "_dev_inputs", None) is not None:
             return self._dev_inputs
         ndm = len(self.dm_list)
         accs = np.full((ndm_p, namax), np.nan, np.float32)
+        jerks = (np.full((ndm_p, namax), np.nan, np.float32)
+                 if jerk_lists is not None else None)
         for i, a in enumerate(acc_lists):
             accs[i, : len(a)] = a
+            if jerks is not None:
+                jerks[i, : len(a)] = jerk_lists[i]
         # edge-pad the DM rows (their accel slots are NaN, so they
         # emit nothing): zero-delay pad rows would sit next to
         # max-delay rows in the Pallas kernel's last dm_tile block and
@@ -985,7 +1080,7 @@ class MeshPulsarSearch(PulsarSearch):
             else:
                 raw = pack_bits(self.fil.data.ravel(), nbits)
             raw_d = put_global(raw, rep)
-        uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
+        uidx, d0_u, pos_u, step_u = self._resample_tables(accs, jerks)
         self._dev_inputs = (
             raw_d,
             put_global(delays, shard),
@@ -997,12 +1092,15 @@ class MeshPulsarSearch(PulsarSearch):
             put_global(step_u, rep),
             put_global(self.birdies, rep),
             put_global(self.bwidths, rep),
-        )
+        ) + ((put_global(jerks, shard),)
+             if jerks is not None and self._legacy_jerks() else ())
         return self._dev_inputs
 
-    def _resample_tables(self, accs: np.ndarray):
+    def _resample_tables(self, accs: np.ndarray, jerks=None):
         """Host-exact unique-accel resample tables for a NaN-padded
-        accel grid (dummies when the legacy path is active)."""
+        accel grid (dummies when the legacy path is active).  A jerks
+        grid (same shape) switches the dedup to unique (accel, jerk)
+        PAIRS with the jerk term baked into each table row."""
         if self.resample_block is None:
             return (
                 np.zeros(accs.shape, np.int32),
@@ -1015,6 +1113,8 @@ class MeshPulsarSearch(PulsarSearch):
         d0_u, pos_u, step_u, uidx = resample2_unique_tables(
             accs, float(self.fil.tsamp), self.size, self.max_shift,
             block=self.resample_block,
+            jerks_grid=jerks,
+            width=(self.table_width if jerks is not None else None),
         )
         return uidx, d0_u, pos_u, step_u
 
@@ -1345,23 +1445,27 @@ class MeshPulsarSearch(PulsarSearch):
             dm_tile_sub=dm_tile_sub, kernel2=kernel2,
         )
 
-    def _device_inputs_chunked(self, plan, acc_lists):
+    def _device_inputs_chunked(self, plan, acc_lists, jerk_lists=None):
         """Upload-once device state for the per-chunk dispatches.
 
         Big replicated arrays (flat data, unique resample tables,
         zap lists) live in HBM across all dispatches in
         ``self._dev_chunk_static``; the per-row arrays (delays, accel
-        grid, table indices) stay HOST-side in
+        grid, per-slot jerks, table indices) stay HOST-side in
         ``self._host_chunk_arrays`` — each dispatch uploads only its
-        chunk's (tiny) row slices."""
+        chunk's (tiny) row slices.  ``acc_lists``/``jerk_lists`` are
+        the COMBINED trial lists (``_trial_lists``)."""
         if getattr(self, "_dev_chunk_static", None) is not None:
             return
         ndm = len(self.dm_list)
         ndm_pp = plan["ndm_local_p"] * self.ndev
         namax_p = plan["namax_p"]
         accs = np.full((ndm_pp, namax_p), np.nan, np.float32)
+        jerks = np.full((ndm_pp, namax_p), np.nan, np.float32)
         for i, a in enumerate(acc_lists):
             accs[i, : len(a)] = a
+            if jerk_lists is not None:
+                jerks[i, : len(a)] = jerk_lists[i]
         # edge-pad to match the planner's slack bound (padded rows emit
         # nothing: their accel slots are all NaN)
         delays = np.empty((ndm_pp, self.fil.nchans), np.int32)
@@ -1394,8 +1498,9 @@ class MeshPulsarSearch(PulsarSearch):
         with ThreadPoolExecutor(min(16, os.cpu_count() or 8)) as ex:
             list(ex.map(_tblock, range(0, nchans, 64)))
         rep = NamedSharding(self.mesh, P())
-        uidx, d0_u, pos_u, step_u = self._resample_tables(accs)
-        self._host_chunk_arrays = (delays, accs, uidx)
+        uidx, d0_u, pos_u, step_u = self._resample_tables(
+            accs, jerks if jerk_lists is not None else None)
+        self._host_chunk_arrays = (delays, accs, jerks, uidx)
         parts = tuple(
             put_global(p, rep)
             for p in split_flat_channels(
@@ -1550,7 +1655,7 @@ class MeshPulsarSearch(PulsarSearch):
         nchans, nsamps_in = self.fil.nchans, self.fil.nsamps
         out_nsamps = self.out_nsamps
         use_km = self.killmask is not None
-        quantise = self.config.trial_nbits == 8
+        lattice = self.lattice
 
         @partial(jax.jit, static_argnames=(
             "bin_width", "fold_nsamps", "tsamp", "nbins", "nints",
@@ -1564,8 +1669,10 @@ class MeshPulsarSearch(PulsarSearch):
             if use_km:
                 data = data * km[:, None]
             trials = dedisperse(data, delays, out_nsamps)
-            if quantise:
+            if lattice == "u8":
                 trials = quantise_trials_u8(trials, nbits, nchans)
+            elif lattice == "bf16":
+                trials = quantise_trials_bf16(trials)
             return fold_epilogue_core(
                 trials, packed_in, periods, bin_width, fold_nsamps,
                 tsamp, nbins, nints, max_shift, block, nu, nb, w)
@@ -1583,7 +1690,7 @@ class MeshPulsarSearch(PulsarSearch):
         return fold_program, row_map
 
     def _run_chunked(self, plan, acc_lists, namax, timers, t_total, ckpt,
-                     ckpt_done):
+                     ckpt_done, jerk_lists=None):
         """Bounded-HBM production driver: ONE dispatch per DM chunk.
 
         A single whole-search dispatch at production scale (500 DM x
@@ -1680,11 +1787,12 @@ class MeshPulsarSearch(PulsarSearch):
         # data upload: stage-1 windows may need extra tail padding
         # (plan["pad_to"] is updated in place)
         sb = self._plan_subband_chunks(plan)
-        self._device_inputs_chunked(plan, acc_lists)
+        self._device_inputs_chunked(plan, acc_lists, jerk_lists)
         data_parts, d0_u, pos_u, step_u, birdies_d, widths_d = (
             self._dev_chunk_static
         )
-        delays_h, accs_h, uidx_h = self._host_chunk_arrays
+        delays_h, accs_h, jerks_h, uidx_h = self._host_chunk_arrays
+        use_jerks = self._legacy_jerks()
         rep = NamedSharding(self.mesh, P())
         shard = NamedSharding(self.mesh, P("dm", None))
         shard1 = NamedSharding(self.mesh, P("dm"))
@@ -1726,8 +1834,10 @@ class MeshPulsarSearch(PulsarSearch):
                 ),
                 quantise_nbits=(
                     self.fil.header.nbits
-                    if cfg.trial_nbits == 8 else 0
+                    if self.lattice == "u8" else 0
                 ),
+                lattice=self.lattice,
+                use_jerks=use_jerks,
                 peaks_methods=self.peaks_methods_for(cap_),
                 compact_method=self.compact_method_for(ck_),
             )
@@ -1815,6 +1925,8 @@ class MeshPulsarSearch(PulsarSearch):
                     *sb_args,
                     put_global(delays_h[rows], shard),
                     put_global(accs_h[rows], shard),
+                    *((put_global(jerks_h[rows], shard),)
+                      if use_jerks else ()),
                     put_global(uidx_h[rows], shard),
                     d0_u, pos_u, step_u, birdies_d, widths_d,
                 )
@@ -1904,7 +2016,9 @@ class MeshPulsarSearch(PulsarSearch):
             with span("Distill", metric="distillation", chunk=int(ci)):
                 batch = self._distill_rows_batch(
                     (int(rows[key]), groups_l.get(key),
-                     acc_lists[int(rows[key])])
+                     acc_lists[int(rows[key])],
+                     None if jerk_lists is None
+                     else jerk_lists[int(rows[key])])
                     for key in range(len(rows))
                     if int(rows[key]) < ndm and key not in clipped_l
                 )
@@ -2267,11 +2381,12 @@ class MeshPulsarSearch(PulsarSearch):
                 self.acc_plan.generate_accel_list(dm)
                 for dm in self.dm_list
             ]
+            acc_lists, jerk_lists = self._trial_lists(acc_lists)
             namax = max(len(a) for a in acc_lists)
             plan = self._plan_chunking(namax) if cfg.npdmp > 0 else None
             if plan is not None:
                 self._chunk_plan = plan
-                self._device_inputs_chunked(plan, acc_lists)
+                self._device_inputs_chunked(plan, acc_lists, jerk_lists)
                 result = self._finalise(
                     dm_cands, None, timers, t_total,
                     trials_provider=self._fold_trials_provider,
@@ -2296,6 +2411,12 @@ class MeshPulsarSearch(PulsarSearch):
         acc_lists = [
             self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
         ]
+        # jerk axis (ISSUE 13): from here on acc_lists are the COMBINED
+        # (accel, jerk) per-DM trial lists — identical objects when the
+        # plan is jerk-free — so the padded grid, HBM budget, cost
+        # model and dispatch attribution all scale with the full
+        # trial product without further special-casing
+        acc_lists, jerk_lists = self._trial_lists(acc_lists)
         namax = max(len(a) for a in acc_lists)
         n_trials_total = sum(len(a) for a in acc_lists)
         from ..obs.costmodel import record_run_costs
@@ -2311,7 +2432,8 @@ class MeshPulsarSearch(PulsarSearch):
                     f"dedisp={plan['dedisp_method']}"
                 )
             return self._run_chunked(
-                plan, acc_lists, namax, timers, t_total, ckpt, ckpt_done
+                plan, acc_lists, namax, timers, t_total, ckpt,
+                ckpt_done, jerk_lists,
             )
         if cfg.subband_dedisp != "never":
             warn_event(
@@ -2358,7 +2480,7 @@ class MeshPulsarSearch(PulsarSearch):
         )
 
         t0 = time.time()
-        inputs = self._device_inputs(acc_lists, ndm_p, namax)
+        inputs = self._device_inputs(acc_lists, ndm_p, namax, jerk_lists)
         cap0 = cap
         self.record_peaks_selection(cap)
 
@@ -2463,7 +2585,8 @@ class MeshPulsarSearch(PulsarSearch):
         ckpt_done = {}
         with span("Distill", metric="distillation", n_dm_trials=ndm):
             batch = self._distill_rows_batch(
-                (ii, per_dm_groups.get(ii), acc_lists[ii])
+                (ii, per_dm_groups.get(ii), acc_lists[ii],
+                 None if jerk_lists is None else jerk_lists[ii])
                 for ii in range(ndm) if ii not in rerun
             )
         for ii in range(ndm):
@@ -2516,7 +2639,8 @@ class MeshPulsarSearch(PulsarSearch):
             dedisp_pallas=(
                 dd_pallas["params"] if dd_pallas is not None else None
             ),
-            quantise=cfg.trial_nbits == 8,
+            lattice=self.lattice,
+            use_jerks=self._legacy_jerks(),
             peaks_methods=self.peaks_methods_for(capacity),
             compact_method=self.compact_method_for(ck),
             batch=batch,
@@ -2561,6 +2685,8 @@ class MeshPulsarSearch(PulsarSearch):
         acc_lists = [
             self.acc_plan.generate_accel_list(dm) for dm in self.dm_list
         ]
+        # combined (accel, jerk) trial lists, as in run()
+        acc_lists, jerk_lists = self._trial_lists(acc_lists)
         namax = max(len(a) for a in acc_lists)
         n_trials_total = sum(len(a) for a in acc_lists)
         plan = self._plan_chunking(namax)
@@ -2626,7 +2752,7 @@ class MeshPulsarSearch(PulsarSearch):
             getattr(self, "_ck_hint", cfg.compact_capacity),
         )
         t0 = time.time()
-        inputs = self._device_inputs(acc_lists, ndm_p, namax)
+        inputs = self._device_inputs(acc_lists, ndm_p, namax, jerk_lists)
         raw_B = np.stack([self._pack_raw(f) for f in fils])
         inputs = (put_global(raw_B, NamedSharding(self.mesh, P())),
                   ) + tuple(inputs[1:])
@@ -2725,7 +2851,8 @@ class MeshPulsarSearch(PulsarSearch):
         with span("Distill", metric="distillation",
                   n_dm_trials=ndm * max(len(decoded), 1), batch=B):
             distilled = self._distill_rows_batch(
-                (((b, ii), decoded[b][0].get(ii), acc_lists[ii])
+                (((b, ii), decoded[b][0].get(ii), acc_lists[ii],
+                  None if jerk_lists is None else jerk_lists[ii])
                  for b in decoded for ii in range(ndm)
                  if ii not in reruns[b]),
                 dm_of=lambda k: k[1],

@@ -36,7 +36,15 @@ from ..obs.metrics import REGISTRY as METRICS
 # v4: the key's input identity is the header/geometry fingerprint, not
 # the absolute path — moving or renaming the observation (or the whole
 # spool) no longer discards a resume; paths are advisory header fields
-_FORMAT_VERSION = 4
+# v5: candidates carry the jerk axis (ISSUE 13) and the config identity
+# gains jerk_start/jerk_end/jerk_step/trial_lattice; a v4 file remains
+# resumable when the search has no jerk axis and an f32 trial lattice
+# (see legacy_search_keys) — its rows deserialise with jerk=0.0
+_FORMAT_VERSION = 5
+
+#: config fields that did not exist in v4 checkpoints; stripped when
+#: computing the v4-compat key for migration
+_V5_NEW_FIELDS = ("jerk_start", "jerk_end", "jerk_step", "trial_lattice")
 
 
 # presentation/runtime knobs that do not change the search's results
@@ -96,6 +104,10 @@ def search_key(infile: str, fil, config) -> str:
     editing one between crash and resume invalidates the checkpoint
     but moving it does not.
     """
+    return _search_key_impl(fil, config, _FORMAT_VERSION)
+
+
+def _search_key_impl(fil, config, version: int, drop: tuple = ()) -> str:
     hdr = fil.header
     cfg_items = sorted(
         # a custom dm_list enters as an explicit tuple: repr() of a long
@@ -104,10 +116,10 @@ def search_key(infile: str, fil, config) -> str:
         (k, tuple(float(x) for x in np.asarray(v).ravel())
          if k == "dm_list" and v is not None else v)
         for k, v in asdict(config).items()
-        if k not in _NON_IDENTITY_FIELDS
+        if k not in _NON_IDENTITY_FIELDS and k not in drop
     )
     return repr((
-        _FORMAT_VERSION, observation_fingerprint(fil),
+        version, observation_fingerprint(fil),
         fil.nsamps, fil.nchans, hdr.nbits, float(hdr.tsamp),
         float(hdr.fch1), float(hdr.foff), cfg_items,
         _file_digest(config.killfilename),
@@ -116,10 +128,30 @@ def search_key(infile: str, fil, config) -> str:
     ))
 
 
+def legacy_search_keys(infile: str, fil, config) -> dict[int, str]:
+    """Keys under which OLDER checkpoint formats stay resumable.
+
+    A v4 file — written before the jerk axis and trial lattice existed
+    — describes the same search iff this one has no jerk axis and an
+    f32 lattice ("auto" that resolved to f32 counts: quantisation
+    never engages silently, pipeline passes the RESOLVED config here).
+    Its v4-compat key is byte-identical to what the v4 writer emitted:
+    version 4 with the v5-only config fields stripped.
+    """
+    jerk_free = (float(config.jerk_start) == 0.0
+                 and float(config.jerk_end) == 0.0
+                 and float(config.jerk_step) == 0.0)
+    lattice = getattr(config, "trial_lattice", "f32")
+    if not jerk_free or lattice not in ("auto", "f32"):
+        return {}
+    return {4: _search_key_impl(fil, config, 4, drop=_V5_NEW_FIELDS)}
+
+
 def _cand_to_obj(c: Candidate) -> dict:
     """Candidate -> JSON-safe dict (recursive over assoc)."""
     obj = {
-        "dm": c.dm, "dm_idx": c.dm_idx, "acc": c.acc, "nh": c.nh,
+        "dm": c.dm, "dm_idx": c.dm_idx, "acc": c.acc, "jerk": c.jerk,
+        "nh": c.nh,
         "snr": c.snr, "freq": c.freq, "folded_snr": c.folded_snr,
         "opt_period": c.opt_period, "is_adjacent": c.is_adjacent,
         "is_physical": c.is_physical,
@@ -138,7 +170,10 @@ def _cand_from_obj(obj: dict) -> Candidate:
     fold = obj.get("fold")
     return Candidate(
         dm=float(obj["dm"]), dm_idx=int(obj["dm_idx"]),
-        acc=float(obj["acc"]), nh=int(obj["nh"]), snr=float(obj["snr"]),
+        acc=float(obj["acc"]),
+        # absent in v4 rows: pre-jerk searches are jerk=0 by definition
+        jerk=float(obj.get("jerk", 0.0)),
+        nh=int(obj["nh"]), snr=float(obj["snr"]),
         freq=float(obj["freq"]), folded_snr=float(obj["folded_snr"]),
         opt_period=float(obj["opt_period"]),
         is_adjacent=bool(obj["is_adjacent"]),
@@ -165,7 +200,8 @@ class SearchCheckpoint:
     corrupted or substituted file would execute arbitrary code."""
 
     def __init__(self, path: str, key: str, interval: int = 8,
-                 advisory: dict | None = None):
+                 advisory: dict | None = None,
+                 legacy: dict[int, str] | None = None):
         self.path = path
         self.key = key
         self.interval = max(int(interval), 1)
@@ -173,6 +209,11 @@ class SearchCheckpoint:
         #: time) — written alongside version/key, NEVER compared on
         #: load: the key carries the content identity
         self.advisory = dict(advisory or {})
+        #: {older format version: compat key} under which a pre-v5
+        #: checkpoint still resumes (see ``legacy_search_keys``); rows
+        #: from such a file deserialise with jerk=0.0 and appends keep
+        #: its original header (v5 only ADDS an optional row field)
+        self.legacy = dict(legacy or {})
         self._since_save = 0
         self._written: set[int] = set()
         self._resuming = False  # load() found a valid same-key file
@@ -200,16 +241,28 @@ class SearchCheckpoint:
                 path=self.path, reason="unreadable", error=str(exc),
             )
             return None
-        if header.get("version") != _FORMAT_VERSION:
+        version = header.get("version")
+        if version != _FORMAT_VERSION:
+            compat = self.legacy.get(version)
+            if compat is None or header.get("key") != compat:
+                warn_event(
+                    "checkpoint_invalid",
+                    f"ignoring checkpoint {self.path!r}: format version "
+                    f"{version} != {_FORMAT_VERSION}",
+                    path=self.path, reason="version_mismatch",
+                    found=version, expected=_FORMAT_VERSION,
+                )
+                return None
+            # migration: an older-format file whose compat key matches
+            # resumes in place — this run's appends continue under the
+            # original header (the row format is append-compatible)
             warn_event(
-                "checkpoint_invalid",
-                f"ignoring checkpoint {self.path!r}: format version "
-                f"{header.get('version')} != {_FORMAT_VERSION}",
-                path=self.path, reason="version_mismatch",
-                found=header.get("version"), expected=_FORMAT_VERSION,
+                "checkpoint_migrated",
+                f"resuming v{version} checkpoint {self.path!r} under "
+                f"format v{_FORMAT_VERSION} (jerk-free search)",
+                path=self.path, found=version, expected=_FORMAT_VERSION,
             )
-            return None
-        if header.get("key") != self.key:
+        elif header.get("key") != self.key:
             warn_event(
                 "checkpoint_invalid",
                 f"ignoring checkpoint {self.path!r}: it belongs to a "

@@ -178,6 +178,41 @@ class AccelerationDistiller(BaseDistiller):
         return (freqs > lo) & (freqs < hi)
 
 
+class JerkDistiller(BaseDistiller):
+    """Jerk-adjacent de-dup (ISSUE 13): the jerk-axis analogue of
+    :class:`AccelerationDistiller`.  A jerk mismatch dj smears a
+    signal's apparent frequency by up to f*|dj|*tobs^2/(6c) over the
+    observation (the cubic resample term's peak fractional shift), so
+    a fundamental absorbs candidates whose frequency sits inside that
+    drift window plus the usual tolerance edge.  Runs only when the
+    search has >1 jerk trial — accel-only runs never construct it, so
+    their distillation chain is untouched.  Python-vectorised only
+    (no native predicate id; jerk grids are small)."""
+
+    native_type = None
+
+    def __init__(self, tobs: float, tolerance: float, keep_related: bool):
+        super().__init__(keep_related)
+        self.tobs = tobs
+        self.tobs2_over_6c = tobs * tobs / (6.0 * SPEED_OF_LIGHT)
+        self.tolerance = tolerance
+
+    def setup(self, cands):
+        super().setup(cands)
+        self.jerks = np.array([c.jerk for c in cands], np.float64)
+
+    def matches(self, idx):
+        fundi_freq = self.freqs[idx]
+        freqs = self.freqs[idx + 1 :]
+        delta_jerk = self.jerks[idx] - self.jerks[idx + 1 :]
+        jerk_freq = (fundi_freq
+                     + delta_jerk * fundi_freq * self.tobs2_over_6c)
+        edge = fundi_freq * self.tolerance
+        lo = np.minimum(jerk_freq, fundi_freq) - edge
+        hi = np.maximum(jerk_freq, fundi_freq) + edge
+        return (freqs > lo) & (freqs < hi)
+
+
 class DMDistiller(BaseDistiller):
     native_type = 2
 

@@ -58,12 +58,19 @@ from ..ops.fold import (
     fold_time_series_core,
     optimise_device,
 )
-from .distill import AccelerationDistiller, DMDistiller, HarmonicDistiller
+from .distill import (
+    AccelerationDistiller,
+    DMDistiller,
+    HarmonicDistiller,
+    JerkDistiller,
+)
 from .plan import (
     FOLD_NBINS,
     FOLD_NINTS,
     AccelerationPlan,
+    JerkPlan,
     SearchConfig,
+    combine_trials,
     prev_power_of_two,
 )
 from .score import CandidateScorer
@@ -134,12 +141,17 @@ def _pallas_mode() -> str | None:
         return None
 
 
-def resample_block_for(n: int, max_shift: int) -> int | None:
+def resample_block_for(n: int, max_shift: int, width_fn=None) -> int | None:
     """Block size for the table-driven resampler: the largest power of
     two dividing ``n``, capped at 16384 (the measured sweet spot on
     v5e).  None if ``n`` has no useful power-of-two factor, or the
     shift is outside the staircase tables' validity domain
-    (4*max_shift >= n) — the legacy on-device path handles both."""
+    (4*max_shift >= n) — the legacy on-device path handles both.
+
+    ``width_fn``: optional block -> residual-table width; jerk-axis
+    searches pass ``residual_width_jerk`` at their global accel/jerk
+    bounds (the accel-only ``residual_width`` underestimates once the
+    cubic term contributes drift)."""
     from ..ops.resample import residual_width
 
     if 4 * max_shift >= n:
@@ -148,8 +160,10 @@ def resample_block_for(n: int, max_shift: int) -> int | None:
     b = min(b, 16384)
     if b < 128:
         return None
+    if width_fn is None:
+        width_fn = lambda blk: residual_width(max_shift, blk, n)
     # keep the per-block residual table narrow even for huge shifts
-    while residual_width(max_shift, b, n) > 18 and b > 128:
+    while width_fn(b) > 18 and b > 128:
         b //= 2
     return b
 
@@ -209,12 +223,12 @@ def search_accel_chunk(tim_w, rtabs, mean, std, tsamp, nharms, bounds,
 
 def search_one_accel_legacy(tim_w, accel, mean, std, tsamp, nharms, bounds,
                             capacity, min_snr, max_shift=None,
-                            methods=None):
+                            methods=None, jerk=0.0):
     """On-device index math fallback for fft sizes with no power-of-two
     factor (no host tables).  NB: on real TPU hardware the emulated-f64
     rint is inexact for a small fraction of indices; the table path is
     exact and preferred."""
-    tim_r = resample2(tim_w, accel, tsamp, max_shift)
+    tim_r = resample2(tim_w, accel, tsamp, max_shift, jerk=jerk)
     return _spectra_peaks(tim_r, mean, std, nharms, bounds, capacity,
                           min_snr, methods)
 
@@ -228,12 +242,21 @@ def search_one_accel_legacy(tim_w, accel, mean, std, tsamp, nharms, bounds,
 )
 def search_accel_chunk_legacy(tim_w, accels, mean, std, tsamp, nharms,
                               bounds, capacity, min_snr, max_shift=None,
-                              methods=None):
-    fn = lambda a: search_one_accel_legacy(
+                              methods=None, jerks=None):
+    # ``jerks=None`` keeps the accel-only trace (and its compiled
+    # program) byte-identical to the pre-jerk build; a jerk-axis search
+    # passes the per-trial jerks alongside the accels
+    if jerks is None:
+        fn = lambda a: search_one_accel_legacy(
+            tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr,
+            max_shift, methods,
+        )
+        return jax.vmap(fn)(accels)
+    fn = lambda a, j: search_one_accel_legacy(
         tim_w, a, mean, std, tsamp, nharms, bounds, capacity, min_snr,
-        max_shift, methods,
+        max_shift, methods, j,
     )
-    return jax.vmap(fn)(accels)
+    return jax.vmap(fn)(accels, jerks)
 
 
 # --------------------------------------------------------------------------
@@ -305,11 +328,62 @@ class PulsarSearch:
             )
         from ..ops.resample import resample2_max_shift
 
+        # jerk axis (ISSUE 13): a fixed-step, DM-independent grid
+        # combined with every per-DM accel list (plan.combine_trials);
+        # the default (0, 0, 0) plan has exactly one zero-jerk trial
+        # and leaves every accel-only code path structurally untouched
+        self.jerk_plan = JerkPlan(
+            config.jerk_start, config.jerk_end, config.jerk_step)
+        max_acc = max(abs(config.acc_start), abs(config.acc_end))
         self.max_shift = resample2_max_shift(
-            max(abs(config.acc_start), abs(config.acc_end)),
-            hdr.tsamp, self.size,
+            max_acc, hdr.tsamp, self.size,
+            max_jerk=self.jerk_plan.max_abs,
         )
-        self.resample_block = resample_block_for(self.size, self.max_shift)
+        #: static residual-table width for jerk-axis table builds: ONE
+        #: global bound (config-level max |accel| and |jerk|) so every
+        #: DM row's tables — and the chunked drivers' scan steps —
+        #: share a single shape; None on the accel-only path, whose
+        #: bisection builder stays bit-identical to the pre-jerk build
+        self.table_width = None
+        if self.jerk_plan.max_abs > 0.0:
+            from ..ops.resample import residual_width_jerk
+
+            width_fn = lambda blk: residual_width_jerk(
+                max_acc, self.jerk_plan.max_abs, hdr.tsamp, blk,
+                self.size)
+            self.resample_block = resample_block_for(
+                self.size, self.max_shift, width_fn=width_fn)
+            if self.resample_block is not None:
+                self.table_width = width_fn(self.resample_block)
+        else:
+            self.resample_block = resample_block_for(
+                self.size, self.max_shift)
+        # trial lattice (ISSUE 13): resolve "auto" to a concrete dtype
+        # ONCE, outside any trace — via the parity-gated tuner sidecar
+        # (search/tuning.py), falling back to f32.  The legacy
+        # trial_nbits=8 flag is an explicit u8 force (validated above).
+        forced_lattice = config.trial_lattice
+        if config.trial_nbits == 8 and forced_lattice in ("auto", "f32"):
+            forced_lattice = "u8"
+        from .tuning import resolve_trial_lattice
+
+        self.lattice = resolve_trial_lattice(
+            forced_lattice, sidecar=config.tune_file,
+            stage="dedisperse", nsamps=self.out_nsamps)
+        if self.lattice == "u8" and hdr.nbits > 8:
+            if config.trial_lattice == "u8":
+                raise ConfigError(
+                    "trial_lattice=u8 needs an integer (<=8-bit) input "
+                    "filterbank: the u8 staircase scales by the input "
+                    "dynamic range (same constraint as trial_nbits=8)")
+            # stale sidecar pick for a float input: refuse it loudly
+            warn_event(
+                "lattice_fallback",
+                f"ignoring tuner lattice pick 'u8' for a "
+                f"{hdr.nbits}-bit input; using f32",
+                picked="u8", nbits=int(hdr.nbits),
+            )
+            self.lattice = "f32"
         self.killmask = None
         if config.killfilename:
             self.killmask = load_killmask(config.killfilename, fil.nchans)
@@ -417,15 +491,24 @@ class PulsarSearch:
         return self._maybe_quantise(trials)
 
     def _maybe_quantise(self, trials: jax.Array) -> jax.Array:
-        """Opt-in uint8 trial lattice (``trial_nbits=8``), exactly as
-        dedisp_execute's out_nbits=8 quantises (`dedisperser.hpp:
-        104-112`)."""
-        if self.config.trial_nbits != 8:
-            return trials
-        from ..ops.dedisperse import quantise_trials_u8
+        """Apply the RESOLVED trial lattice (``self.lattice``): "u8" is
+        the dedisp_execute out_nbits=8 staircase (`dedisperser.hpp:
+        104-112`, also reachable via the legacy ``trial_nbits=8``
+        flag), "bf16" the half-bandwidth round-trip cast, "f32" the
+        identity.  Resolution happened in ``__init__`` — an "auto"
+        config only lands here non-f32 through a parity-validated
+        tuner pick."""
+        lattice = getattr(self, "lattice", "f32")
+        if lattice == "u8":
+            from ..ops.dedisperse import quantise_trials_u8
 
-        return quantise_trials_u8(
-            trials, self.fil.header.nbits, self.fil.nchans)
+            return quantise_trials_u8(
+                trials, self.fil.header.nbits, self.fil.nchans)
+        if lattice == "bf16":
+            from ..ops.dedisperse import quantise_trials_bf16
+
+            return quantise_trials_bf16(trials)
+        return trials
 
     def _trial_tim(self, trials: jax.Array, idx: int) -> jax.Array:
         if self.out_nsamps >= self.size:
@@ -471,11 +554,20 @@ class PulsarSearch:
             bool(len(self.birdies)),
         )
         acc_list = self.acc_plan.generate_accel_list(dm)
-        n = len(acc_list)
+        # combined (accel, jerk) trial axis: slot k is accel k%na at
+        # jerk k//na; a single zero-jerk trial returns acc_list
+        # UNCHANGED (plan.combine_trials), so accel-only searches run
+        # the exact pre-jerk trial sequence
+        trial_accs, trial_jerks = combine_trials(
+            acc_list, self.jerk_plan.jerk_list())
+        has_jerk = self.jerk_plan.max_abs > 0.0
+        n = len(trial_accs)
         chunk = max(1, min(accel_chunk or cfg.accel_chunk, n))
         padded = int(np.ceil(n / chunk)) * chunk
         accs = np.zeros(padded, np.float32)
-        accs[:n] = acc_list
+        accs[:n] = trial_accs
+        jerks = np.zeros(padded, np.float32)
+        jerks[:n] = trial_jerks
         cap = start_capacity or cfg.peak_capacity
         chunk_tables = {}
         if self.resample_block is not None:
@@ -489,6 +581,9 @@ class PulsarSearch:
                         accs[c0 : c0 + chunk], float(self.fil.tsamp),
                         self.size, self.max_shift,
                         block=self.resample_block,
+                        jerks=(jerks[c0 : c0 + chunk] if has_jerk
+                               else None),
+                        width=(self.table_width if has_jerk else None),
                     ))
                 )
         # per-chunk modelled work (obs/costmodel.py), attached to the
@@ -517,10 +612,12 @@ class PulsarSearch:
                         )
                     else:
                         batch = jnp.asarray(accs[c0 : c0 + chunk])
+                        jbatch = (jnp.asarray(jerks[c0 : c0 + chunk])
+                                  if has_jerk else None)
                         idxs, snrs, counts = search_accel_chunk_legacy(
                             tim_w, batch, mean, std, float(self.fil.tsamp),
                             cfg.nharmonics, self.bounds, cap, cfg.min_snr,
-                            self.max_shift, methods,
+                            self.max_shift, methods, jbatch,
                         )
                     sp.block((idxs, snrs, counts))
                 all_idxs.append(np.asarray(idxs))
@@ -537,26 +634,28 @@ class PulsarSearch:
                 dm_trial=int(idx), count=mx, capacity=cap,
             )
         return self.process_dm_peaks(
-            dm, idx, acc_list,
+            dm, idx, trial_accs,
             np.concatenate(all_idxs), np.concatenate(all_snrs),
             np.concatenate(all_counts),
-            capacity=cap,
+            capacity=cap, jerk_list=trial_jerks,
         )
 
     def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts,
-                         capacity=None):
-        """Turn per-(accel, spectrum) peak buffers into distilled per-DM
-        candidates."""
+                         capacity=None, jerk_list=None):
+        """Turn per-(trial, spectrum) peak buffers into distilled
+        per-DM candidates.  ``acc_list`` is the COMBINED trial axis;
+        ``jerk_list`` its parallel per-trial jerks (None -> all 0)."""
         groups = [
             self._peaks_to_candidates(
                 idxs[j], snrs[j], counts[j], dm, dm_idx, float(acc),
                 capacity,
+                jerk=(0.0 if jerk_list is None else float(jerk_list[j])),
             )
             for j, acc in enumerate(acc_list)
         ]
         return self._distill_accel_groups(groups)
 
-    def _distill_dm_row(self, ii, group, acc_list):
+    def _distill_dm_row(self, ii, group, acc_list, jerk_list=None):
         """Build + distill one DM trial's candidates from its decoded
         peak group (None -> no peaks); the per-row fallback behind
         :meth:`_distill_rows_batch`."""
@@ -568,9 +667,10 @@ class PulsarSearch:
         for j in range(len(acc_list)):
             m = eacc == j
             acc = float(acc_list[j])
+            jerk = 0.0 if jerk_list is None else float(jerk_list[j])
             groups.append([
-                Candidate(dm=dm, dm_idx=ii, acc=acc, nh=int(nh),
-                          snr=float(sn), freq=float(fq))
+                Candidate(dm=dm, dm_idx=ii, acc=acc, jerk=jerk,
+                          nh=int(nh), snr=float(sn), freq=float(fq))
                 for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
             ])
         return self._distill_accel_groups(groups)
@@ -598,20 +698,28 @@ class PulsarSearch:
         from .distill import SPEED_OF_LIGHT
 
         cfg = self.config
-        rows = list(rows)
+        # rows may carry an optional 4th element: the per-trial jerks
+        # parallel to acc_list (jerk-axis searches); pad to 4-tuples
+        rows = [(r[0], r[1], r[2], r[3] if len(r) > 3 else None)
+                for r in rows]
         if dm_of is None:
             dm_of = lambda k: k
-        if _native is None:
+        jp = getattr(self, "jerk_plan", None)
+        # the native segmented distiller has no jerk predicate: any
+        # jerk-axis search takes the per-row Python path (which chains
+        # the JerkDistiller through _distill_accel_groups)
+        jerk_free = jp is None or (jp.njerk == 1 and jp.max_abs == 0.0)
+        if _native is None or not jerk_free:
             return {
-                ii: self._distill_dm_row(dm_of(ii), grp, acc_list)
-                for ii, grp, acc_list in rows
+                ii: self._distill_dm_row(dm_of(ii), grp, acc_list, jerks)
+                for ii, grp, acc_list, jerks in rows
             }
         out: dict = {}
         # ---- stage A: harmonic distill per (dm, accel) segment -------
         fa, sa, nha, acca = [], [], [], []
         bounds_a = [0]
         row_meta = []  # (dm_idx, n_accel_trials)
-        for ii, grp, acc_list in rows:
+        for ii, grp, acc_list, _jerks in rows:
             if grp is None:
                 out[ii] = []
                 continue
@@ -693,10 +801,17 @@ class PulsarSearch:
         for cands in groups:
             accel_trial_cands.extend(harm_still.distill(cands))
         acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
-        return acc_still.distill(accel_trial_cands)
+        out = acc_still.distill(accel_trial_cands)
+        jp = getattr(self, "jerk_plan", None)
+        if jp is not None and jp.njerk > 1:
+            # jerk-adjacent de-dup (ISSUE 13), only when the axis is
+            # real — accel-only runs keep the exact pre-jerk chain
+            jerk_still = JerkDistiller(self.tobs, cfg.freq_tol, True)
+            out = jerk_still.distill(out)
+        return out
 
     def _peaks_to_candidates(self, idxs, snrs, counts, dm, dm_idx, acc,
-                             capacity=None):
+                             capacity=None, jerk=0.0):
         cands: list[Candidate] = []
         for level, (start, stop, factor) in enumerate(self.bounds):
             cnt = int(counts[level])
@@ -733,12 +848,26 @@ class PulsarSearch:
             pidx, psnr = identify_unique_peaks(bi[order], bs[order])
             for p, s in zip(pidx, psnr):
                 cands.append(
-                    Candidate(dm=dm, dm_idx=dm_idx, acc=acc, nh=level,
-                              snr=float(s), freq=float(p * factor))
+                    Candidate(dm=dm, dm_idx=dm_idx, acc=acc, jerk=jerk,
+                              nh=level, snr=float(s),
+                              freq=float(p * factor))
                 )
         return cands
 
     # -- full run ----------------------------------------------------------
+
+    def _identity_config(self, cfg=None):
+        """``cfg`` with an "auto" trial lattice replaced by the
+        RESOLVED dtype: the checkpoint/tuner identity must pin the
+        concrete lattice (two "auto" runs that resolve differently are
+        different searches)."""
+        cfg = self.config if cfg is None else cfg
+        lattice = getattr(self, "lattice", "f32")
+        if cfg.trial_lattice == lattice:
+            return cfg
+        from dataclasses import replace
+
+        return replace(cfg, trial_lattice=lattice)
 
     def _make_checkpoint(self, fil=None, cfg=None):
         # batched dispatch passes per-beam (fil, cfg) so every beam
@@ -747,13 +876,19 @@ class PulsarSearch:
         cfg = self.config if cfg is None else cfg
         if not cfg.checkpoint_file:
             return None, {}
-        from .checkpoint import SearchCheckpoint, search_key
+        from .checkpoint import (
+            SearchCheckpoint,
+            legacy_search_keys,
+            search_key,
+        )
 
+        key_cfg = self._identity_config(cfg)
         ckpt = SearchCheckpoint(
             cfg.checkpoint_file,
-            search_key(cfg.infilename, fil, cfg),
+            search_key(cfg.infilename, fil, key_cfg),
             cfg.checkpoint_interval,
             advisory={"input": cfg.infilename},
+            legacy=legacy_search_keys(cfg.infilename, fil, key_cfg),
         )
         return ckpt, (ckpt.load() or {})
 
@@ -762,7 +897,8 @@ class PulsarSearch:
         key the checkpoint uses: input + geometry + parameters)."""
         from .checkpoint import search_key
 
-        return search_key(self.config.infilename, self.fil, self.config)
+        return search_key(self.config.infilename, self.fil,
+                          self._identity_config())
 
     def run(self) -> SearchResult:
         from ..obs.costmodel import record_run_costs

@@ -77,6 +77,24 @@ class SearchConfig:
     # (ops.dedisperse.quantise_trials_u8) for sensitivity studies —
     # NOT tighter golden parity; see the NOTE on quantise_trials_u8.
     trial_nbits: int = 32
+    # jerk (acceleration-derivative) trial axis (Andersen & Ransom
+    # 2018): a fixed-step DM-independent grid jerk_start..jerk_end in
+    # m/s^3, combined with every accel trial into one flattened trial
+    # axis per DM (search/plan.py:combine_trials).  The defaults keep
+    # a single zero-jerk trial — bit-identical to the accel-only
+    # search (the kernel-II ramp skips the cubic term entirely).
+    jerk_start: float = 0.0
+    jerk_end: float = 0.0
+    jerk_step: float = 0.0
+    # dedispersed-trial storage lattice for the bandwidth-bound
+    # dedisperse/resample/spectrum stages: "f32" (exact, default
+    # resolution), "u8" (dedisp's uint8 lattice, = trial_nbits=8),
+    # "bf16" (round-trip bfloat16 — halves trial bytes, keeps range).
+    # "auto" resolves through the tuner sidecar's parity-gated
+    # ``lattice`` section (search/tuning.py) and falls back to f32:
+    # a quantised lattice NEVER engages silently — only via a
+    # parity-validated sidecar pick or this explicit flag.
+    trial_lattice: str = "auto"
     # TPU-build extras (no reference equivalent)
     peak_capacity: int = 1024  # fixed-size device peak buffer per spectrum
     accel_chunk: int = 16      # accel trials batched per device step
@@ -175,27 +193,96 @@ class SearchConfig:
 
 @dataclass(frozen=True)
 class TrialGridGeometry:
-    """Closed-form summary of the full DM x accel trial grid."""
+    """Closed-form summary of the full DM x accel x jerk trial grid.
+
+    The jerk axis multiplies every DM's accel list into one combined
+    flattened trial axis (:func:`combine_trials`), so ``namax`` is the
+    widest per-DM ACCEL count while ``n_trials_total`` counts combined
+    (accel, jerk) trials; ``njerk == 1`` is the accel-only grid."""
 
     n_dm: int
     namax: int            # widest per-DM accel-trial count
-    n_trials_total: int   # sum over DMs of that DM's accel trials
+    n_trials_total: int   # sum over DMs of combined (accel, jerk) trials
+    njerk: int = 1        # jerk trials (1 = accel-only grid)
 
 
-def trial_grid_geometry(dm_list, acc_plan,
-                        acc_lists=None) -> TrialGridGeometry:
-    """Grid geometry for ``dm_list`` under ``acc_plan``; pass the
-    per-DM ``acc_lists`` when the caller already generated them (the
-    mesh driver does) to skip regenerating the grid."""
+def trial_grid_geometry(dm_list, acc_plan, acc_lists=None,
+                        jerk_plan=None) -> TrialGridGeometry:
+    """Grid geometry for ``dm_list`` under ``acc_plan`` (and the
+    optional ``jerk_plan`` third axis); pass the per-DM ``acc_lists``
+    when the caller already generated them (the mesh driver does) to
+    skip regenerating the grid.  ``acc_lists`` here are PURE accel
+    lists — combined flattened lists would double-count the jerk
+    multiplier."""
     if acc_lists is None:
         acc_lists = [acc_plan.generate_accel_list(float(dm))
                      for dm in dm_list]
     counts = [len(a) for a in acc_lists]
+    njerk = jerk_plan.njerk if jerk_plan is not None else 1
     return TrialGridGeometry(
         n_dm=len(counts),
         namax=max(counts) if counts else 0,
-        n_trials_total=int(sum(counts)),
+        n_trials_total=int(sum(counts)) * int(njerk),
+        njerk=int(njerk),
     )
+
+
+class JerkPlan:
+    """Fixed-step jerk (acceleration-derivative) trial grid, in m/s^3.
+
+    DM-independent by design: the jerk-induced smearing is a
+    second-order correction to the accel tolerance, so a fixed step
+    (Andersen & Ransom 2018 use a uniform w-dot grid) is the standard
+    choice.  A zero trial is always present when the range straddles
+    zero, and the grid is sorted/deduplicated — the forced zero must
+    not shadow an on-grid zero.  ``jerk_lo == jerk_hi`` collapses to
+    one trial; the all-zero default is the accel-only search."""
+
+    def __init__(self, jerk_lo: float, jerk_hi: float, step: float):
+        lo, hi = float(jerk_lo), float(jerk_hi)
+        if hi < lo:
+            raise ConfigError(
+                f"jerk_start={lo} > jerk_end={hi}: empty jerk grid")
+        if lo == hi:
+            grid = [lo]
+        else:
+            if not step > 0.0:
+                raise ConfigError(
+                    f"jerk_step={step} must be > 0 when jerk_start="
+                    f"{lo} < jerk_end={hi}")
+            grid = list(np.arange(lo, hi, np.float64(step)))
+            grid.append(hi)
+            if lo < 0.0 < hi:
+                grid.append(0.0)  # forced zero-jerk trial
+        self._grid = np.unique(np.asarray(grid, dtype=np.float32))
+
+    def jerk_list(self) -> np.ndarray:
+        return self._grid.copy()
+
+    @property
+    def njerk(self) -> int:
+        return len(self._grid)
+
+    @property
+    def max_abs(self) -> float:
+        """|jerk| bound for static max-shift/residual-width planning."""
+        return float(np.abs(self._grid).max(initial=0.0))
+
+
+def combine_trials(acc_list, jerk_list):
+    """Flatten one DM's (accel, jerk) trial product into the combined
+    trial axis the drivers batch over: accel varies fastest, so slot
+    ``k`` maps back as ``acc = acc_list[k % na]``,
+    ``jerk = jerk_list[k // na]``.  Returns ``(accs_flat, jerks_flat)``
+    float32.  With one zero-jerk trial the combined axis IS the accel
+    list (identical values and order), keeping the accel-only search
+    bit-identical."""
+    acc = np.asarray(acc_list, dtype=np.float32)
+    jerks = np.asarray(jerk_list, dtype=np.float32)
+    if len(jerks) == 1 and float(jerks[0]) == 0.0:
+        return acc, np.zeros(len(acc), np.float32)
+    return (np.tile(acc, len(jerks)),
+            np.repeat(jerks, len(acc)))
 
 
 class AccelerationPlan:

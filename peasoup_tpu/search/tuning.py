@@ -144,15 +144,18 @@ def save_tuning(path: str, key: str, cap_hw: int, ck_hw: int,
                "cap_hw": int(cap_hw), "ck_hw": int(ck_hw)}
         if row_hw is not None:
             obj["row_hw"] = [int(v) for v in row_hw]
-        # the extraction and calibration sections are device-keyed,
-        # not search-keyed: carry them across rewrites (and across
-        # search-key changes)
+        # the extraction, calibration and lattice sections are
+        # device-keyed, not search-keyed: carry them across rewrites
+        # (and across search-key changes)
         extraction = load_extraction(path)
         if extraction:
             obj["extraction"] = extraction
         calibration = load_calibration(path)
         if calibration:
             obj["calibration"] = calibration
+        lattice = load_lattice(path)
+        if lattice:
+            obj["lattice"] = lattice
         with open(tmp, "w") as f:
             json.dump(obj, f)
         os.replace(tmp, path)
@@ -599,6 +602,163 @@ def resolve_peaks_methods(bounds, capacity: int, *, forced: str = "auto",
             out.append("two_stage" if stop > _TWO_STAGE_MIN_SIZE
                        else "sort")
     return tuple(out)
+
+
+# --------------------------------------------------------------------------
+# trial-lattice selection (ISSUE 13; see search/plan.py trial_lattice)
+# --------------------------------------------------------------------------
+
+#: the selectable trial-lattice dtypes (ops/dedisperse.py): identity,
+#: dedisp's uint8 staircase, and a bf16 round-trip of the f32 trials
+LATTICE_DTYPES = ("f32", "u8", "bf16")
+
+#: per-trial-sample bytes each lattice costs the bandwidth-bound
+#: dedisperse-write / spectrum-read stages (obs/costmodel.py consumes
+#: this; u8 quantises THROUGH one byte then widens on read)
+LATTICE_ITEMSIZE = {"f32": 4, "u8": 1, "bf16": 2}
+
+#: committed defaults: no device kind ships a non-f32 pick — quantised
+#: lattices engage only after a MEASURED, parity-validated sidecar
+#: entry (or an explicit config force).  The table exists so a future
+#: sweep can commit known-good picks the way DEFAULT_EXTRACTION_COSTS
+#: commits v5e extraction costs.
+DEFAULT_LATTICE_PICKS: dict[str, dict] = {}
+
+
+def lattice_bucket(nsamps: int) -> int:
+    """Geometry bucket of a lattice cell: next-power-of-two of the
+    trial row length (same bucketing rule as ``stop_bucket``)."""
+    return stop_bucket(nsamps)
+
+
+def _lattice_key(stage: str, bucket: int) -> str:
+    return f"{stage}/{int(bucket)}"
+
+
+def load_lattice(path: str) -> dict:
+    """The sidecar's ``"lattice"`` section ({} when absent or
+    unreadable) — like ``"extraction"``, it ignores the search-key/
+    version gate: lattice economics belong to the device, not to one
+    search."""
+    if not path or not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except Exception:
+        return {}
+    sec = obj.get("lattice")
+    return sec if isinstance(sec, dict) else {}
+
+
+def update_lattice(path: str, device_kind: str, stage: str, nsamps: int,
+                   *, costs: dict | None = None,
+                   picked: str | None = None,
+                   parity: dict | None = None) -> None:
+    """Merge one measured-cost / picked-path / parity entry into the
+    sidecar's ``"lattice"`` section (read-modify-write, atomic; every
+    other key of the file is preserved).
+
+    ``costs``: measured device seconds per lattice dtype for this
+    (stage, geometry bucket).  ``parity``: {dtype: {"ok": bool,
+    "max_snr_delta": float, "candidates_moved": int}} — the parity
+    harness's verdict vs the f32 reference; ``resolve_trial_lattice``
+    refuses any auto pick whose parity entry is missing or not ok."""
+    if not path:
+        return
+    try:
+        obj = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    obj = json.load(f)
+            except Exception:
+                obj = {}
+        if not isinstance(obj, dict):
+            obj = {}
+        sec = obj.setdefault("lattice", {})
+        cell = sec.setdefault(str(device_kind), {}).setdefault(
+            _lattice_key(stage, lattice_bucket(nsamps)), {})
+        if costs:
+            for d, s in costs.items():
+                if d in LATTICE_DTYPES and s is not None:
+                    cell[d] = float(s)
+        if picked is not None:
+            cell["picked"] = str(picked)
+        if parity:
+            pcell = cell.setdefault("parity", {})
+            for d, verdict in parity.items():
+                if d in LATTICE_DTYPES and isinstance(verdict, dict):
+                    pcell[d] = {
+                        "ok": bool(verdict.get("ok", False)),
+                        "max_snr_delta": float(
+                            verdict.get("max_snr_delta", 0.0)),
+                        "candidates_moved": int(
+                            verdict.get("candidates_moved", 0)),
+                    }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except OSError as exc:
+        warn_event(
+            "tune_io_error",
+            f"could not update lattice sidecar {path!r}: {exc}",
+            path=path, op="update_lattice", error=str(exc),
+        )
+
+
+def _lattice_parity_ok(cell: dict, dtype: str) -> bool:
+    """True iff the parity harness has validated ``dtype`` in this
+    cell: its verdict exists, is ok, and moved no golden candidate.
+    f32 is the reference — always ok."""
+    if dtype == "f32":
+        return True
+    verdict = (cell.get("parity") or {}).get(dtype)
+    return (isinstance(verdict, dict) and bool(verdict.get("ok"))
+            and int(verdict.get("candidates_moved", 1)) == 0)
+
+
+def resolve_trial_lattice(forced: str = "auto", *,
+                          device_kind: str | None = None,
+                          sidecar: str = "", stage: str = "dedisperse",
+                          nsamps: int = 0) -> str:
+    """The concrete trial-lattice dtype a run should use.
+
+    ``forced``: ``SearchConfig.trial_lattice`` — a concrete dtype wins
+    unconditionally (the A/B forcing path; parity is the operator's
+    problem when they force).  ``"auto"`` resolution: the sidecar's
+    measured cell for (device kind, stage, geometry bucket) — a
+    recorded ``picked`` whose parity verdict is ok wins; else the
+    cheapest measured dtype whose parity verdict is ok; else the
+    committed defaults (same parity rule); else ``"f32"``.  A
+    quantised lattice therefore NEVER engages silently: it takes
+    either an explicit force or a measured, parity-validated sidecar
+    entry (the acceptance gate of ISSUE 13).
+    """
+    if forced != "auto" and forced not in LATTICE_DTYPES:
+        from ..errors import ConfigError
+
+        raise ConfigError(
+            f"trial_lattice={forced!r}: use auto, "
+            + ", ".join(LATTICE_DTYPES))
+    if forced != "auto":
+        return forced
+    device_kind = device_kind or _device_kind_default()
+    key = _lattice_key(stage, lattice_bucket(nsamps))
+    for table in (load_lattice(sidecar), DEFAULT_LATTICE_PICKS):
+        cell = (_kind_entry(table, device_kind) or {}).get(key)
+        if not isinstance(cell, dict):
+            continue
+        picked = cell.get("picked")
+        if picked in LATTICE_DTYPES and _lattice_parity_ok(cell, picked):
+            return picked
+        costs = {d: cell[d] for d in LATTICE_DTYPES
+                 if isinstance(cell.get(d), (int, float))
+                 and _lattice_parity_ok(cell, d)}
+        if costs:
+            return min(costs, key=costs.get)
+    return "f32"
 
 
 def record_peaks_choices(sidecar: str, bounds, capacity: int, methods,

@@ -345,7 +345,11 @@ class SurveyWorker:
                 (k, repr(v)) for k, v in (job.overrides or {}).items()))
             return (ovr, int(hdr.nchans), int(hdr.nbits),
                     float(hdr.tsamp), float(hdr.fch1), float(hdr.foff),
-                    int(size), eff)
+                    int(size), eff,
+                    # jerk axis + trial lattice change the padded grid
+                    # and the traced program — never batch across them
+                    float(cfg.jerk_start), float(cfg.jerk_end),
+                    float(cfg.jerk_step), str(cfg.trial_lattice))
         except Exception:
             return None
 

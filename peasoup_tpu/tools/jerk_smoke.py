@@ -1,0 +1,274 @@
+"""Jerk-search smoke test (``make jerk-smoke``).
+
+CPU end-to-end proof that the jerk axis (ISSUE 13) buys real
+sensitivity and that the quantised trial lattice engages only through
+the parity gate:
+
+Phase 1 — zero-jerk parity: one synthetic constant-period observation
+searched twice — the accel-only default config vs the same config
+spelled through the new machinery (explicit zero jerk grid, forced
+``trial_lattice="f32"``).  The candidate fingerprints must be
+BIT-IDENTICAL: a jerk axis nobody asked for must cost nothing and
+change nothing.
+
+Phase 2 — jerked-pulse recovery: a pulse train synthesised with the
+resampler's own cubic index ramp run backwards (a constant-period
+signal smeared by a known jerk), searched with the accel-only grid and
+with a {-j, 0, +j} jerk grid.  The accel-only search must MISS the
+pulse (its quadratic trials cannot de-smear a cubic drift); the jerk
+search must recover it at the injected period with the injected jerk
+trial attached.  This is the 10-100x grid paying for itself.
+
+Phase 3 — lattice sidecar: the jerk search re-run under each forced
+lattice dtype; per-dtype device seconds and parity verdicts vs the f32
+reference (max SNR delta, candidates moved) are recorded through
+``search/tuning.py:update_lattice``, and ``resolve_trial_lattice`` is
+asserted to return the recorded pick for ``auto`` — and to refuse any
+dtype whose verdict failed.  A ``kind="jerk_smoke"`` ledger record is
+appended and read back.
+
+Exit status 0 only if every assertion holds — CI-gateable like
+``serve-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+SPEED_OF_LIGHT = 299792458.0
+
+#: synthetic observation geometry (small enough for a CPU smoke, long
+#: enough that the injected jerk smears the pulse by tens of samples).
+#: SIZE is the search's fft length — the cubic ramp is pinned to it so
+#: the matched (0, jerk) trial de-smears exactly; PAD keeps
+#: size + max_shift + 1 samples available after the lossless trim
+SIZE = 8192
+PAD = 320
+NSAMPS = SIZE + PAD
+NCHANS = 16
+TSAMP = 0.000256
+F0 = 50.0          # injected topocentric spin frequency, Hz
+PULSE_AMP = 30     # on-pulse amplitude over the noise floor
+DUTY = 0.06
+MIN_SNR = 7.0
+
+
+def _pulse_value(phase_idx: np.ndarray) -> np.ndarray:
+    """Rest-frame pulse-train value at fractional sample index."""
+    phase = np.mod(phase_idx * TSAMP * F0, 1.0)
+    return (phase < DUTY).astype(np.float64)
+
+
+def _write_synthetic(path: str, jerk: float = 0.0,
+                     seed: int = 0) -> str:
+    """An 8-bit filterbank carrying a DM-0 pulse train smeared by
+    ``jerk``: observed sample m holds the rest-frame signal at
+    ``m - shift(m)`` where shift is resample2's cubic index ramp
+    ``m*jf*(m-n)*(m+n)`` — so the search's matching (0, jerk) trial
+    de-smears it exactly, and no quadratic accel trial can."""
+    from peasoup_tpu.io.sigproc import (
+        SigprocHeader, write_sigproc_header,
+    )
+
+    rng = np.random.default_rng(seed)
+    m = np.arange(NSAMPS, dtype=np.float64)
+    jf = jerk * TSAMP * TSAMP / (6.0 * SPEED_OF_LIGHT)
+    shift = m * jf * (m - SIZE) * (m + SIZE)
+    tim = _pulse_value(m - shift)
+    data = rng.integers(0, 24, size=(NSAMPS, NCHANS), dtype=np.uint8)
+    data = np.minimum(
+        data + (tim[:, None] * PULSE_AMP).astype(np.uint8), 255
+    ).astype(np.uint8)
+    hdr = SigprocHeader(nbits=8, nchans=NCHANS, tsamp=TSAMP,
+                        fch1=1510.0, foff=-10.0, nsamples=NSAMPS)
+    with open(path, "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        f.write(data.tobytes())
+    return path
+
+
+def _check(ok: bool, what: str, failures: list[str]) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        failures.append(what)
+
+
+def _run_search(path: str, **overrides):
+    """One MeshPulsarSearch over ``path``; returns (result, search,
+    elapsed_s of the run)."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    cfg = SearchConfig(**dict(
+        dict(dm_start=0.0, dm_end=10.0, acc_start=-5.0, acc_end=5.0,
+             min_snr=MIN_SNR, npdmp=0, limit=64, size=SIZE),
+        **overrides))
+    search = MeshPulsarSearch(read_filterbank(path), cfg)
+    t0 = time.time()
+    result = search.run()
+    return result, search, time.time() - t0
+
+
+def _fingerprint(result) -> list[tuple]:
+    return sorted(
+        (round(float(c.freq), 9), round(float(c.dm), 3),
+         round(float(c.acc), 3), round(float(c.snr), 4))
+        for c in result.candidates)
+
+
+def _find_pulse(result, tol: float = 2e-3):
+    """The strongest candidate within ``tol`` fractional frequency of
+    the injected F0 (or a harmonic fold of it), or None."""
+    best = None
+    for c in result.candidates:
+        for h in (1.0, 0.5, 2.0):
+            if abs(c.freq * h - F0) / F0 < tol:
+                if best is None or c.snr > best.snr:
+                    best = c
+    return best
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-jerk-smoke",
+        description="Peasoup-TPU - jerk-search + trial-lattice smoke",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-jerk-smoke",
+                   help="scratch directory (wiped)")
+    p.add_argument("--jerk", type=float, default=6.0e6,
+                   help="injected jerk magnitude, m/s^3 (scaled for "
+                        "the smoke's short synthetic observation)")
+    args = p.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir)
+    failures: list[str] = []
+    jerk = float(args.jerk)
+    jgrid = dict(jerk_start=-jerk, jerk_end=jerk, jerk_step=jerk)
+
+    # ---- phase 1: zero-jerk parity -----------------------------------
+    clean = _write_synthetic(os.path.join(args.dir, "clean.fil"))
+    res_default, _, _ = _run_search(clean)
+    res_zero, search_zero, _ = _run_search(
+        clean, jerk_start=0.0, jerk_end=0.0, jerk_step=0.0,
+        trial_lattice="f32")
+    _check(_fingerprint(res_default) == _fingerprint(res_zero),
+           "zero-jerk run bit-identical to the accel-only default",
+           failures)
+    _check(search_zero.jerk_plan.njerk == 1
+           and search_zero.lattice == "f32",
+           "zero jerk grid collapses to one trial, lattice f32",
+           failures)
+    _check(_find_pulse(res_default) is not None,
+           "clean pulse found by the accel-only search", failures)
+
+    # ---- phase 2: jerked-pulse recovery ------------------------------
+    jerked = _write_synthetic(os.path.join(args.dir, "jerked.fil"),
+                              jerk=jerk)
+    res_acc, _, _ = _run_search(jerked)
+    res_jerk, search_jerk, t_f32 = _run_search(jerked, **jgrid)
+    missed = _find_pulse(res_acc)
+    found = _find_pulse(res_jerk)
+    _check(missed is None,
+           "accel-only grid misses the jerk-smeared pulse", failures)
+    _check(found is not None,
+           "jerk grid recovers the smeared pulse", failures)
+    if found is not None:
+        _check(abs(abs(float(found.jerk)) - jerk) / jerk < 1e-6,
+               f"recovered candidate carries the injected jerk trial "
+               f"(got {float(found.jerk):g})", failures)
+    _check(search_jerk.jerk_plan.njerk == 3,
+           "jerk plan is the 3-trial {-j, 0, +j} grid", failures)
+
+    # ---- phase 3: lattice sidecar + ledger ---------------------------
+    sidecar = os.path.join(args.dir, "tune.json")
+    ref_fp = {f: s for f, _, _, s in _fingerprint(res_jerk)}
+    costs, parity = {"f32": t_f32}, {}
+    for dtype in ("u8", "bf16"):
+        res_q, _, t_q = _run_search(jerked, trial_lattice=dtype,
+                                    **jgrid)
+        costs[dtype] = t_q
+        q_fp = {f: s for f, _, _, s in _fingerprint(res_q)}
+        moved = len(set(ref_fp) ^ set(q_fp))
+        deltas = [abs(q_fp[f] - ref_fp[f])
+                  for f in set(ref_fp) & set(q_fp)]
+        q_pulse = _find_pulse(res_q)
+        parity[dtype] = {
+            "ok": q_pulse is not None and moved == 0,
+            "max_snr_delta": max(deltas, default=0.0),
+            "candidates_moved": moved,
+        }
+        _check(q_pulse is not None,
+               f"forced {dtype} lattice still recovers the pulse",
+               failures)
+
+    from peasoup_tpu.search.tuning import (
+        _device_kind_default, resolve_trial_lattice, update_lattice,
+    )
+
+    device_kind = _device_kind_default()
+    nsamps = int(search_jerk.size)
+    ok_dtypes = [d for d in costs
+                 if d == "f32" or parity.get(d, {}).get("ok")]
+    picked = min(ok_dtypes, key=costs.get)
+    update_lattice(sidecar, device_kind, "dedisperse", nsamps,
+                   costs=costs, picked=picked, parity=parity)
+    _check(os.path.exists(sidecar)
+           and "lattice" in json.load(open(sidecar)),
+           "lattice sidecar section written", failures)
+    resolved = resolve_trial_lattice(
+        "auto", device_kind=device_kind, sidecar=sidecar,
+        stage="dedisperse", nsamps=nsamps)
+    _check(resolved == picked,
+           f"auto resolution returns the recorded pick ({picked})",
+           failures)
+    # poison one verdict: a failed parity entry must force f32 back
+    bad = {d: dict(parity.get(d, {}), ok=False, candidates_moved=1)
+           for d in ("u8", "bf16")}
+    poisoned = os.path.join(args.dir, "tune_bad.json")
+    update_lattice(poisoned, device_kind, "dedisperse", nsamps,
+                   costs=costs, picked="u8", parity=bad)
+    _check(resolve_trial_lattice(
+        "auto", device_kind=device_kind, sidecar=poisoned,
+        stage="dedisperse", nsamps=nsamps) == "f32",
+           "failed parity verdict refuses the quantised pick",
+           failures)
+
+    from peasoup_tpu.obs.history import (
+        append_history, load_history, make_history_record,
+    )
+
+    history = os.path.join(args.dir, "history.jsonl")
+    append_history(make_history_record(
+        "jerk_smoke",
+        metrics={"njerk": 3,
+                 "f32_elapsed_s": round(costs["f32"], 4),
+                 **{f"{d}_elapsed_s": round(costs[d], 4)
+                    for d in ("u8", "bf16")}},
+        parity=f"picked={picked}",
+        extra={"trial_lattice": picked},
+    ), path=history)
+    back = load_history(history, kinds=("jerk_smoke",))
+    _check(len(back) == 1
+           and back[0].get("trial_lattice") == picked,
+           "jerk_smoke ledger record emitted and read back", failures)
+
+    print()
+    if failures:
+        print(f"jerk-smoke: {len(failures)} FAILURE(S)")
+        for f in failures:
+            print("  - " + f)
+        return 1
+    print("jerk-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

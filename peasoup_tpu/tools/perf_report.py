@@ -43,9 +43,12 @@ GATE_METRIC = "e2e_s"
 #: hide a device-side regression behind host/tunnel jitter, so the
 #: peak-extraction share and the pooled search-stage device seconds
 #: (bench.py's ``peaks_device_s`` / ``search_device_s`` metrics) are
-#: gated too.  A metric with fewer than 2 records passes vacuously —
-#: pre-ISSUE-6 ledgers stay green.
-STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s")
+#: gated too, as is the jerk bench's per-trial cost
+#: (``jerk_s_per_ktrial``, from ``kind:"jerk"`` records — ISSUE 13).
+#: A metric with fewer than 2 records passes vacuously — ledgers
+#: predating a metric stay green.
+STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
+                      "jerk_s_per_ktrial")
 
 #: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
 #: device seconds per wall second — a drop means the dispatch pipeline
@@ -259,6 +262,39 @@ def loadgen_table(ledger: str | None = None) -> str:
     return "\n".join(lines)
 
 
+def jerk_table(ledger: str | None = None, limit: int = 12) -> str:
+    """Jerk-bench history (``kind:"jerk"`` ledger records — ISSUE 13):
+    per-trial cost next to the jerk-grid size and the resolved trial
+    LATTICE column, so "did the tuner's u8/bf16 pick actually engage"
+    and "what does a jerk trial cost" are trendable from the default
+    report view."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("jerk",))
+    if not records:
+        return ""
+    lines = [f"jerk bench ({len(records)} record(s); newest last):",
+             f"  {'ts':<20}{'njerk':>6}{'mult':>7}{'lattice':>9}"
+             f"{'s/ktrial':>10}{'wall_x':>8}"]
+    for rec in records[-limit:]:
+        m = rec.get("metrics", {})
+        lat = str(rec.get("trial_lattice") or "-")
+        lines.append(
+            f"  {str(rec.get('ts', ''))[:19]:<20}"
+            f"{int(m.get('njerk', 0)):>6}"
+            f"{float(m.get('jerk_trial_multiplier', 0.0)):>7.3g}"
+            f"{lat:>9}"
+            f"{float(m.get('jerk_s_per_ktrial', 0.0)):>10.4g}"
+            f"{float(m.get('jerk_wallclock_ratio', 0.0)):>8.3g}")
+    vals = [float(r["metrics"]["jerk_s_per_ktrial"]) for r in records
+            if isinstance(r.get("metrics", {}).get("jerk_s_per_ktrial"),
+                          (int, float))]
+    if vals:
+        lines.append(f"  s/ktrial trend: {sparkline(vals)}  "
+                     f"(median {_median(vals):.4g}, last "
+                     f"{vals[-1]:.4g})")
+    return "\n".join(lines)
+
+
 def stage_table(records: list[dict]) -> str:
     """Trailing per-stage device-time and utilization figures (from the
     newest record that carries them)."""
@@ -384,10 +420,22 @@ def main(argv=None) -> int:
             m.strip() for m in (args.stage_metrics or "").split(",")
             if m.strip() and m.strip() != args.metric
         ]
+        # the jerk bench's metrics live in kind="jerk" records; widen
+        # the gate's view so jerk_s_per_ktrial is judged against its
+        # own history (metric_series keys never collide across kinds —
+        # absent metrics still pass vacuously)
+        gate_records = records
+        if args.kind == "bench":
+            try:
+                gate_records = records + load_history(
+                    args.ledger or default_ledger_path(),
+                    kinds=("jerk",))
+            except OSError:
+                pass
         codes, msgs = [], []
         for m in metrics:
             code, msg = regression_gate(
-                records, metric=m, head=args.head,
+                gate_records, metric=m, head=args.head,
                 window=args.window, threshold=args.threshold)
             codes.append(code)
             msgs.append(msg)
@@ -426,6 +474,10 @@ def main(argv=None) -> int:
         if lg:
             print()
             print(lg)
+        jt = jerk_table(args.ledger)
+        if jt:
+            print()
+            print(jt)
     if gate_msg:
         print()
         print(gate_msg)

@@ -1,0 +1,456 @@
+"""Jerk-search axis + quantized trial lattice tests (ISSUE 13).
+
+Covers the grid plumbing (JerkPlan, combine_trials, 3-axis geometry),
+the resampler's cubic index ramp (zero-jerk bit-identity, numpy
+reference parity, host-exact (accel, jerk) pair tables), the
+trial-lattice parity gate (sidecar round-trip, refusal on failed
+verdicts, forced overrides), checkpoint v4 -> v5 migration, the
+JerkDistiller, and a synthetic end-to-end zero-jerk bit-identity run
+through the fused mesh driver."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from peasoup_tpu.data.candidates import Candidate
+from peasoup_tpu.errors import ConfigError
+from peasoup_tpu.ops.resample import (
+    resample2,
+    resample2_from_tables,
+    resample2_max_shift,
+    resample2_unique_tables,
+)
+from peasoup_tpu.search.plan import (
+    JerkPlan,
+    SearchConfig,
+    combine_trials,
+    trial_grid_geometry,
+)
+
+rng = np.random.default_rng(7)
+
+SPEED_OF_LIGHT = 299792458.0
+
+
+# --------------------------------------------------------------------------
+# trial grid plumbing
+# --------------------------------------------------------------------------
+
+def test_jerk_plan_grid():
+    p = JerkPlan(-10.0, 10.0, 5.0)
+    np.testing.assert_array_equal(
+        p.jerk_list(), np.array([-10, -5, 0, 5, 10], np.float32))
+    assert p.njerk == 5 and p.max_abs == 10.0
+    # forced zero when the range straddles it off-grid
+    assert 0.0 in JerkPlan(-7.0, 7.0, 5.0).jerk_list()
+    # collapse to one trial
+    one = JerkPlan(3.0, 3.0, 0.0)
+    assert one.njerk == 1 and one.jerk_list()[0] == 3.0
+    zero = JerkPlan(0.0, 0.0, 0.0)
+    assert zero.njerk == 1 and zero.max_abs == 0.0
+
+
+def test_jerk_plan_errors():
+    with pytest.raises(ConfigError):
+        JerkPlan(5.0, -5.0, 1.0)
+    with pytest.raises(ConfigError):
+        JerkPlan(-5.0, 5.0, 0.0)
+
+
+def test_combine_trials_ordering():
+    acc = np.array([0.0, 1.0, 2.0], np.float32)
+    jerks = np.array([-5.0, 0.0, 5.0], np.float32)
+    accs, js = combine_trials(acc, jerks)
+    assert len(accs) == len(js) == 9
+    # accel varies fastest: slot k -> acc[k % na], jerk[k // na]
+    na = len(acc)
+    for k in range(9):
+        assert accs[k] == acc[k % na]
+        assert js[k] == jerks[k // na]
+
+
+def test_combine_trials_zero_jerk_is_identity():
+    """The single-zero-jerk combine returns the SAME accel array object
+    (structural bit-identity for the accel-only path)."""
+    acc = np.array([0.0, 1.0], np.float32)
+    accs, js = combine_trials(acc, np.array([0.0], np.float32))
+    assert accs is acc
+    assert js.dtype == np.float32 and not js.any()
+
+
+def test_trial_grid_geometry_jerk_axis():
+    from peasoup_tpu.search.plan import AccelerationPlan
+
+    plan = AccelerationPlan(-5.0, 5.0, 1.10, 64000.0, 1 << 17,
+                            6.4e-5, 1510.0, -10.0)
+    dms = np.asarray([0.0, 50.0], np.float32)
+    flat = trial_grid_geometry(dms, plan)
+    jp = JerkPlan(-10.0, 10.0, 5.0)
+    cubed = trial_grid_geometry(dms, plan, jerk_plan=jp)
+    assert cubed.njerk == 5
+    assert cubed.n_trials_total == 5 * flat.n_trials_total
+    assert cubed.n_dm == flat.n_dm and cubed.namax == flat.namax
+
+
+def test_search_config_jerk_defaults():
+    cfg = SearchConfig()
+    assert cfg.jerk_start == cfg.jerk_end == cfg.jerk_step == 0.0
+    assert cfg.trial_lattice == "auto"
+
+
+# --------------------------------------------------------------------------
+# cubic index ramp
+# --------------------------------------------------------------------------
+
+def _ref_jerk_numpy(tim, accel, jerk, tsamp):
+    """Plain-gather kernel-II reference with the cubic jerk term."""
+    n = len(tim)
+    af = accel * tsamp / (2.0 * SPEED_OF_LIGHT)
+    jf = jerk * tsamp * tsamp / (6.0 * SPEED_OF_LIGHT)
+    i = np.arange(n, dtype=np.float64)
+    idx = np.rint(i + i * af * (i - float(n))
+                  + i * jf * (i - float(n)) * (i + float(n)))
+    return tim[np.clip(idx.astype(np.int64), 0, n - 1)]
+
+
+@pytest.mark.parametrize("accel,jerk", [
+    (0.0, 2e6), (125.5, -2e6), (-125.5, 5e5), (5.0, 0.0),
+])
+def test_resample2_jerk_matches_numpy(accel, jerk):
+    n = 1 << 14
+    tsamp = 0.000064
+    tim = rng.normal(size=n).astype(np.float32)
+    got = np.asarray(resample2(jnp.asarray(tim), accel, tsamp,
+                               jerk=jerk))
+    np.testing.assert_array_equal(
+        got, _ref_jerk_numpy(tim, accel, jerk, tsamp))
+
+
+def test_resample2_zero_jerk_bit_identical():
+    """jerk=0.0 must be the PRE-JERK program: identical jaxpr (the
+    static-zero gate keeps the cubic term out of the trace entirely)
+    and identical output."""
+    import jax
+
+    n = 1 << 12
+    tsamp = 0.000064
+    tim = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    old = jax.make_jaxpr(
+        lambda t: resample2(t, 125.5, tsamp))(tim)
+    new = jax.make_jaxpr(
+        lambda t: resample2(t, 125.5, tsamp, jerk=0.0))(tim)
+    assert str(old) == str(new)
+    np.testing.assert_array_equal(
+        np.asarray(resample2(tim, 125.5, tsamp)),
+        np.asarray(resample2(tim, 125.5, tsamp, jerk=0.0)))
+
+
+def test_resample2_max_shift_jerk_bound():
+    """The static bound covers the true peak displacement of the cubic
+    ramp (an under-bound would silently clip device slices)."""
+    n = 1 << 14
+    tsamp = 0.000064
+    for accel, jerk in ((125.5, 2e6), (0.0, 5e6), (500.0, 0.0)):
+        ms = resample2_max_shift(accel, tsamp, n, max_jerk=jerk)
+        af = accel * tsamp / (2.0 * SPEED_OF_LIGHT)
+        jf = jerk * tsamp * tsamp / (6.0 * SPEED_OF_LIGHT)
+        i = np.arange(n, dtype=np.float64)
+        shift = i * af * (i - float(n)) + i * jf * (i - n) * (i + n)
+        assert ms >= np.abs(shift).max()
+
+
+def test_resample2_unique_pair_tables_exact():
+    """(accel, jerk) pair tables are bit-exact with the on-device
+    gather ramp for every grid slot, and dedup by PAIR (same accel
+    under two jerks must not alias)."""
+    from peasoup_tpu.ops.resample import residual_width_jerk
+
+    n, tsamp, block = 1 << 14, 0.000064, 1024
+    accs = np.array([[0.0, 50.0, np.nan],
+                     [0.0, -50.0, 50.0]], np.float32)
+    jerks = np.array([[1e6, 1e6, np.nan],
+                      [-1e6, 1e6, 1e6]], np.float32)
+    ms = resample2_max_shift(50.0, tsamp, n, max_jerk=1e6)
+    width = residual_width_jerk(50.0, 1e6, tsamp, block, n)
+    d0, pos, step, uidx = resample2_unique_tables(
+        accs, tsamp, n, ms, block=block, jerks_grid=jerks, width=width)
+    # unique pairs: (-50,1e6) (0,-1e6) (0,0 <- NaN pad) (0,1e6)
+    # (50,1e6) -> 5 rows
+    assert d0.shape[0] == 5
+    assert uidx[0, 0] != uidx[1, 0]  # same accel, different jerk
+    tim = rng.normal(size=n).astype(np.float32)
+    for (r, c), acc in np.ndenumerate(accs):
+        if np.isnan(acc):
+            continue
+        u = int(uidx[r, c])
+        got = np.asarray(resample2_from_tables(
+            jnp.asarray(tim), jnp.asarray(d0[u]), jnp.asarray(pos[u]),
+            jnp.asarray(step[u]), ms, block=block))
+        np.testing.assert_array_equal(
+            got, _ref_jerk_numpy(tim, float(acc), float(jerks[r, c]),
+                                 tsamp))
+
+
+# --------------------------------------------------------------------------
+# trial lattice: quantisers + parity-gated tuner sidecar
+# --------------------------------------------------------------------------
+
+def test_quantise_trials_bf16_properties():
+    from peasoup_tpu.ops.dedisperse import quantise_trials_bf16
+
+    trials = jnp.asarray(
+        rng.normal(size=(4, 256)).astype(np.float32) * 100.0)
+    q = quantise_trials_bf16(trials)
+    assert q.dtype == jnp.float32  # widened back for the FFT chain
+    err = np.abs(np.asarray(q) - np.asarray(trials))
+    # bf16 keeps 8 significand bits: relative error < 2^-8
+    assert (err <= np.abs(np.asarray(trials)) * 2.0 ** -8 + 1e-12).all()
+    # idempotent: a bf16 lattice re-quantises to itself
+    np.testing.assert_array_equal(np.asarray(quantise_trials_bf16(q)),
+                                  np.asarray(q))
+
+
+def test_lattice_sidecar_roundtrip(tmp_path):
+    from peasoup_tpu.search.tuning import (
+        load_lattice,
+        resolve_trial_lattice,
+        update_lattice,
+    )
+
+    path = str(tmp_path / "tune.json")
+    good = {"ok": True, "max_snr_delta": 0.01, "candidates_moved": 0}
+    update_lattice(path, "TPU v5 lite", "dedisperse", 1 << 21,
+                   costs={"f32": 2.0, "u8": 0.8, "bf16": 1.2},
+                   picked="u8", parity={"u8": good, "bf16": good})
+    sec = load_lattice(path)
+    assert "TPU v5 lite" in sec
+    got = resolve_trial_lattice(
+        "auto", device_kind="TPU v5 lite", sidecar=path,
+        stage="dedisperse", nsamps=1 << 21)
+    assert got == "u8"
+    # other cells / devices fall back to f32
+    assert resolve_trial_lattice(
+        "auto", device_kind="TPU v4", sidecar=path,
+        stage="dedisperse", nsamps=1 << 21) == "f32"
+    assert resolve_trial_lattice(
+        "auto", device_kind="TPU v5 lite", sidecar=path,
+        stage="dedisperse", nsamps=1 << 10) == "f32"
+
+
+def test_lattice_parity_gate_refuses(tmp_path):
+    """A pick whose parity verdict failed (or moved a candidate) must
+    NOT engage — quantisation never engages silently."""
+    from peasoup_tpu.search.tuning import (
+        resolve_trial_lattice,
+        update_lattice,
+    )
+
+    path = str(tmp_path / "tune.json")
+    update_lattice(
+        path, "cpu", "dedisperse", 1 << 20,
+        costs={"f32": 2.0, "u8": 0.5},
+        picked="u8",
+        parity={"u8": {"ok": True, "max_snr_delta": 0.4,
+                       "candidates_moved": 2}})
+    assert resolve_trial_lattice(
+        "auto", device_kind="cpu", sidecar=path,
+        stage="dedisperse", nsamps=1 << 20) == "f32"
+    # a cheap dtype with NO parity entry is equally refused
+    path2 = str(tmp_path / "tune2.json")
+    update_lattice(path2, "cpu", "dedisperse", 1 << 20,
+                   costs={"f32": 2.0, "bf16": 0.5})
+    assert resolve_trial_lattice(
+        "auto", device_kind="cpu", sidecar=path2,
+        stage="dedisperse", nsamps=1 << 20) == "f32"
+
+
+def test_lattice_forced_override_and_validation():
+    from peasoup_tpu.search.tuning import resolve_trial_lattice
+
+    # a concrete force wins with no sidecar at all
+    assert resolve_trial_lattice("bf16") == "bf16"
+    assert resolve_trial_lattice("f32") == "f32"
+    with pytest.raises(ConfigError):
+        resolve_trial_lattice("f16")
+
+
+# --------------------------------------------------------------------------
+# checkpoint migration
+# --------------------------------------------------------------------------
+
+def _synthetic_fil(tmp_path):
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.tools.batch_smoke import _write_synthetic
+
+    path = _write_synthetic(str(tmp_path / "obs.fil"), seed=3)
+    return path, read_filterbank(path)
+
+
+def test_checkpoint_v4_migration(tmp_path):
+    """A v4 (pre-jerk) checkpoint resumes under v5 iff the search is
+    jerk-free with an f32/auto lattice; its rows deserialise with
+    jerk=0.0."""
+    from peasoup_tpu.search.checkpoint import (
+        SearchCheckpoint,
+        _cand_to_obj,
+        legacy_search_keys,
+        search_key,
+    )
+
+    path, fil = _synthetic_fil(tmp_path)
+    cfg = SearchConfig(dm_end=20.0)
+    key5 = search_key(path, fil, cfg)
+    legacy = legacy_search_keys(path, fil, cfg)
+    assert set(legacy) == {4}
+    assert legacy[4] != key5
+    # simulate the v4 writer: version-4 header + a row without jerk
+    row = _cand_to_obj(Candidate(dm=1.0, dm_idx=0, acc=2.0, nh=3,
+                                 snr=11.0, freq=7.0))
+    row.pop("jerk")
+    ck = str(tmp_path / "resume.ckpt")
+    with open(ck, "w") as f:
+        f.write(json.dumps({"version": 4, "key": legacy[4]}) + "\n")
+        f.write(json.dumps({"dm_idx": 0, "cands": [row]}) + "\n")
+    with pytest.warns(UserWarning, match="resuming v4 checkpoint"):
+        out = SearchCheckpoint(ck, key5, legacy=legacy).load()
+    assert out is not None and list(out) == [0]
+    assert out[0][0].jerk == 0.0 and out[0][0].acc == 2.0
+
+
+def test_checkpoint_v4_refused_for_jerk_search(tmp_path):
+    """The SAME v4 file must NOT resume a search that grew a jerk axis
+    or a non-f32 lattice — different trial grid, different results."""
+    from peasoup_tpu.search.checkpoint import (
+        SearchCheckpoint,
+        legacy_search_keys,
+        search_key,
+    )
+
+    path, fil = _synthetic_fil(tmp_path)
+    flat_cfg = SearchConfig(dm_end=20.0)
+    flat_legacy = legacy_search_keys(path, fil, flat_cfg)
+    ck = str(tmp_path / "resume.ckpt")
+    with open(ck, "w") as f:
+        f.write(json.dumps({"version": 4,
+                            "key": flat_legacy[4]}) + "\n")
+    for cfg in (SearchConfig(dm_end=20.0, jerk_start=-5e6,
+                             jerk_end=5e6, jerk_step=5e6),
+                SearchConfig(dm_end=20.0, trial_lattice="bf16")):
+        assert legacy_search_keys(path, fil, cfg) == {}
+        key = search_key(path, fil, cfg)
+        with pytest.warns(UserWarning, match="format version 4"):
+            out = SearchCheckpoint(
+                ck, key, legacy=legacy_search_keys(path, fil, cfg)
+            ).load()
+        assert out is None
+
+
+def test_checkpoint_v5_roundtrip_preserves_jerk(tmp_path):
+    from peasoup_tpu.search.checkpoint import SearchCheckpoint
+
+    ck = str(tmp_path / "v5.ckpt")
+    cands = {2: [Candidate(dm=1.0, dm_idx=2, acc=-3.0, jerk=5e6,
+                           nh=2, snr=12.0, freq=50.0)]}
+    cp = SearchCheckpoint(ck, "key")
+    cp.save(cands)
+    out = SearchCheckpoint(ck, "key").load()
+    assert out[2][0].jerk == 5e6
+
+
+def test_jerk_fields_change_search_key(tmp_path):
+    from peasoup_tpu.search.checkpoint import search_key
+
+    path, fil = _synthetic_fil(tmp_path)
+    base = search_key(path, fil, SearchConfig(dm_end=20.0))
+    jerked = search_key(path, fil, SearchConfig(
+        dm_end=20.0, jerk_start=-5e6, jerk_end=5e6, jerk_step=5e6))
+    latticed = search_key(path, fil, SearchConfig(
+        dm_end=20.0, trial_lattice="u8"))
+    assert len({base, jerked, latticed}) == 3
+
+
+# --------------------------------------------------------------------------
+# jerk-adjacent distillation
+# --------------------------------------------------------------------------
+
+def test_jerk_distiller_absorbs_drift_window():
+    from peasoup_tpu.search.distill import JerkDistiller
+
+    tobs = 40.0
+    f0 = 50.0
+    dj = 2e6
+    drift = f0 * dj * tobs * tobs / (6.0 * SPEED_OF_LIGHT)
+    assert drift > 0
+    cands = [
+        Candidate(freq=f0, snr=30.0, jerk=0.0),
+        # inside the (signed) drift window of a dj jerk mismatch:
+        # delta_jerk = 0 - dj < 0 pulls the window BELOW f0
+        Candidate(freq=f0 - 0.5 * drift, snr=20.0, jerk=dj),
+        # far outside any window
+        Candidate(freq=f0 * 1.5, snr=10.0, jerk=dj),
+    ]
+    out = JerkDistiller(tobs, 1e-4, keep_related=True).distill(cands)
+    assert len(out) == 2
+    assert out[0].freq == f0 and out[0].count_assoc() == 1
+    # zero jerk spread -> window collapses to the tolerance edge
+    tight = [
+        Candidate(freq=f0, snr=30.0, jerk=dj),
+        Candidate(freq=f0 + 0.5 * drift, snr=20.0, jerk=dj),
+    ]
+    out2 = JerkDistiller(tobs, 1e-4, keep_related=False).distill(tight)
+    assert len(out2) == 2
+
+
+# --------------------------------------------------------------------------
+# end-to-end zero-jerk bit-identity (fused mesh driver, synthetic)
+# --------------------------------------------------------------------------
+
+def _run_mesh(path, **overrides):
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+
+    cfg = SearchConfig(**dict(
+        dict(dm_end=20.0, min_snr=6.0, npdmp=0, limit=10), **overrides))
+    return MeshPulsarSearch(read_filterbank(path), cfg).run()
+
+
+def test_mesh_zero_jerk_bit_identity(tmp_path):
+    """An explicit zero jerk grid + forced f32 lattice through the
+    fused mesh driver returns candidates BIT-identical to the
+    accel-only default (the new axis costs nothing when unused)."""
+    from peasoup_tpu.tools.batch_smoke import _write_synthetic
+
+    path = _write_synthetic(str(tmp_path / "obs.fil"), seed=5)
+    ref = _run_mesh(path)
+    zero = _run_mesh(path, jerk_start=0.0, jerk_end=0.0,
+                     jerk_step=0.0, trial_lattice="f32")
+    fp = lambda res: sorted(
+        (c.freq, c.dm, c.acc, c.jerk, c.snr, c.nh)
+        for c in res.candidates)
+    assert fp(ref) == fp(zero)
+    assert all(c.jerk == 0.0 for c in ref.candidates)
+
+
+def test_tutorial_zero_jerk_bit_identity(tutorial_fil):
+    """Same invariant against the reference's shipped tutorial data
+    (the golden-parity observation): the jerk-free config spelled
+    through the new machinery must reproduce the accel-only
+    candidates bit-for-bit."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.search.pipeline import PulsarSearch
+
+    fil = read_filterbank(tutorial_fil)
+    base = dict(dm_start=0.0, dm_end=60.0, acc_start=-5.0,
+                acc_end=5.0, acc_pulse_width=64000.0, npdmp=0,
+                limit=50)
+    ref = PulsarSearch(fil, SearchConfig(**base)).run()
+    zero = PulsarSearch(fil, SearchConfig(
+        **base, jerk_start=0.0, jerk_end=0.0, jerk_step=0.0,
+        trial_lattice="f32")).run()
+    fp = lambda res: sorted(
+        (c.freq, c.dm, c.acc, c.jerk, c.snr, c.nh)
+        for c in res.candidates)
+    assert fp(ref) == fp(zero)

@@ -54,6 +54,65 @@ def test_costs_scale_with_geometry():
         2 * a["dedisperse"].flops)
 
 
+def test_lattice_itemsize_scales_trial_bytes():
+    """The trial-lattice dtype reaches the closed forms (ISSUE 13):
+    dedispersed trial bytes written and spectrum trial bytes read both
+    scale with ``trial_itemsize`` per LATTICE_ITEMSIZE, while flops are
+    untouched (quantisation changes traffic, not arithmetic)."""
+    from peasoup_tpu.search.tuning import LATTICE_ITEMSIZE
+
+    f32 = cm.pipeline_costs(
+        _geometry(trial_itemsize=LATTICE_ITEMSIZE["f32"]))
+    for dtype in ("u8", "bf16"):
+        isz = LATTICE_ITEMSIZE[dtype]
+        q = cm.pipeline_costs(_geometry(trial_itemsize=isz))
+        assert q["dedisperse"].bytes_written == pytest.approx(
+            f32["dedisperse"].bytes_written * isz / 4.0)
+        # spectrum reads trials at the lattice dtype plus f32/f64
+        # side inputs: the delta is exactly the trial-array shrink
+        g = _geometry()
+        assert (f32["spectrum"].bytes_read - q["spectrum"].bytes_read
+                == g.n_trials_total * g.size * (4 - isz))
+        assert q["spectrum"].flops == f32["spectrum"].flops
+        assert q["dedisperse"].flops == f32["dedisperse"].flops
+
+
+def test_jerk_axis_multiplies_trial_grid_geometry():
+    """``from_search`` folds the jerk plan in through
+    trial_grid_geometry: n_trials_total picks up the njerk factor, so
+    every per-trial closed form scales with it automatically."""
+    from peasoup_tpu.search.plan import (
+        AccelerationPlan,
+        JerkPlan,
+        trial_grid_geometry,
+    )
+
+    plan = AccelerationPlan(-5.0, 5.0, 1.10, 64000.0, 1 << 17,
+                            6.4e-5, 1510.0, -10.0)
+    dms = np.asarray([0.0, 50.0], np.float32)
+    flat = trial_grid_geometry(dms, plan)
+    jp = JerkPlan(-10.0, 10.0, 10.0)
+    cubed = trial_grid_geometry(dms, plan, jerk_plan=jp)
+    a = cm.pipeline_costs(_geometry(
+        n_trials_total=flat.n_trials_total))
+    b = cm.pipeline_costs(_geometry(
+        n_trials_total=cubed.n_trials_total, njerk=jp.njerk))
+    assert b["harmonics"].flops == pytest.approx(
+        jp.njerk * a["harmonics"].flops)
+    assert b["peaks"].flops == pytest.approx(
+        jp.njerk * a["peaks"].flops)
+    assert b["dedisperse"].flops == a["dedisperse"].flops
+
+
+def test_geometry_json_carries_lattice_fields():
+    g = _geometry(njerk=3, trial_itemsize=2)
+    blob = g.to_json()
+    assert blob["njerk"] == 3 and blob["trial_itemsize"] == 2
+    # defaults keep the pre-jerk accounting bit-for-bit
+    d = _geometry().to_json()
+    assert d["njerk"] == 1 and d["trial_itemsize"] == 4
+
+
 def test_dominant_classification():
     peak = {"flops_per_s": 1e12, "bytes_per_s": 100e9}
     assert cm.StageCost(1e12, 1e9, 1e9).dominant(peak) == "compute"
