@@ -11,8 +11,9 @@ HEALTH_SMOKE_DIR ?= /tmp/peasoup-health-smoke
 PIPELINE_SMOKE_DIR ?= /tmp/peasoup-pipeline-smoke
 LOADGEN_SMOKE_DIR ?= /tmp/peasoup-loadgen-smoke
 JERK_SMOKE_DIR ?= /tmp/peasoup-jerk-smoke
+SENSITIVITY_SMOKE_DIR ?= /tmp/peasoup-sensitivity-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -127,3 +128,14 @@ loadgen-smoke:
 jerk-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.jerk_smoke \
 	    --dir $(JERK_SMOKE_DIR)
+
+# sensitivity-observatory smoke test (ISSUE 14): a 3-point injected-SNR
+# sweep must recover the bright injections, miss the faintest, attach
+# a monotone per-stage SNR budget to every cell and append exactly one
+# kind:"sensitivity" ledger record; a recovered canary drain must pass
+# `health` while a missed canary must trip canary_recovery to crit
+# (nonzero exit) until a clean re-drain clears it; canary candidates
+# must stay out of science store reads
+sensitivity-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.sensitivity --smoke \
+	    --dir $(SENSITIVITY_SMOKE_DIR)

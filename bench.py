@@ -49,6 +49,15 @@ trial) before reporting the per-trial cost ratio; appends a
 ``kind="jerk"`` ledger record with per-stage device seconds and the
 resolved trial lattice.
 
+``--sensitivity`` runs the sensitivity micro-bench instead: the
+default injected-SNR sweep of ``tools/sensitivity.py`` (bright /
+marginal / sub-threshold cells) over synthetic observations,
+asserting the bright injections are recovered and the sub-threshold
+one is not before reporting the recovery fraction; appends the
+``kind="sensitivity"`` ledger record the perf gate trends
+``recovery_fraction`` from and the ``canary_recovery`` health rule
+reads its baseline median from.
+
 ``--loadgen [N]`` (default 16 jobs/rate) runs the open-loop
 saturation micro-bench instead: a seeded two-rate in-process sweep
 (``tools/loadgen.py`` — one rate under the stub workers' capacity,
@@ -521,6 +530,58 @@ def run_jerk_bench(njerk: int) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def run_sensitivity_bench() -> int:
+    """``bench.py --sensitivity``: the default injected-SNR sweep
+    (ISSUE 14) over synthetic observations — a real search per cell,
+    recovery matched against each cell's injection manifest and the
+    per-stage SNR budget attached.  The bright (snr_in >= 10) cells
+    must be recovered and the sub-threshold cell must not (a sweep
+    that "recovers" a snr 1.5 injection is matching noise); both are
+    asserted before any number is reported.  Prints one JSON line
+    with the recovery fraction, min detectable SNR and transfer
+    curve, and appends a ``kind="sensitivity"`` ledger record."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.tools.sensitivity import run_sweep
+
+    work = tempfile.mkdtemp(prefix="peasoup-sensitivity-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        REGISTRY.reset()
+        t0 = time.time()
+        doc = run_sweep(
+            work,
+            overrides=dict(dm_end=20.0, min_snr=6.0, npdmp=0,
+                           limit=16),
+            history=history, verbose=False)
+        elapsed = time.time() - t0
+        bright = [c for c in doc["cells"] if c["snr_in"] >= 10.0]
+        faint = [c for c in doc["cells"] if c["snr_in"] < 3.0]
+        ok = (all(c["recovered"] for c in bright)
+              and not any(c["recovered"] for c in faint))
+        out = {
+            "metric": "sensitivity_recovery_fraction",
+            "value": doc["recovery_fraction"],
+            "unit": "fraction",
+            "min_detectable_snr": doc["min_detectable_snr"],
+            "cells": len(doc["cells"]),
+            "elapsed_s": round(elapsed, 3),
+            "transfer": doc["transfer"],
+            "parity": ("bright injections recovered, sub-threshold "
+                       "missed" if ok else
+                       "SENSITIVITY SWEEP INCONSISTENT: "
+                       f"bright={[c['recovered'] for c in bright]} "
+                       f"faint={[c['recovered'] for c in faint]}"),
+        }
+        print(json.dumps(out))
+        return 0 if ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -547,6 +608,8 @@ def main() -> None:
     jk = jerk_arg(sys.argv[1:])
     if jk is not None:
         sys.exit(run_jerk_bench(jk))
+    if "--sensitivity" in sys.argv[1:]:
+        sys.exit(run_sensitivity_bench())
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

@@ -187,7 +187,10 @@ def write_search_output(result, outdir: str) -> dict:
     cfg = result.config
     report_path = (getattr(cfg, "metrics_json", "") or
                    os.path.join(outdir, "run_report.json"))
-    report = write_run_report(report_path, result)
+    injection = getattr(result, "injection", None)
+    report = write_run_report(
+        report_path, result,
+        extra=({"injection": injection} if injection else None))
     byte_mapping = write_candidate_binary(
         result.candidates, os.path.join(outdir, "candidates.peasoup")
     )

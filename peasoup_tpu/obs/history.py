@@ -58,6 +58,17 @@ a top-level ``rates`` list of slim per-rate rows (offered/achieved
 rate, p50/p95/p99 sojourn, duty cycle, quarantined count) that
 ``tools/perf_report.py`` renders as the rate x percentile table.
 
+``kind == "sensitivity"`` records are appended once per sensitivity
+sweep by ``tools/sensitivity.py``: metrics ``cells`` / ``recovered``
+/ ``recovery_fraction`` (the fraction of injected synthetic pulsars
+the search recovered — the baseline the ``canary_recovery`` health
+rule compares live canary traffic against), ``min_detectable_snr``
+(lowest injected SNR with >= 50% recovery; omitted when the sweep
+was inconclusive) and ``sweep_elapsed_s``, plus a top-level
+``transfer`` list of per-injected-SNR rows (cells, recovered,
+fraction, mean recovered SNR) that ``tools/perf_report.py`` renders
+as the transfer-curve table.
+
 Ledger I/O never raises into a benchmark run: append/load failures
 warn and return best-effort results.
 """

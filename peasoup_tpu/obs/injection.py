@@ -1,0 +1,318 @@
+"""Synthetic-pulsar injection: synthesizer, manifests and recovery matching.
+
+The sensitivity observatory's ground truth (ISSUE 14).  Every other
+observability layer (spans, cost model, telemetry, health rules, load
+curves) watches *performance*; this module supplies the known-answer
+probes that watch whether the pipeline still **finds pulsars**:
+
+* :func:`synthesize` writes a filterbank carrying a properly dispersed
+  pulse train with a chosen period / DM / accel / jerk / duty cycle and
+  target SNR, into fresh noise at any supported ``nbits``, and returns a
+  serialisable **injection manifest** describing exactly what went in.
+* :func:`match_candidates` decides whether a search recovered the
+  injection: candidate vs manifest within frequency / DM / accel / jerk
+  tolerances, harmonic-fold aware, using the same window formulas as the
+  distiller (``search/distill.py``) so "recovered" means "would have
+  survived distillation as the same signal".
+
+The module is deliberately **jax-free** (numpy + ``io/sigproc.py``
+only): it must be importable from the serve control plane, the load
+generator and the health rules without dragging in a backend.  The
+per-channel dispersion delay table is the same float32 arithmetic as
+``ops/dedisperse.py:delay_table`` (asserted identical by
+``tests/test_injection.py``), re-spelt here to keep the import graph
+clean.
+
+The acceleration/jerk smearing is resample2's own cubic index ramp run
+backwards — observed sample ``m`` holds the rest-frame signal at
+``m - shift(m)`` with ``shift(m) = m*af*(m-n) + m*jf*(m-n)*(m+n)``
+(``af = accel*tsamp/(2c)``, ``jf = jerk*tsamp^2/(6c)``, ``n`` the
+search's FFT size) — so the matching ``(accel, jerk)`` trial de-smears
+the train exactly, and the per-stage SNR budget probe
+(``search/pipeline.py``) can attribute every dB of loss to a concrete
+stage instead of to synthesis/search model mismatch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+SPEED_OF_LIGHT = 299792458.0
+
+#: dedisp's dispersion constant (MHz^2 pc^-1 cm^3 s) — must match
+#: ops/dedisperse.py:DM_CONST_S so injected delays land on the same
+#: sample lattice the search's dedispersion removes.
+DM_CONST_S = 4.15e3
+
+MANIFEST_VERSION = 1
+
+
+def delay_table(nchans: int, dt: float, f0: float, df: float) -> np.ndarray:
+    """Per-channel delay in samples per DM unit.
+
+    Bit-identical to ``ops.dedisperse.delay_table`` (same float32
+    arithmetic, same constant) without importing jax.
+    """
+    f = (np.float32(f0) + np.arange(nchans, dtype=np.float32) * np.float32(df))
+    a = np.float32(1.0) / f
+    b = np.float32(1.0) / np.float32(f0)
+    return (np.float32(DM_CONST_S / dt) * (a * a - b * b)).astype(np.float32)
+
+
+def _delays_in_samples(dm: float, table: np.ndarray) -> np.ndarray:
+    """Integer per-channel delays, round-half-up like dedisp's kernel."""
+    return np.floor(np.float32(dm) * np.float32(table) + 0.5).astype(np.int64)
+
+
+def _pack_payload(data: np.ndarray, nbits: int) -> bytes:
+    if nbits == 32:
+        return np.ascontiguousarray(data, dtype=np.float32).tobytes()
+    from peasoup_tpu.io.unpack import pack_bits
+
+    flat = np.ascontiguousarray(data, dtype=np.uint8).ravel()
+    return pack_bits(flat, nbits).tobytes()
+
+
+def noise_sigma(noise_max: int) -> float:
+    """Std of the uniform integer noise floor ``rng.integers(0, noise_max)``."""
+    return float(np.sqrt((noise_max * noise_max - 1.0) / 12.0))
+
+
+def amp_for_snr(snr: float, *, duty: float, nsamps: int, nchans: int,
+                noise_max: int) -> float:
+    """On-pulse amplitude that targets a spectral SNR of ``snr``.
+
+    Radiometer-style calibration: a duty-``delta`` boxcar train of
+    amplitude A over N samples x C summed channels carries matched
+    amplitude ``A*sqrt(delta*N*C)`` against a noise floor of std
+    ``sigma`` per sample, so ``A = snr*sigma/sqrt(delta*N*C)``.  This is
+    the *injected* SNR the sensitivity sweep's transfer curves measure
+    against; the recovered SNR is lower by exactly the per-stage losses
+    the budget probe attributes (scalloping, harmonic mismatch,
+    quantisation).
+    """
+    return float(snr) * noise_sigma(noise_max) / float(
+        np.sqrt(duty * nsamps * nchans))
+
+
+def synthesize(path: str, *, period: float | None = None,
+               freq: float | None = None, dm: float = 0.0,
+               accel: float = 0.0, jerk: float = 0.0, duty: float = 0.05,
+               snr: float | None = None, amp: float | None = None,
+               noise_max: int = 32, nsamps: int = 4096, nchans: int = 16,
+               tsamp: float = 0.000256, fch1: float = 1510.0,
+               foff: float = -10.0, nbits: int = 8, seed: int = 0,
+               size: int | None = None, truncate_bytes: int = 0,
+               data: np.ndarray | None = None) -> dict:
+    """Write a filterbank carrying a known synthetic pulsar; return its
+    injection manifest.
+
+    Exactly one of ``period`` (seconds) / ``freq`` (Hz) selects the spin;
+    exactly one of ``snr`` (target spectral SNR, converted through
+    :func:`amp_for_snr`) / ``amp`` (raw on-pulse amplitude) selects the
+    brightness.  ``size`` pins the cubic accel/jerk ramp to the search's
+    FFT length (defaults to ``nsamps``) so the matched trial de-smears
+    exactly.  ``data`` injects into an existing (nsamps, nchans) block
+    instead of fresh uniform noise; ``truncate_bytes`` drops trailing
+    payload bytes (the load generator's poison-input family).
+
+    The noise draw is always the generator's FIRST call, so two
+    manifests with the same seed and geometry share a noise floor
+    regardless of what is injected into it.
+    """
+    from peasoup_tpu.io.sigproc import SigprocHeader, write_sigproc_header
+
+    if (period is None) == (freq is None):
+        raise ValueError("pass exactly one of period= / freq=")
+    # the phase arithmetic below uses whichever spin quantity the
+    # caller supplied EXACTLY: on-grid periods (an integer number of
+    # samples) and literal frequencies must not pick up a reciprocal
+    # round trip's ulp, or boundary pulses drift off the train
+    by_period = freq is None
+    if by_period:
+        freq = 1.0 / period
+    else:
+        period = 1.0 / freq
+    if amp is None and snr is None:
+        raise ValueError("pass one of snr= / amp=")
+    if amp is None:
+        amp = amp_for_snr(snr, duty=duty, nsamps=nsamps, nchans=nchans,
+                          noise_max=noise_max)
+    n = int(size if size is not None else nsamps)
+
+    if data is None:
+        rng = np.random.default_rng(seed)
+        data = rng.integers(0, noise_max, size=(nsamps, nchans),
+                            dtype=np.uint8).astype(np.float64)
+    else:
+        data = np.asarray(data, dtype=np.float64).copy()
+        if data.shape != (nsamps, nchans):
+            raise ValueError(f"data shape {data.shape} != "
+                             f"({nsamps}, {nchans})")
+
+    # rest-frame pulse train evaluated at a fractional sample index;
+    # period expressed in samples so on-grid periods (e.g. the smoke
+    # recipes' 16*tsamp) place pulses exactly, while a caller-supplied
+    # frequency multiplies through directly
+    period_samples = period / tsamp
+
+    def pulse(phase_idx: np.ndarray) -> np.ndarray:
+        if by_period:
+            phase = np.mod(phase_idx / period_samples, 1.0)
+        else:
+            phase = np.mod(phase_idx * tsamp * freq, 1.0)
+        return (phase < duty).astype(np.float64)
+
+    af = accel * tsamp / (2.0 * SPEED_OF_LIGHT)
+    jf = jerk * tsamp * tsamp / (6.0 * SPEED_OF_LIGHT)
+    m = np.arange(nsamps, dtype=np.float64)
+    delays = _delays_in_samples(dm, delay_table(nchans, tsamp, fch1, foff))
+    for j in range(nchans):
+        # channel j sees the signal ``delays[j]`` samples late; the
+        # smear ramp applies in the dedispersed frame
+        md = m - delays[j]
+        shift = md * af * (md - n) + md * jf * (md - n) * (md + n)
+        data[:, j] += pulse(md - shift) * amp
+
+    top = 2.0 ** nbits - 1.0 if nbits != 32 else np.inf
+    if nbits == 32:
+        out = data.astype(np.float32)
+    else:
+        out = np.minimum(np.maximum(np.round(data), 0.0), top).astype(
+            np.uint8)
+
+    hdr = SigprocHeader(nbits=nbits, nchans=nchans, tsamp=tsamp, fch1=fch1,
+                        foff=foff, nsamples=nsamps)
+    payload = _pack_payload(out, nbits)
+    if truncate_bytes:
+        payload = payload[:-truncate_bytes]
+    with open(path, "wb") as f:
+        write_sigproc_header(f, hdr, include_nsamples=True)
+        f.write(payload)
+
+    return {
+        "v": MANIFEST_VERSION,
+        "kind": "injection",
+        "path": os.path.abspath(path),
+        "freq": float(freq),
+        "period": float(period),
+        "dm": float(dm),
+        "accel": float(accel),
+        "jerk": float(jerk),
+        "duty": float(duty),
+        "target_snr": float(snr) if snr is not None else None,
+        "amp": float(amp),
+        "noise_max": int(noise_max),
+        "nsamps": int(nsamps),
+        "nchans": int(nchans),
+        "tsamp": float(tsamp),
+        "fch1": float(fch1),
+        "foff": float(foff),
+        "nbits": int(nbits),
+        "seed": int(seed),
+        "size": n,
+    }
+
+
+def save_manifest(manifest: dict, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_manifest(path_or_manifest) -> dict:
+    """Accept a manifest dict, or a path to a saved one."""
+    if isinstance(path_or_manifest, dict):
+        return path_or_manifest
+    with open(path_or_manifest) as f:
+        return json.load(f)
+
+
+# --------------------------------------------------------------------------
+# recovery matching
+
+
+def _cand_field(cand, name: str, default: float = 0.0) -> float:
+    if isinstance(cand, dict):
+        return float(cand.get(name, default))
+    return float(getattr(cand, name, default))
+
+
+def _harmonically_related(f: float, f0: float, tol: float,
+                          max_harm: int) -> bool:
+    """Same predicate family as ``JerkDistiller.is_related``: some
+    integer fold ``kk*f`` lands within ``tol`` (fractional) of some
+    integer fold ``jj*f0``."""
+    if f <= 0.0 or f0 <= 0.0:
+        return False
+    for kk in range(1, max_harm + 1):
+        for jj in range(1, max_harm + 1):
+            ratio = kk * f / (jj * f0)
+            if 1.0 - tol < ratio < 1.0 + tol:
+                return True
+    return False
+
+
+def match_candidates(manifest, candidates, *, tobs: float | None = None,
+                     freq_tol: float = 2e-3, dm_tol: float | None = None,
+                     max_harm: int = 16) -> dict:
+    """Did a candidate list recover the injected pulsar?
+
+    Frequency matching is harmonic-fold aware (a candidate at half or
+    twice the injected spin counts, like the distiller's related-set
+    construction).  Accel and jerk windows translate the trial mismatch
+    into the fractional frequency drift it causes over ``tobs``
+    (``distill.py``'s ``acc_freq`` / jerk windows): a candidate matches
+    when ``|acc - accel| * tobs / c <= freq_tol`` and
+    ``|jerk - jerk0| * tobs^2 / (6c) <= freq_tol`` — compared on
+    magnitudes, since the recovered trial's sign convention is
+    resampler-relative.  ``dm_tol`` (pc cm^-3) is enforced only when
+    given: DM grids are tolerance-stepped, so the caller knows the
+    meaningful window.  Returns ``{"recovered", "best", "best_snr",
+    "n_matches"}`` with ``best`` the strongest matching candidate.
+    """
+    man = load_manifest(manifest)
+    f0 = float(man["freq"])
+    if tobs is None:
+        tobs = float(man["size"]) * float(man["tsamp"])
+    best, n_matches = None, 0
+    for c in candidates:
+        f = _cand_field(c, "freq")
+        if not _harmonically_related(f, f0, freq_tol, max_harm):
+            continue
+        dacc = abs(abs(_cand_field(c, "acc")) - abs(float(man["accel"])))
+        if dacc * tobs / SPEED_OF_LIGHT > freq_tol:
+            continue
+        djerk = abs(abs(_cand_field(c, "jerk")) - abs(float(man["jerk"])))
+        if djerk * tobs * tobs / (6.0 * SPEED_OF_LIGHT) > freq_tol:
+            continue
+        if dm_tol is not None and abs(
+                _cand_field(c, "dm") - float(man["dm"])) > dm_tol:
+            continue
+        n_matches += 1
+        if best is None or _cand_field(c, "snr") > _cand_field(best, "snr"):
+            best = c
+    return {
+        "recovered": best is not None,
+        "best": best,
+        "best_snr": _cand_field(best, "snr") if best is not None else 0.0,
+        "n_matches": n_matches,
+    }
+
+
+def smoke_observation(path: str, *, nsamps: int = 4096, nchans: int = 16,
+                      seed: int = 0, truncate_bytes: int = 0,
+                      noise_max: int = 32, amp: float = 60.0,
+                      tsamp: float = 0.000256) -> dict:
+    """The smoke tools' shared synthetic observation: a bright DM-0
+    train pulsing every 16th sample over uniform noise (historically
+    each tool's private ``_write_synthetic``).  Returns the manifest so
+    smoke inputs double as injections.
+    """
+    return synthesize(path, period=16.0 * tsamp, duty=0.05, amp=amp,
+                      noise_max=noise_max, nsamps=nsamps, nchans=nchans,
+                      tsamp=tsamp, seed=seed, truncate_bytes=truncate_bytes)

@@ -6,6 +6,15 @@ float32 fold[nbins*nints], then int32 ndets followed by ndets packed
 CandidatePOD records (float32 dm, int32 dm_idx, float32 acc, int32 nh,
 float32 snr, float32 freq) — the candidate itself first, then its
 flattened assoc tree in pre-order.
+
+The jerk axis (ISSUE 13/14) extends the layout with an optional
+``JRK0`` section between the fold block and the POD block: magic +
+int32 ndets + ndets float32 jerks, one per POD record in the same
+pre-order.  It is written ONLY when some detection carries a nonzero
+jerk, so accel-only searches keep emitting reference-byte-compatible
+files; the reader tolerates its absence (legacy files parse
+unchanged, jerk column zero) and every hit row it returns carries a
+``jerk`` field.
 """
 
 from __future__ import annotations
@@ -25,6 +34,10 @@ POD_DTYPE = np.dtype(
     ]
 )
 
+#: what the reader hands back: the reference POD plus the jerk column
+#: (zero when the file predates the JRK0 section)
+HIT_DTYPE = np.dtype(POD_DTYPE.descr + [("jerk", "<f4")])
+
 
 def write_candidate_binary(candidates, filename: str) -> dict[int, int]:
     """Write candidates; returns {candidate_index: byte_offset}."""
@@ -39,6 +52,13 @@ def write_candidate_binary(candidates, filename: str) -> dict[int, int]:
                     np.ascontiguousarray(cand.fold, dtype=np.float32).tobytes()
                 )
             dets = cand.collect()
+            jerks = np.array(
+                [float(getattr(d, "jerk", 0.0)) for d in dets],
+                dtype=np.float32)
+            if np.any(jerks):
+                f.write(b"JRK0")
+                f.write(struct.pack("<i", len(dets)))
+                f.write(jerks.tobytes())
             f.write(struct.pack("<i", len(dets)))
             pods = np.empty(len(dets), dtype=POD_DTYPE)
             for jj, d in enumerate(dets):
@@ -73,8 +93,23 @@ class CandidateFileParser:
             ).reshape(nints, nbins)
         else:
             self._f.seek(offset)
+        # second peek: the optional jerk section (absent in legacy
+        # files — the first int32 there is ndets, never b"JRK0")
+        pos = self._f.tell()
+        jerks = None
+        if self._f.read(4) == b"JRK0":
+            (njerk,) = struct.unpack("<i", self._f.read(4))
+            jerks = np.frombuffer(self._f.read(4 * njerk),
+                                  dtype=np.float32)
+        else:
+            self._f.seek(pos)
         (count,) = struct.unpack("<i", self._f.read(4))
-        hits = np.frombuffer(
+        pods = np.frombuffer(
             self._f.read(POD_DTYPE.itemsize * count), dtype=POD_DTYPE
         )
+        hits = np.zeros(count, dtype=HIT_DTYPE)
+        for name in POD_DTYPE.names:
+            hits[name] = pods[name]
+        if jerks is not None and len(jerks) == count:
+            hits["jerk"] = jerks
         return fold, hits

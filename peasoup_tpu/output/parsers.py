@@ -14,6 +14,7 @@ CAND_DTYPE = np.dtype(
         ("opt_period", "<f4"),
         ("dm", "<f4"),
         ("acc", "<f4"),
+        ("jerk", "<f4"),
         ("nh", "<f4"),
         ("snr", "<f4"),
         ("folded_snr", "<f4"),
@@ -55,7 +56,10 @@ class OverviewFile:
             rec["cand_num"] = int(cand.attrib["id"])
             for tag, _ in CAND_DTYPE.descr:
                 if tag != "cand_num":
-                    rec[tag] = float(cand.find(tag).text)
+                    # pre-jerk files have no <jerk> element: absent
+                    # tags read as 0 so legacy output parses unchanged
+                    el = cand.find(tag)
+                    rec[tag] = float(el.text) if el is not None else 0.0
         return out
 
     def get_candidate(self, idx: int) -> dict:
@@ -63,5 +67,7 @@ class OverviewFile:
         out = {"cand_num": int(cand.attrib["id"])}
         for tag, typename in CAND_DTYPE.descr:
             if tag != "cand_num":
-                out[tag] = np.array([cand.find(tag).text]).astype(typename)[0]
+                el = cand.find(tag)
+                text = el.text if el is not None else "0"
+                out[tag] = np.array([text]).astype(typename)[0]
         return out

@@ -156,6 +156,7 @@ class OutputFileWriter:
             cand.append(XMLElement("opt_period", c.opt_period))
             cand.append(XMLElement("dm", c.dm))
             cand.append(XMLElement("acc", c.acc))
+            cand.append(XMLElement("jerk", getattr(c, "jerk", 0.0)))
             cand.append(XMLElement("nh", c.nh))
             cand.append(XMLElement("snr", c.snr))
             cand.append(XMLElement("folded_snr", c.folded_snr))

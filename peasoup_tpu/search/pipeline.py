@@ -271,6 +271,9 @@ class SearchResult:
     timers: dict = field(default_factory=dict)
     config: SearchConfig | None = None
     header: object | None = None
+    # per-stage SNR budget of the injected signal when the config named
+    # an injection manifest (obs/injection.py, ISSUE 14); None otherwise
+    injection: dict | None = None
 
 
 class PulsarSearch:
@@ -1118,6 +1121,17 @@ class PulsarSearch:
         timers["folding"] = time.time() - t0
 
         cands = cands[: cfg.limit]
+        injection = None
+        if cfg.injection_manifest:
+            try:
+                injection = self._injection_budget(cands, cfg)
+            except Exception as exc:
+                # diagnostics must never kill a science run
+                warn_event(
+                    "injection_probe_failed",
+                    f"SNR budget probe failed: {exc}",
+                    manifest=cfg.injection_manifest,
+                )
         timers["total"] = time.time() - t_total
         # the run's device_duty_cycle (ISSUE 11): measured device/link
         # seconds over the span ledger since run() start, per
@@ -1136,7 +1150,170 @@ class PulsarSearch:
             timers=timers,
             config=cfg,
             header=hdr,
+            injection=injection,
         )
+
+    def _injection_budget(self, cands, cfg) -> dict:
+        """Per-stage SNR budget of an injected signal (ISSUE 14).
+
+        Re-runs the whitening/resample front half on the single trial
+        nearest the manifest's (DM, accel, jerk) — through the SAME
+        jitted ``whiten_trial`` / ``resample2`` / quantised-lattice code
+        the search used — then taps the injected spin's amplitude at
+        each stage, z-scored exactly like ``_spectra_peaks`` normalises
+        spectra:
+
+        * ``whiten``: exact single-frequency DFT of the resampled
+          whitened series at the manifest spin — the scalloping-free
+          matched ceiling everything downstream is measured against;
+        * ``fourier_bin``: plain ``|rfft|`` at the nearest bin — the
+          drop from ``whiten`` is pure interbin scalloping;
+        * ``interbin``: ``form_interpolated`` at that bin — what the
+          estimator wins back;
+        * ``harmonic``: each summed level's value at the fundamental's
+          stretched index (the reference's ``(i*m + 2^(k-1)) >> k``
+          read collapses to ``spec[k0*m]`` on the fundamental's exact
+          grid point), mismatch shows up as a sub-sqrt(2^k) gain;
+        * ``peak``: the strongest candidate the recovery matcher
+          accepts — the drop from ``harmonic_best`` is extraction /
+          distillation loss.
+
+        The u8/bf16 trial lattice is applied when resolved, so lattice
+        quantisation loss lands in every tap.  Returns the budget dict
+        attached to ``SearchResult.injection``; gauges + an
+        ``Injection-Probe`` span make it land in run_report.json and
+        the telemetry stream automatically.
+        """
+        import os
+
+        from ..obs.injection import load_manifest, match_candidates
+        from ..ops.resample import resample2
+
+        man = load_manifest(cfg.injection_manifest)
+        f0 = float(man["freq"])
+        tsamp = float(self.fil.tsamp)
+
+        # nearest trial coordinates on this search's grid
+        dm_idx = int(np.argmin(np.abs(self.dm_list - float(man["dm"]))))
+        dm = float(self.dm_list[dm_idx])
+        acc_list = np.asarray(self.acc_plan.generate_accel_list(dm))
+        acc = float(acc_list[int(np.argmin(
+            np.abs(acc_list - float(man["accel"]))))])
+        jerk_list = np.asarray(self.jerk_plan.jerk_list())
+        jerk = float(jerk_list[int(np.argmin(
+            np.abs(jerk_list - float(man["jerk"]))))])
+
+        # the injected file's data (batched drivers finalise per-beam
+        # configs against self.fil == beam 0; the manifest knows which
+        # file it describes)
+        fil = self.fil
+        path = man.get("path", "")
+        if path and os.path.exists(path):
+            try:
+                from ..io.sigproc import read_filterbank
+
+                probe_fil = read_filterbank(path)
+                if probe_fil.nchans == fil.nchans:
+                    fil = probe_fil
+            except Exception:
+                pass
+
+        # host dedispersion of the one matched DM row (same channel sum
+        # as ops.dedisperse), then the resolved trial lattice and the
+        # driver's pad/trim rule
+        dj = np.asarray(self.delays[dm_idx], dtype=np.int64)
+        out_n = min(self.out_nsamps, fil.nsamps - int(dj.max()))
+        data = np.asarray(fil.data)
+        row = np.zeros(out_n, dtype=np.float64)
+        for j in range(fil.nchans):
+            row += data[dj[j] : dj[j] + out_n, j].astype(np.float64)
+        row = np.asarray(
+            self._maybe_quantise(jnp.asarray(row[None, :], jnp.float32)),
+            dtype=np.float64)[0]
+        if out_n >= self.size:
+            tim = row[: self.size]
+        else:
+            tim = np.concatenate(
+                [row, np.full(self.size - out_n, row.mean())])
+
+        tim_w, mean, std = whiten_trial(
+            jnp.asarray(tim, jnp.float32),
+            jnp.asarray(self.birdies),
+            jnp.asarray(self.bwidths),
+            self.bin_width,
+            cfg.boundary_5_freq,
+            cfg.boundary_25_freq,
+            bool(len(self.birdies)),
+        )
+        tim_r = np.asarray(
+            resample2(tim_w, acc, tsamp, None, jerk), dtype=np.float64)
+        mean = float(mean)
+        std = float(std)
+        z = lambda a: round(float((a - mean) / std), 4)
+
+        # stage taps
+        t = np.arange(self.size, dtype=np.float64)
+        amp_exact = np.abs(np.sum(
+            tim_r * np.exp(-2j * np.pi * f0 * tsamp * t)))
+        fs = np.fft.rfft(tim_r)
+        k0 = int(round(f0 / self.bin_width))
+        k0 = min(max(k0, 1), len(fs) - 1)
+        amp_bin = np.abs(fs[k0])
+        amp_ib = np.sqrt(max(
+            np.abs(fs[k0]) ** 2, 0.5 * np.abs(fs[k0] - fs[k0 - 1]) ** 2))
+        spec = np.abs(fs)
+        spec[1:] = np.sqrt(np.maximum(
+            spec[1:] ** 2, 0.5 * np.abs(np.diff(fs)) ** 2))
+        spec = (spec - mean) / std
+        harmonics = []
+        for lvl in range(1, cfg.nharmonics + 1):
+            _, stop, _ = self.bounds[lvl]
+            if k0 * (1 << lvl) >= stop:
+                break  # fundamental's stretched index is unsearchable
+            folds = k0 * np.arange(1, (1 << lvl) + 1)
+            tap = spec[np.minimum(folds, len(spec) - 1)].sum() \
+                / np.sqrt(float(1 << lvl))
+            harmonics.append(round(float(tap), 4))
+        snr_whiten = z(amp_exact)
+        snr_bin = z(amp_bin)
+        snr_interbin = z(amp_ib)
+        harmonic_best = max([snr_interbin] + harmonics)
+
+        verdict = match_candidates(man, cands, tobs=self.tobs)
+        peak = round(float(verdict["best_snr"]), 4)
+        budget = {
+            "manifest": cfg.injection_manifest,
+            "freq": f0,
+            "bin": k0,
+            "lattice": getattr(self, "lattice", "f32"),
+            "trial": {"dm": dm, "dm_idx": dm_idx, "acc": acc,
+                      "jerk": jerk},
+            "snr": {
+                "whiten": snr_whiten,
+                "fourier_bin": snr_bin,
+                "interbin": snr_interbin,
+                "harmonic": harmonics,
+                "harmonic_best": harmonic_best,
+                "peak": peak,
+            },
+            "loss": {
+                "scalloping": round(snr_whiten - snr_bin, 4),
+                "interbin_residual": round(snr_whiten - snr_interbin, 4),
+                "harmonic": round(snr_interbin - harmonic_best, 4),
+                "extraction": round(harmonic_best - peak, 4),
+            },
+            "recovered": bool(verdict["recovered"]),
+            "n_matches": int(verdict["n_matches"]),
+        }
+        METRICS.gauge("injection.snr_whiten", snr_whiten)
+        METRICS.gauge("injection.snr_interbin", snr_interbin)
+        METRICS.gauge("injection.snr_peak", peak)
+        METRICS.gauge("injection.recovered", int(budget["recovered"]))
+        with span("Injection-Probe", freq=f0, dm=dm, acc=acc, jerk=jerk,
+                  snr_whiten=snr_whiten, snr_interbin=snr_interbin,
+                  snr_peak=peak, recovered=budget["recovered"]):
+            pass
+        return budget
 
 
 # --------------------------------------------------------------------------

@@ -174,6 +174,12 @@ class SearchConfig:
     # hosts' spans into the one file process 0 writes.  Empty =
     # <outdir>/trace.json (CLI default)
     trace_json: str = ""
+    # injection-manifest path (obs/injection.py, ISSUE 14): when set,
+    # the drivers run the per-stage SNR budget probe against the
+    # manifest's known signal and attach the budget to the result /
+    # run_report.json.  Diagnostics-only — never part of the search
+    # identity key, never changes the candidate list
+    injection_manifest: str = ""
 
     # -- geometry accessors (the cost model reads these; keeping them
     # -- here means plan-derived figures have exactly one definition)

@@ -663,7 +663,12 @@ def update_lattice(path: str, device_kind: str, stage: str, nsamps: int,
     (stage, geometry bucket).  ``parity``: {dtype: {"ok": bool,
     "max_snr_delta": float, "candidates_moved": int}} — the parity
     harness's verdict vs the f32 reference; ``resolve_trial_lattice``
-    refuses any auto pick whose parity entry is missing or not ok."""
+    refuses any auto pick whose parity entry is missing or not ok.
+    A verdict may additionally carry ``"recovery_delta"`` (the
+    sensitivity sweep's injected-pulsar recovery_fraction under this
+    lattice minus the f32 reference's — see ``tools/sensitivity.py
+    run_lattice_sweep``); it is copied through verbatim so the sidecar
+    records not just "no candidate moved" but "no sensitivity lost"."""
     if not path:
         return
     try:
@@ -696,6 +701,9 @@ def update_lattice(path: str, device_kind: str, stage: str, nsamps: int,
                         "candidates_moved": int(
                             verdict.get("candidates_moved", 0)),
                     }
+                    if "recovery_delta" in verdict:
+                        pcell[d]["recovery_delta"] = float(
+                            verdict["recovery_delta"])
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(obj, f)

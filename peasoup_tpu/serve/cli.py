@@ -89,6 +89,12 @@ def build_parser() -> argparse.ArgumentParser:
                     default=[], metavar="KEY=VALUE",
                     help="SearchConfig override (repeatable), e.g. "
                          "--set dm_end=120 --set npdmp=8")
+    ps.add_argument("--canary", default=None, metavar="MANIFEST.json",
+                    help="submit as a known-answer canary: the "
+                         "injection manifest (obs/injection.py) rides "
+                         "the job, the worker matches the result "
+                         "against it, and the store tags its "
+                         "candidates out of science queries")
 
     pw = sub.add_parser("worker", help="claim and run jobs")
     _add_worker_args(pw)
@@ -231,10 +237,21 @@ def _add_worker_args(pw) -> None:
 
 def cmd_submit(spool, args) -> int:
     overrides = dict(_parse_override(o) for o in args.overrides)
+    canary = None
+    if getattr(args, "canary", None):
+        from ..obs.injection import load_manifest
+
+        canary = load_manifest(args.canary)
+        # the worker's search also runs the per-stage SNR budget probe
+        # against the same manifest (search/pipeline.py)
+        overrides.setdefault("injection_manifest",
+                             os.path.abspath(args.canary))
     for path in args.inputs:
-        rec = spool.submit(path, overrides, priority=args.priority)
+        rec = spool.submit(path, overrides, priority=args.priority,
+                           canary=canary)
+        tag = "  canary" if canary else ""
         print(f"submitted {rec.job_id}  priority={rec.priority}  "
-              f"{rec.input}")
+              f"{rec.input}{tag}")
     return 0
 
 

@@ -117,7 +117,7 @@ class HealthContext:
     latest: dict[str, dict]       # newest sample per host
     queue: dict[str, int]         # spool state counts
     running: list[dict]           # [{"job_id", "host"}] lease holders
-    ledger: list[dict]            # kind:"serve"/"loadgen" history recs
+    ledger: list[dict]            # serve/loadgen/sensitivity history recs
     window_s: float = DEFAULT_WINDOW_S
     stale_after: float = DEFAULT_STALE_AFTER
     slo: dict = field(default_factory=lambda: dict(DEFAULT_SLO))
@@ -158,7 +158,7 @@ def build_context(spool: JobSpool, *, ts_dir: str | None = None,
         queue=spool.counts(),
         running=running,
         ledger=load_history(ledger_path or default_ledger_path(),
-                            kinds=("serve", "loadgen")),
+                            kinds=("serve", "loadgen", "sensitivity")),
         window_s=float(window_s),
         stale_after=float(stale_after),
         slo=targets,
@@ -504,6 +504,75 @@ def rule_loadgen_saturation(ctx: HealthContext) -> list[HealthFinding]:
         "loadgen_saturation", OK,
         f"arrival rate {rate:.3f}/s within the measured knee "
         f"({knee:.3f}/s)", data=data)]
+
+
+@health_rule
+def rule_canary_recovery(ctx: HealthContext) -> list[HealthFinding]:
+    """Known-answer canary jobs (ISSUE 14): a missed canary means the
+    pipeline is NOT recovering a signal it is known to contain — a
+    sensitivity outage no throughput metric can see.
+
+    The verdict keys off the NEWEST telemetry sample that carries any
+    canary counter delta, so one missed canary goes crit and STAYS
+    crit until a later drain recovers a canary again (the operator's
+    clean re-run produces a newer recovered-only sample and the fleet
+    reports healthy).  Secondary check: the window's live recovery
+    fraction against the ledger median of ``kind:"sensitivity"``
+    sweeps — a soft regression warns before canaries start missing
+    outright.  No canary traffic at all is ok, not unknown-unhealthy
+    (canaries are opt-in via ``submit --canary`` / loadgen
+    ``canary_fraction``).
+    """
+    last = None
+    for s in ctx.samples:  # ts-sorted; last hit wins
+        counters = s.get("counters", {})
+        rec = int(counters.get("canary.recovered", 0))
+        mis = int(counters.get("canary.missed", 0))
+        if rec + mis > 0:
+            last = {"ts": float(s.get("ts", 0.0)), "recovered": rec,
+                    "missed": mis, "host": str(s.get("host", ""))}
+    if last is None:
+        return [HealthFinding(
+            "canary_recovery", OK,
+            "no canary activity in the telemetry (submit known-answer "
+            "jobs with 'submit --canary' to probe sensitivity)",
+            data={"canaries": 0})]
+    if last["missed"] > 0:
+        return [HealthFinding(
+            "canary_recovery", CRIT,
+            f"latest canary drain MISSED {last['missed']} injected "
+            f"pulsar(s) (recovered {last['recovered']}) — the search "
+            f"is not finding signals it is known to contain",
+            host=last["host"], data=last)]
+    recovered = _recent_counter(ctx, "canary.recovered")
+    missed = _recent_counter(ctx, "canary.missed")
+    total = recovered + missed
+    fraction = recovered / total if total else 1.0
+    data = dict(last)
+    data.update({"window_recovered": recovered,
+                 "window_missed": missed,
+                 "window_recovery_fraction": round(fraction, 4)})
+    baseline_vals = sorted(
+        float(r.get("metrics", {}).get("recovery_fraction", -1.0))
+        for r in ctx.ledger
+        if r.get("kind") == "sensitivity"
+        and r.get("metrics", {}).get("recovery_fraction", -1.0) >= 0)
+    if len(baseline_vals) >= 3 and total > 0:
+        mid = len(baseline_vals) // 2
+        median = (baseline_vals[mid] if len(baseline_vals) % 2
+                  else 0.5 * (baseline_vals[mid - 1]
+                              + baseline_vals[mid]))
+        data["median_recovery_fraction"] = round(median, 4)
+        if fraction < 0.8 * median:
+            return [HealthFinding(
+                "canary_recovery", WARN,
+                f"window canary recovery {fraction:.2f} below 80% of "
+                f"the sensitivity-sweep ledger median ({median:.2f}) "
+                f"— sensitivity regressing", data=data)]
+    return [HealthFinding(
+        "canary_recovery", OK,
+        f"latest canary drain recovered {last['recovered']} "
+        f"injected pulsar(s), none missed", data=data)]
 
 
 # -- SLO summary -----------------------------------------------------------

@@ -93,6 +93,11 @@ class JobRecord:
     queue_wait_s: float = 0.0
     #: success summary (candidate counts, outdir) set by mark_done
     summary: dict = field(default_factory=dict)
+    #: injection manifest for canary jobs (obs/injection.py, ISSUE 14):
+    #: a known synthetic pulsar the worker must recover on completion.
+    #: Empty dict = a normal science job; pre-canary records load
+    #: unchanged through from_obj's known-field filter
+    canary: dict = field(default_factory=dict)
     v: int = _RECORD_VERSION
 
     def to_json(self) -> str:
@@ -182,13 +187,20 @@ class JobSpool:
     # -- submit / claim ----------------------------------------------------
 
     def submit(self, input_path: str, overrides: dict | None = None,
-               priority: int = 0) -> JobRecord:
-        """Enqueue one observation; returns the pending record."""
+               priority: int = 0,
+               canary: dict | None = None) -> JobRecord:
+        """Enqueue one observation; returns the pending record.
+
+        ``canary``: injection manifest dict for a known-answer canary
+        job — the worker matches the result against it on completion
+        and the store tags its candidates out of science queries.
+        """
         rec = JobRecord(
             job_id=_new_job_id(),
             input=os.path.abspath(input_path),
             priority=int(priority),
             overrides=dict(overrides or {}),
+            canary=dict(canary or {}),
             submitted_utc=time.time(),
         )
         self._write(self._path("pending", rec.job_id), rec)

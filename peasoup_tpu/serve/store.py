@@ -20,7 +20,10 @@ Record schema (``v`` = 1; consumers tolerate additions)::
     job_id     str    spool job that produced the record
     source     str    input filterbank path (the observation)
     utc        float  ingest time (unix seconds)
-    dm, acc, freq, snr, folded_snr, nh, period   candidate fields
+    dm, acc, jerk, freq, snr, folded_snr, nh, period  candidate fields
+    canary     bool   present (true) only on canary-job records
+                      (obs/injection.py, ISSUE 14) — excluded from
+                      every science read unless ``include_canary=True``
 
 Store I/O follows the ledger rules (obs/history.py): appends are one
 atomic line write; corrupt/torn lines are skipped on load so a killed
@@ -63,9 +66,12 @@ def safe_label(label: str) -> str:
 
 
 def _iter_records(path: str, source: str | None = None,
-                  min_snr: float | None = None):
+                  min_snr: float | None = None,
+                  include_canary: bool = False):
     """Yield one file's records in file order; corrupt/torn lines and
-    a missing file are skipped (ledger rules)."""
+    a missing file are skipped (ledger rules).  Canary-job records are
+    skipped unless ``include_canary`` — known-answer probes must never
+    pollute science reads."""
     if not os.path.exists(path):
         return
     with open(path, encoding="utf-8") as f:
@@ -79,6 +85,8 @@ def _iter_records(path: str, source: str | None = None,
                 continue  # torn tail from a killed worker
             if not isinstance(rec, dict) or "freq" not in rec:
                 continue
+            if rec.get("canary") and not include_canary:
+                continue
             if source is not None and rec.get("source") != source:
                 continue
             if min_snr is not None and \
@@ -88,20 +96,26 @@ def _iter_records(path: str, source: str | None = None,
 
 
 def _record_from_candidate(job_id: str, source: str, cand,
-                           utc: float) -> dict:
-    return {
+                           utc: float, canary: bool = False) -> dict:
+    rec = {
         "v": STORE_VERSION,
         "job_id": str(job_id),
         "source": str(source),
         "utc": round(float(utc), 3),
         "dm": round(float(cand.dm), 6),
         "acc": round(float(cand.acc), 6),
+        "jerk": round(float(getattr(cand, "jerk", 0.0)), 6),
         "freq": float(cand.freq),
         "snr": round(float(cand.snr), 4),
         "folded_snr": round(float(cand.folded_snr), 4),
         "nh": int(cand.nh),
         "period": (1.0 / float(cand.freq)) if cand.freq else 0.0,
     }
+    if canary:
+        # tag-only-when-true keeps science records byte-identical to
+        # the pre-canary schema
+        rec["canary"] = True
+    return rec
 
 
 class CandidateStore:
@@ -113,11 +127,14 @@ class CandidateStore:
     # -- ingest ------------------------------------------------------------
 
     def ingest(self, job_id: str, source: str, candidates,
-               utc: float | None = None) -> int:
-        """Append one job's distilled candidates; returns the count."""
+               utc: float | None = None, canary: bool = False) -> int:
+        """Append one job's distilled candidates; returns the count.
+
+        ``canary=True`` tags every record so the default read side
+        excludes them from science queries and coincidence."""
         utc = time.time() if utc is None else utc
         recs = [
-            _record_from_candidate(job_id, source, c, utc)
+            _record_from_candidate(job_id, source, c, utc, canary)
             for c in candidates
         ]
         if not recs:
@@ -133,9 +150,13 @@ class CandidateStore:
     # -- load / filter -----------------------------------------------------
 
     def records(self, source: str | None = None,
-                min_snr: float | None = None) -> list[dict]:
-        """All records in file order; corrupt lines skipped."""
-        return list(_iter_records(self.path, source, min_snr))
+                min_snr: float | None = None,
+                include_canary: bool = False) -> list[dict]:
+        """All SCIENCE records in file order; corrupt lines skipped.
+        ``include_canary=True`` adds the canary-tagged records (the
+        canary drain's own bookkeeping reads)."""
+        return list(_iter_records(self.path, source, min_snr,
+                                  include_canary))
 
     def count(self) -> int:
         return len(self.records())
@@ -241,10 +262,12 @@ class ShardedCandidateStore(CandidateStore):
         return shards
 
     def records(self, source: str | None = None,
-                min_snr: float | None = None) -> list[dict]:
+                min_snr: float | None = None,
+                include_canary: bool = False) -> list[dict]:
         out: list[dict] = []
         for path in self.shard_files():
-            out.extend(_iter_records(path, source, min_snr))
+            out.extend(_iter_records(path, source, min_snr,
+                                     include_canary))
         return out
 
     def shard_counts(self) -> dict[str, int]:

@@ -509,7 +509,8 @@ class SurveyWorker:
                     with span("Store-Ingest", metric="store_ingest",
                               job_id=job.job_id):
                         ingested = self.store.ingest(
-                            job.job_id, job.input, result.candidates)
+                            job.job_id, job.input, result.candidates,
+                            canary=bool(job.canary))
                     best = max((float(c.snr)
                                 for c in result.candidates), default=0.0)
                     summary = {
@@ -521,6 +522,9 @@ class SurveyWorker:
                         "timers": {k: round(float(v), 3)
                                    for k, v in result.timers.items()},
                     }
+                    if job.canary:
+                        summary["canary"] = self._check_canary(job,
+                                                               result)
                 except Exception as exc:
                     self._handle_failure(job, exc)
                     continue
@@ -568,10 +572,11 @@ class SurveyWorker:
         with span("Store-Ingest", metric="store_ingest",
                   job_id=job.job_id):
             ingested = self.store.ingest(
-                job.job_id, job.input, result.candidates)
+                job.job_id, job.input, result.candidates,
+                canary=bool(job.canary))
         best = max((float(c.snr) for c in result.candidates),
                    default=0.0)
-        return {
+        summary = {
             "candidates": len(result.candidates),
             "ingested": ingested,
             "best_snr": round(best, 4),
@@ -579,6 +584,49 @@ class SurveyWorker:
             "timers": {k: round(float(v), 3)
                        for k, v in result.timers.items()},
         }
+        if job.canary:
+            summary["canary"] = self._check_canary(job, result)
+        return summary
+
+    def _check_canary(self, job: JobRecord, result) -> dict:
+        """Match a completed canary job against its injection manifest
+        (obs/injection.py, ISSUE 14).
+
+        The serving stack's known-answer probe: counters + a
+        ``canary_missed`` event feed the telemetry stream and the
+        ``canary_recovery`` health rule, and the verdict rides the job
+        summary into the ``done/`` record and the serve ledger.
+        Matching failures count as misses — a canary that cannot be
+        checked is a canary that did not come back.
+        """
+        from ..obs.injection import match_candidates
+
+        man = job.canary
+        try:
+            verdict = match_candidates(man, result.candidates)
+            out = {
+                "recovered": bool(verdict["recovered"]),
+                "best_snr": round(float(verdict["best_snr"]), 4),
+                "n_matches": int(verdict["n_matches"]),
+                "freq": man.get("freq"),
+                "target_snr": man.get("target_snr"),
+            }
+        except Exception as exc:
+            out = {"recovered": False, "best_snr": 0.0, "n_matches": 0,
+                   "error": str(exc)}
+        if out["recovered"]:
+            METRICS.inc("canary.recovered")
+        else:
+            METRICS.inc("canary.missed")
+            warn_event(
+                "canary_missed",
+                f"canary job {job.job_id} did not recover its "
+                f"injected pulsar (freq {man.get('freq')}, target SNR "
+                f"{man.get('target_snr')})",
+                job_id=job.job_id, freq=man.get("freq"),
+                target_snr=man.get("target_snr"),
+            )
+        return out
 
     def _capture_failure_report(self, job: JobRecord) -> str:
         """Snapshot the run's telemetry (stage timers, counters,
@@ -826,6 +874,13 @@ class SurveyWorker:
                 "timeline_marks": int(tl.get("marks", 0)),
                 "timeline_overhead_s": float(
                     tl.get("overhead_s", 0.0)),
+                # sensitivity observatory (ISSUE 14): known-answer
+                # canary jobs this drain checked; the canary_recovery
+                # health rule goes crit on a missed one
+                "canary_recovered": int(
+                    counters.get("canary.recovered", 0)),
+                "canary_missed": int(
+                    counters.get("canary.missed", 0)),
             },
             stage_device_s=stage_device_seconds(snap),
             config={

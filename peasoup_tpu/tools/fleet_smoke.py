@@ -39,8 +39,6 @@ import sys
 import time
 import warnings
 
-import numpy as np
-
 #: fast-search overrides shared by every smoke job
 FAST = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0, "limit": 10}
 
@@ -50,22 +48,13 @@ def _write_synthetic(path: str, nsamps: int = 4096, nchans: int = 16,
     """A small 8-bit filterbank with a pulse train (the SAME period in
     every observation, so the survey coincidencer has a cross-source
     signal to find); ``truncate_bytes`` chops the data section short
-    of what the header declares."""
-    from peasoup_tpu.io.sigproc import (
-        SigprocHeader, write_sigproc_header,
-    )
+    of what the header declares.  Thin wrapper over the injection
+    synthesizer's shared smoke recipe (byte-identical to the
+    historical private helper)."""
+    from peasoup_tpu.obs.injection import smoke_observation
 
-    rng = np.random.default_rng(seed)
-    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
-    data[::16] += 60
-    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
-                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
-    with open(path, "wb") as f:
-        write_sigproc_header(f, hdr, include_nsamples=True)
-        payload = data.tobytes()
-        if truncate_bytes:
-            payload = payload[:-truncate_bytes]
-        f.write(payload)
+    smoke_observation(path, nsamps=nsamps, nchans=nchans, seed=seed,
+                      truncate_bytes=truncate_bytes)
     return path
 
 

@@ -39,10 +39,6 @@ import os
 import shutil
 import time
 
-import numpy as np
-
-SPEED_OF_LIGHT = 299792458.0
-
 #: synthetic observation geometry (small enough for a CPU smoke, long
 #: enough that the injected jerk smears the pulse by tens of samples).
 #: SIZE is the search's fft length — the cubic ramp is pinned to it so
@@ -59,37 +55,21 @@ DUTY = 0.06
 MIN_SNR = 7.0
 
 
-def _pulse_value(phase_idx: np.ndarray) -> np.ndarray:
-    """Rest-frame pulse-train value at fractional sample index."""
-    phase = np.mod(phase_idx * TSAMP * F0, 1.0)
-    return (phase < DUTY).astype(np.float64)
-
-
 def _write_synthetic(path: str, jerk: float = 0.0,
                      seed: int = 0) -> str:
     """An 8-bit filterbank carrying a DM-0 pulse train smeared by
     ``jerk``: observed sample m holds the rest-frame signal at
     ``m - shift(m)`` where shift is resample2's cubic index ramp
     ``m*jf*(m-n)*(m+n)`` — so the search's matching (0, jerk) trial
-    de-smears it exactly, and no quadratic accel trial can."""
-    from peasoup_tpu.io.sigproc import (
-        SigprocHeader, write_sigproc_header,
-    )
+    de-smears it exactly, and no quadratic accel trial can.  Thin
+    wrapper over the injection synthesizer (byte-identical to the
+    historical private recipe — ``size=SIZE`` pins the cubic ramp to
+    the search's fft length)."""
+    from peasoup_tpu.obs.injection import synthesize
 
-    rng = np.random.default_rng(seed)
-    m = np.arange(NSAMPS, dtype=np.float64)
-    jf = jerk * TSAMP * TSAMP / (6.0 * SPEED_OF_LIGHT)
-    shift = m * jf * (m - SIZE) * (m + SIZE)
-    tim = _pulse_value(m - shift)
-    data = rng.integers(0, 24, size=(NSAMPS, NCHANS), dtype=np.uint8)
-    data = np.minimum(
-        data + (tim[:, None] * PULSE_AMP).astype(np.uint8), 255
-    ).astype(np.uint8)
-    hdr = SigprocHeader(nbits=8, nchans=NCHANS, tsamp=TSAMP,
-                        fch1=1510.0, foff=-10.0, nsamples=NSAMPS)
-    with open(path, "wb") as f:
-        write_sigproc_header(f, hdr, include_nsamples=True)
-        f.write(data.tobytes())
+    synthesize(path, freq=F0, jerk=jerk, duty=DUTY, amp=PULSE_AMP,
+               noise_max=24, nsamps=NSAMPS, nchans=NCHANS, tsamp=TSAMP,
+               seed=seed, size=SIZE)
     return path
 
 

@@ -83,20 +83,29 @@ def arrival_offsets(rate_per_s: float, n: int, rng) -> list[float]:
 
 
 def job_mix(n: int, rng, *, buckets=(4096,), priorities=(0,),
-            poison_fraction: float = 0.0) -> list[dict]:
+            poison_fraction: float = 0.0,
+            canary_fraction: float = 0.0) -> list[dict]:
     """``n`` deterministic job specs: geometry bucket (sample count),
-    priority tier, per-job data seed, and which jobs are poisoned
-    (truncated mid-data -> typed quarantine at the worker)."""
+    priority tier, per-job data seed, which jobs are poisoned
+    (truncated mid-data -> typed quarantine at the worker), and which
+    are canaries (known-answer injections, ISSUE 14 — disjoint from
+    the poison set: a truncated canary could never be recovered)."""
     n = int(n)
     n_poison = min(n, int(round(float(poison_fraction) * n)))
     poison = (set(rng.choice(n, size=n_poison, replace=False).tolist())
               if n_poison else set())
+    clean = np.array([i for i in range(n) if i not in poison])
+    n_canary = min(len(clean), int(round(float(canary_fraction) * n)))
+    canary = (set(rng.choice(clean, size=n_canary,
+                             replace=False).tolist())
+              if n_canary else set())
     return [{
         "i": i,
         "nsamps": int(buckets[int(rng.integers(0, len(buckets)))]),
         "priority": int(priorities[int(rng.integers(0,
                                                     len(priorities)))]),
         "poison": i in poison,
+        "canary": i in canary,
         "seed": int(rng.integers(0, 2**31 - 1)),
     } for i in range(n)]
 
@@ -105,12 +114,26 @@ def write_observations(specs: list[dict], obs_dir: str) -> list[dict]:
     """Materialise each spec as a real filterbank (poisoned specs are
     truncated 1 KiB short of their header's promise); sets
     ``spec["path"]``."""
+    from ..obs.injection import save_manifest, smoke_observation
+
     os.makedirs(obs_dir, exist_ok=True)
     for spec in specs:
-        spec["path"] = _write_synthetic(
-            os.path.join(obs_dir, f"obs-{spec['i']:04d}.fil"),
-            nsamps=spec["nsamps"], seed=spec["seed"] % (2**16),
-            truncate_bytes=1024 if spec["poison"] else 0)
+        path = os.path.join(obs_dir, f"obs-{spec['i']:04d}.fil")
+        if spec.get("canary"):
+            # canary inputs ARE injections: keep the manifest so the
+            # worker can match candidates against the known answer
+            manifest = smoke_observation(
+                path, nsamps=spec["nsamps"],
+                seed=spec["seed"] % (2**16))
+            spec["canary_manifest"] = manifest
+            spec["manifest_path"] = save_manifest(
+                manifest, path + ".manifest.json")
+            spec["path"] = path
+        else:
+            spec["path"] = _write_synthetic(
+                path, nsamps=spec["nsamps"],
+                seed=spec["seed"] % (2**16),
+                truncate_bytes=1024 if spec["poison"] else 0)
     return specs
 
 
@@ -129,8 +152,13 @@ def submit_burst(spool, specs: list[dict], offsets: list[float],
         delay = t0 + off - clock()
         if delay > 0:
             pause(delay, sleeper)
-        recs.append(spool.submit(spec["path"], dict(overrides or {}),
-                                 priority=spec["priority"]))
+        ov = dict(overrides or {})
+        if spec.get("canary_manifest"):
+            if spec.get("manifest_path"):
+                ov["injection_manifest"] = spec["manifest_path"]
+        recs.append(spool.submit(spec["path"], ov,
+                                 priority=spec["priority"],
+                                 canary=spec.get("canary_manifest")))
     return recs
 
 
@@ -196,6 +224,10 @@ def _point_stats(spool, *, offered_rate: float, n_jobs: int,
                 marks += int(delta.get("count", 0))
             elif name != "job":  # job would double-count its stages
                 device_s += float(delta.get("device_s", 0.0))
+    canary_rec = sum(int(s.get("counters", {}).get(
+        "canary.recovered", 0)) for s in samples)
+    canary_mis = sum(int(s.get("counters", {}).get(
+        "canary.missed", 0)) for s in samples)
     achieved = len(done) / elapsed_s if elapsed_s > 0 else 0.0
     # the schedule's EMPIRICAL rate: with small n the sampled
     # exponential gaps can realize a window far from nominal, so knee
@@ -228,6 +260,7 @@ def _point_stats(spool, *, offered_rate: float, n_jobs: int,
             "sojourn_p50_s": round(percentile(q_sojourns, 0.50), 6),
             "sojourn_p95_s": round(percentile(q_sojourns, 0.95), 6),
         },
+        "canary": {"recovered": canary_rec, "missed": canary_mis},
         "queue_depth": queue_depth,
         "device_duty_cycle": round(device_s / elapsed_s, 6)
         if elapsed_s > 0 else 0.0,
@@ -431,7 +464,8 @@ def append_loadgen_record(doc: dict, history: str | None) -> dict:
 
 def sweep(dirpath: str, rates: list[float], jobs: int, *,
           workers: int = 2, seed: int = 0,
-          poison_fractions=None, buckets=(4096,), priorities=(0,),
+          poison_fractions=None, canary_fraction: float = 0.0,
+          buckets=(4096,), priorities=(0,),
           overrides: dict | None = None, history: str | None = None,
           timeout_s: float = 900.0, inprocess: bool = False,
           service_s: float = 0.03, verbose: bool = True) -> dict:
@@ -462,7 +496,8 @@ def sweep(dirpath: str, rates: list[float], jobs: int, *,
             specs = write_observations(
                 job_mix(jobs, rng, buckets=buckets,
                         priorities=priorities,
-                        poison_fraction=poison_fractions[i]),
+                        poison_fraction=poison_fractions[i],
+                        canary_fraction=canary_fraction),
                 os.path.join(point_dir, "obs"))
             point = run_rate_point(
                 point_dir, rate, specs, workers=workers,
@@ -498,6 +533,7 @@ def sweep(dirpath: str, rates: list[float], jobs: int, *,
             "buckets": list(buckets),
             "priorities": list(priorities),
             "poison_fractions": [float(f) for f in poison_fractions],
+            "canary_fraction": float(canary_fraction),
             **({"service_s": service_s} if inprocess else {}),
         },
     }
@@ -628,6 +664,10 @@ def main(argv=None) -> int:
     p.add_argument("--poison-fraction", type=float, default=0.0,
                    help="fraction of each point's jobs truncated "
                         "mid-data (quarantine path)")
+    p.add_argument("--canary-fraction", type=float, default=0.0,
+                   help="fraction of each point's jobs carrying a "
+                        "known-answer injection manifest (canary "
+                        "recovery under load, ISSUE 14)")
     p.add_argument("--buckets", default="4096",
                    help="comma-separated geometry buckets (nsamps)")
     p.add_argument("--priorities", default="0",
@@ -655,6 +695,7 @@ def main(argv=None) -> int:
     doc = sweep(
         args.dir, rates, args.jobs, workers=args.workers,
         seed=args.seed, poison_fractions=args.poison_fraction,
+        canary_fraction=args.canary_fraction,
         buckets=tuple(int(b) for b in args.buckets.split(",")),
         priorities=tuple(int(x) for x in args.priorities.split(",")),
         history=args.history, timeout_s=args.timeout,

@@ -44,11 +44,13 @@ GATE_METRIC = "e2e_s"
 #: peak-extraction share and the pooled search-stage device seconds
 #: (bench.py's ``peaks_device_s`` / ``search_device_s`` metrics) are
 #: gated too, as is the jerk bench's per-trial cost
-#: (``jerk_s_per_ktrial``, from ``kind:"jerk"`` records — ISSUE 13).
-#: A metric with fewer than 2 records passes vacuously — ledgers
-#: predating a metric stay green.
+#: (``jerk_s_per_ktrial``, from ``kind:"jerk"`` records — ISSUE 13),
+#: and the sensitivity sweep's ``recovery_fraction`` (from
+#: ``kind:"sensitivity"`` records — ISSUE 14; higher is better, see
+#: below).  A metric with fewer than 2 records passes vacuously —
+#: ledgers predating a metric stay green.
 STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
-                      "jerk_s_per_ktrial")
+                      "jerk_s_per_ktrial", "recovery_fraction")
 
 #: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
 #: device seconds per wall second — a drop means the dispatch pipeline
@@ -56,7 +58,8 @@ STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
 #: they are not gated by default (CPU smoke figures are noise) but
 #: ``--stage-metrics device_duty_cycle`` gates them correctly.
 HIGHER_IS_BETTER_METRICS = ("device_duty_cycle", "vs_baseline",
-                            "jobs_per_hour", "knee_throughput_per_s")
+                            "jobs_per_hour", "knee_throughput_per_s",
+                            "recovery_fraction")
 
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 
@@ -295,6 +298,49 @@ def jerk_table(ledger: str | None = None, limit: int = 12) -> str:
     return "\n".join(lines)
 
 
+def sensitivity_table(ledger: str | None = None,
+                      limit: int = 12) -> str:
+    """Sensitivity-sweep history (``kind:"sensitivity"`` ledger
+    records — ISSUE 14): recovery fraction and min detectable SNR per
+    sweep, with the newest sweep's injected->recovered transfer curve,
+    so "is the pipeline still finding the pulsars we plant" is
+    trendable from the default report view."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("sensitivity",))
+    if not records:
+        return ""
+    lines = [f"sensitivity sweeps ({len(records)} record(s); "
+             f"newest last):",
+             f"  {'ts':<20}{'cells':>6}{'recov':>6}{'fraction':>9}"
+             f"{'min_snr':>8}{'sweep_s':>8}"]
+    for rec in records[-limit:]:
+        m = rec.get("metrics", {})
+        min_snr = m.get("min_detectable_snr")
+        lines.append(
+            f"  {str(rec.get('ts', ''))[:19]:<20}"
+            f"{int(m.get('cells', 0)):>6}"
+            f"{int(m.get('recovered', 0)):>6}"
+            f"{float(m.get('recovery_fraction', 0.0)):>9.3g}"
+            + (f"{float(min_snr):>8.3g}" if min_snr is not None
+               else f"{'-':>8}")
+            + f"{float(m.get('sweep_elapsed_s', 0.0)):>8.3g}")
+    transfer = records[-1].get("transfer") or []
+    for row in transfer:
+        lines.append(
+            f"    snr_in {float(row.get('snr_in', 0.0)):>6.3g}  -> "
+            f"recovered {int(row.get('recovered', 0))}/"
+            f"{int(row.get('cells', 0))}"
+            f"  snr_out_mean {float(row.get('snr_out_mean', 0.0)):.4g}")
+    vals = [float(r["metrics"]["recovery_fraction"]) for r in records
+            if isinstance(r.get("metrics", {}).get("recovery_fraction"),
+                          (int, float))]
+    if vals:
+        lines.append(f"  recovery trend: {sparkline(vals)}  "
+                     f"(median {_median(vals):.4g}, last "
+                     f"{vals[-1]:.4g})")
+    return "\n".join(lines)
+
+
 def stage_table(records: list[dict]) -> str:
     """Trailing per-stage device-time and utilization figures (from the
     newest record that carries them)."""
@@ -420,16 +466,17 @@ def main(argv=None) -> int:
             m.strip() for m in (args.stage_metrics or "").split(",")
             if m.strip() and m.strip() != args.metric
         ]
-        # the jerk bench's metrics live in kind="jerk" records; widen
-        # the gate's view so jerk_s_per_ktrial is judged against its
-        # own history (metric_series keys never collide across kinds —
-        # absent metrics still pass vacuously)
+        # the jerk bench's metrics live in kind="jerk" records and the
+        # sensitivity sweep's in kind="sensitivity"; widen the gate's
+        # view so jerk_s_per_ktrial / recovery_fraction are judged
+        # against their own history (metric_series keys never collide
+        # across kinds — absent metrics still pass vacuously)
         gate_records = records
         if args.kind == "bench":
             try:
                 gate_records = records + load_history(
                     args.ledger or default_ledger_path(),
-                    kinds=("jerk",))
+                    kinds=("jerk", "sensitivity"))
             except OSError:
                 pass
         codes, msgs = [], []
@@ -478,6 +525,10 @@ def main(argv=None) -> int:
         if jt:
             print()
             print(jt)
+        sn = sensitivity_table(args.ledger)
+        if sn:
+            print()
+            print(sn)
     if gate_msg:
         print()
         print(gate_msg)

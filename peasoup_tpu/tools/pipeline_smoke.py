@@ -30,25 +30,16 @@ import os
 import shutil
 import sys
 
-import numpy as np
-
 
 def _write_synthetic(path: str, nsamps: int = 4096, nchans: int = 16,
                      seed: int = 0) -> str:
     """A small 8-bit filterbank with a pulse train (same recipe as
-    batch_smoke so the smokes exercise identical observations)."""
-    from peasoup_tpu.io.sigproc import (
-        SigprocHeader, write_sigproc_header,
-    )
+    batch_smoke so the smokes exercise identical observations).  Thin
+    wrapper over the injection synthesizer's shared smoke recipe
+    (byte-identical to the historical private helper)."""
+    from peasoup_tpu.obs.injection import smoke_observation
 
-    rng = np.random.default_rng(seed)
-    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
-    data[::16] += 60
-    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
-                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
-    with open(path, "wb") as f:
-        write_sigproc_header(f, hdr, include_nsamples=True)
-        f.write(data.tobytes())
+    smoke_observation(path, nsamps=nsamps, nchans=nchans, seed=seed)
     return path
 
 

@@ -84,6 +84,7 @@ class CandidatePlotter:
             ("Opt P0", "%.9f" % s["opt_period"]),
             ("DM", "%.2f" % s["dm"]),
             ("Acc", "%.2f" % s["acc"]),
+            ("Jerk", "%.2f" % s["jerk"]),
             ("Harmonic", "%d" % s["nh"]),
             ("Spec S/N", "%.1f" % s["snr"]),
             ("Fold S/N", "%.1f" % s["folded_snr"]),

@@ -1,0 +1,523 @@
+"""Sensitivity sweep + canary smoke (``make sensitivity-smoke``).
+
+The judging half of the sensitivity observatory (ISSUE 14).
+``obs/injection.py`` supplies ground truth — synthetic pulsars with
+serialisable manifests and a recovery matcher — and this tool turns it
+into numbers an operator can gate on:
+
+* :func:`run_sweep` — a grid of injected SNR x period x accel, each
+  cell a real :class:`MeshPulsarSearch` over a fresh injection with the
+  per-stage SNR budget probe attached (``injection_manifest`` on the
+  search config), reduced to a **recovery fraction**, SNR-in vs
+  SNR-out **transfer curves**, and the **minimum detectable SNR** (the
+  lowest injected SNR still recovered in at least half its cells).
+  Results land in ``sensitivity_report.json`` and ONE
+  ``kind:"sensitivity"`` record in the bench history ledger — the
+  baseline the ``canary_recovery`` health rule and
+  ``tools/perf_report.py`` read.
+
+* :func:`run_lattice_sweep` — the same sweep repeated under each
+  forced trial-lattice dtype; each dtype's ``recovery_delta`` (its
+  recovery fraction minus f32's) rides the parity verdict into the
+  tuner sidecar via ``search/tuning.py:update_lattice``, so ``auto``
+  lattice resolution is informed by *sensitivity*, not just speed.
+
+* ``--smoke`` — the CI gate: three injections at descending SNR (the
+  faintest deliberately sub-threshold) must come back as two
+  recoveries + one reported miss with the per-stage budget table
+  rendered; then a real ``worker --drain`` subprocess recovers a
+  canary job (``submit --canary`` -> ``health`` ok), a deliberately
+  sub-threshold canary drives ``canary_recovery`` to crit (``health``
+  exits nonzero), and a clean re-drain returns the fleet to ok.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+from .fleet_smoke import FAST, _check
+
+REPORT_BASENAME = "sensitivity_report.json"
+
+#: default sweep grid: bright / marginal / sub-threshold injected SNR
+#: at the smoke recipe's on-grid period (16 samples -> an exact FFT
+#: bin at any power-of-two size)
+DEFAULT_SNRS = (40.0, 12.0, 1.5)
+DEFAULT_TSAMP = 0.000256
+DEFAULT_PERIODS = (16.0 * DEFAULT_TSAMP,)
+DEFAULT_ACCELS = (0.0,)
+
+#: a grid row "detects" at an injected SNR when at least this fraction
+#: of its period x accel cells recovered
+DETECT_FRACTION = 0.5
+
+
+# --------------------------------------------------------------------------
+# one grid cell
+# --------------------------------------------------------------------------
+
+def run_cell(path: str, *, snr: float, period: float, accel: float,
+             dm: float = 0.0, jerk: float = 0.0, duty: float = 0.05,
+             noise_max: int = 32, nsamps: int = 4096, nchans: int = 16,
+             tsamp: float = DEFAULT_TSAMP, size: int = 2048,
+             seed: int = 0, overrides: dict | None = None) -> dict:
+    """Inject one synthetic pulsar, search it, match it back.
+
+    The manifest path rides the search config as
+    ``injection_manifest``, so the cell's result carries the per-stage
+    SNR budget the drivers' probe attributes (whiten -> Fourier bin ->
+    interbin -> harmonic levels -> extracted peak).
+    """
+    from ..io import read_filterbank
+    from ..obs.injection import (
+        match_candidates, save_manifest, synthesize,
+    )
+    from ..parallel.mesh import MeshPulsarSearch
+    from ..search.plan import SearchConfig
+
+    manifest = synthesize(
+        path, period=period, dm=dm, accel=accel, jerk=jerk, duty=duty,
+        snr=snr, noise_max=noise_max, nsamps=nsamps, nchans=nchans,
+        tsamp=tsamp, seed=seed, size=size)
+    man_path = save_manifest(manifest, path + ".manifest.json")
+    acc_span = max(5.0, abs(accel) + 5.0)
+    cfg = SearchConfig(**dict(
+        dict(dm_start=0.0, dm_end=max(20.0, dm + 5.0),
+             acc_start=-acc_span, acc_end=acc_span,
+             min_snr=6.0, npdmp=0, limit=16, size=size),
+        **(overrides or {}), injection_manifest=man_path))
+    search = MeshPulsarSearch(read_filterbank(path), cfg)
+    t0 = time.time()
+    result = search.run()
+    elapsed = time.time() - t0
+    match = match_candidates(manifest, result.candidates)
+    probe = getattr(result, "injection", None) or {}
+    return {
+        "snr_in": float(snr),
+        "period": float(period),
+        "freq": float(manifest["freq"]),
+        "dm": float(dm),
+        "accel": float(accel),
+        "jerk": float(jerk),
+        "recovered": bool(match["recovered"]),
+        "snr_out": round(float(match["best_snr"]), 4),
+        "n_matches": int(match["n_matches"]),
+        "budget": probe.get("snr", {}),
+        "loss": probe.get("loss", {}),
+        "elapsed_s": round(elapsed, 3),
+        "manifest_path": man_path,
+        "size": int(search.size),
+    }
+
+
+# --------------------------------------------------------------------------
+# sweep + report + ledger
+# --------------------------------------------------------------------------
+
+def run_sweep(dirpath: str, *, snrs=DEFAULT_SNRS,
+              periods=DEFAULT_PERIODS, accels=DEFAULT_ACCELS,
+              dm: float = 0.0, jerk: float = 0.0,
+              nsamps: int = 4096, size: int = 2048, seed: int = 0,
+              overrides: dict | None = None,
+              lattice: str | None = None,
+              history: str | None = None,
+              ledger: bool = True, verbose: bool = True) -> dict:
+    """Run the full grid, reduce it, write the report + ledger record.
+
+    ``lattice`` forces ``trial_lattice`` for every cell (the per-dtype
+    recovery_delta mode); ``ledger=False`` skips the history record
+    (the lattice sweep's per-dtype passes are diagnostics, not
+    baselines).  Returns the report document.
+    """
+    os.makedirs(dirpath, exist_ok=True)
+    say = print if verbose else (lambda *a, **kw: None)
+    ov = dict(overrides or {})
+    if lattice:
+        ov["trial_lattice"] = lattice
+    cells = []
+    for i, (snr, period, accel) in enumerate(
+            itertools.product(snrs, periods, accels)):
+        cell = run_cell(
+            os.path.join(dirpath, f"cell-{i:03d}.fil"),
+            snr=snr, period=period, accel=accel, dm=dm, jerk=jerk,
+            nsamps=nsamps, size=size, seed=seed + i, overrides=ov)
+        say(f"sensitivity: cell {i} snr_in={snr:g} "
+            f"period={period:g}s accel={accel:g} -> "
+            f"{'recovered' if cell['recovered'] else 'MISSED'} "
+            f"(snr_out={cell['snr_out']:g})")
+        cells.append(cell)
+    n_rec = sum(c["recovered"] for c in cells)
+    fraction = n_rec / len(cells) if cells else 0.0
+
+    # SNR-in -> SNR-out transfer: one row per injected SNR, averaged
+    # over its period x accel cells (recovered cells only for the
+    # output side — a miss has no meaningful SNR-out)
+    transfer = []
+    for snr in sorted(set(float(s) for s in snrs)):
+        row_cells = [c for c in cells if c["snr_in"] == snr]
+        rec = [c for c in row_cells if c["recovered"]]
+        transfer.append({
+            "snr_in": snr,
+            "cells": len(row_cells),
+            "recovered": len(rec),
+            "fraction": round(len(rec) / len(row_cells), 4)
+            if row_cells else 0.0,
+            "snr_out_mean": round(
+                sum(c["snr_out"] for c in rec) / len(rec), 4)
+            if rec else 0.0,
+        })
+    detectable = [t["snr_in"] for t in transfer
+                  if t["fraction"] >= DETECT_FRACTION]
+    min_detectable = min(detectable) if detectable else None
+
+    doc = {
+        "v": 1,
+        "seed": int(seed),
+        "grid": {"snrs": [float(s) for s in snrs],
+                 "periods": [float(p) for p in periods],
+                 "accels": [float(a) for a in accels],
+                 "dm": float(dm), "jerk": float(jerk)},
+        "config": {"nsamps": int(nsamps), "size": int(size),
+                   "lattice": lattice or "auto-default",
+                   "overrides": {k: v for k, v in ov.items()}},
+        "cells": cells,
+        "transfer": transfer,
+        "recovery_fraction": round(fraction, 4),
+        "min_detectable_snr": min_detectable,
+        "elapsed_s": round(sum(c["elapsed_s"] for c in cells), 3),
+    }
+    report_path = os.path.join(dirpath, REPORT_BASENAME)
+    tmp = report_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+    os.replace(tmp, report_path)
+    doc["report_path"] = report_path
+    if ledger:
+        doc["ledger_record"] = append_sensitivity_record(doc, history)
+    return doc
+
+
+def append_sensitivity_record(doc: dict, history: str | None) -> dict:
+    """One ``kind:"sensitivity"`` ledger record per sweep: recovery
+    fraction + detection floor are the headline (the
+    ``canary_recovery`` health rule and perf_report's table read
+    them), the transfer rows ride along slim."""
+    from ..obs.history import append_history, make_history_record
+
+    metrics = {
+        "cells": len(doc["cells"]),
+        "recovered": sum(c["recovered"] for c in doc["cells"]),
+        "recovery_fraction": doc["recovery_fraction"],
+        "sweep_elapsed_s": doc["elapsed_s"],
+    }
+    if doc["min_detectable_snr"] is not None:
+        metrics["min_detectable_snr"] = float(doc["min_detectable_snr"])
+    rec = make_history_record(
+        "sensitivity", metrics,
+        config=doc["config"],
+        extra={"transfer": doc["transfer"]},
+    )
+    append_history(rec, history)
+    return rec
+
+
+def run_lattice_sweep(dirpath: str, *, lattices=("u8", "bf16"),
+                      sidecar: str | None = None,
+                      stage: str = "dedisperse",
+                      history: str | None = None, **sweep_kw) -> dict:
+    """The sweep per trial-lattice dtype: f32 is the reference (and
+    the pass that writes the ledger baseline); each quantised dtype's
+    ``recovery_delta`` — its recovery fraction minus f32's — is
+    recorded on the tuner sidecar's parity verdict, so ``auto``
+    resolution can never pick a lattice that silently loses pulsars
+    (``update_lattice`` refuses dtypes whose verdict failed)."""
+    from ..search.tuning import _device_kind_default, update_lattice
+
+    ref = run_sweep(os.path.join(dirpath, "f32"), lattice="f32",
+                    history=history, **sweep_kw)
+    costs = {"f32": ref["elapsed_s"]}
+    parity = {}
+    docs = {"f32": ref}
+    for dtype in lattices:
+        doc = run_sweep(os.path.join(dirpath, dtype), lattice=dtype,
+                        ledger=False, **sweep_kw)
+        docs[dtype] = doc
+        costs[dtype] = doc["elapsed_s"]
+        delta = doc["recovery_fraction"] - ref["recovery_fraction"]
+        moved = sum(
+            a["recovered"] != b["recovered"]
+            for a, b in zip(ref["cells"], doc["cells"]))
+        snr_deltas = [abs(a["snr_out"] - b["snr_out"])
+                      for a, b in zip(ref["cells"], doc["cells"])
+                      if a["recovered"] and b["recovered"]]
+        parity[dtype] = {
+            "ok": delta >= 0.0 and moved == 0,
+            "max_snr_delta": round(max(snr_deltas, default=0.0), 4),
+            "candidates_moved": moved,
+            "recovery_delta": round(delta, 4),
+        }
+    size = int(sweep_kw.get("size", 2048))
+    ok_dtypes = [d for d in costs
+                 if d == "f32" or parity.get(d, {}).get("ok")]
+    picked = min(ok_dtypes, key=costs.get)
+    if sidecar:
+        update_lattice(sidecar, _device_kind_default(), stage, size,
+                       costs=costs, picked=picked, parity=parity)
+    return {"reference": ref, "parity": parity, "costs": costs,
+            "picked": picked, "docs": docs}
+
+
+def format_budget_table(cells: list[dict]) -> str:
+    """The per-stage SNR budget as one row per cell (the smoke's
+    human-readable artifact): where each injection's SNR went."""
+    lines = [f"{'snr_in':>8} {'whiten':>8} {'fourier':>8} "
+             f"{'interbin':>8} {'harm':>8} {'peak':>8}  recovered"]
+    for c in cells:
+        b = c.get("budget", {})
+
+        def col(key):
+            val = b.get(key)
+            return f"{val:8.2f}" if isinstance(val, (int, float)) \
+                else f"{'-':>8}"
+
+        lines.append(
+            f"{c['snr_in']:8.2f} {col('whiten')} {col('fourier_bin')} "
+            f"{col('interbin')} {col('harmonic_best')} {col('peak')}"
+            f"  {'yes' if c['recovered'] else 'NO'}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# smoke (make sensitivity-smoke)
+# --------------------------------------------------------------------------
+
+def _serve(spool_dir: str, *verb_args, env=None) -> \
+        subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.serve", "--spool",
+         spool_dir, *verb_args],
+        env=env or dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, text=True, timeout=900)
+
+
+def run_smoke(dirpath: str, history: str | None = None) -> int:
+    """The ISSUE 14 acceptance gate, two phases.
+
+    Phase 1 — sweep: three injections at descending SNR through
+    :func:`run_sweep`; at least the two bright ones recover, the
+    sub-threshold one is reported missed, the budget table renders,
+    and exactly one ``kind:"sensitivity"`` ledger record appears.
+
+    Phase 2 — canaries under a REAL worker: ``submit --canary`` +
+    ``worker --drain`` subprocesses; a good canary leaves ``health``
+    at ok (exit 0), a deliberately sub-threshold canary drives
+    ``canary_recovery`` to crit (``health`` exits nonzero), and a
+    clean re-drain returns the fleet to ok.
+    """
+    from peasoup_tpu.obs.injection import (
+        save_manifest, smoke_observation, synthesize,
+    )
+
+    shutil.rmtree(dirpath, ignore_errors=True)
+    os.makedirs(dirpath)
+    history = history or os.path.join(dirpath, "history.jsonl")
+    failures: list[str] = []
+
+    # ---- phase 1: sweep + budget table -------------------------------
+    doc = run_sweep(os.path.join(dirpath, "sweep"), seed=5,
+                    overrides=dict(FAST), history=history)
+    print()
+    print(format_budget_table(doc["cells"]))
+    print()
+    cells = doc["cells"]
+    by_snr = {c["snr_in"]: c for c in cells}
+    bright = [c for c in cells if c["snr_in"] >= 10.0]
+    faint = by_snr[min(by_snr)]
+    _check(os.path.exists(doc["report_path"]),
+           "sensitivity_report.json written", failures)
+    _check(sum(c["recovered"] for c in cells) >= 2
+           and all(c["recovered"] for c in bright),
+           "bright + marginal injections recovered (>= 2 of 3)",
+           failures)
+    _check(not faint["recovered"],
+           f"sub-threshold injection (snr_in={faint['snr_in']:g}) "
+           f"reported missed", failures)
+    _check(all(isinstance(c["budget"].get("whiten"), (int, float))
+               and isinstance(c["budget"].get("interbin"), (int, float))
+               and isinstance(c["budget"].get("peak"), (int, float))
+               for c in cells),
+           "per-stage SNR budget attached to every cell", failures)
+    _check(doc["min_detectable_snr"] is not None
+           and doc["min_detectable_snr"] <= 12.0,
+           f"detection floor measured "
+           f"(min_detectable_snr={doc['min_detectable_snr']})",
+           failures)
+
+    from peasoup_tpu.obs.history import load_history
+
+    recs = load_history(history, kinds=("sensitivity",))
+    _check(len(recs) == 1
+           and recs[0]["metrics"]["recovery_fraction"]
+           == doc["recovery_fraction"]
+           and "min_detectable_snr" in recs[0]["metrics"],
+           "one kind:\"sensitivity\" ledger record with "
+           "recovery_fraction + min_detectable_snr", failures)
+
+    # ---- phase 2: canaries through a real worker ---------------------
+    spool_dir = os.path.join(dirpath, "jobs")
+    fast_flags = [x for k, v in FAST.items()
+                  for x in ("--set", f"{k}={v}")]
+    worker_args = ["worker", "--drain", "--single_device",
+                   "--history", history, "--telemetry-interval", "0.2",
+                   "--backoff-base", "0", "--max-attempts", "2"]
+
+    good_fil = os.path.join(dirpath, "canary-good.fil")
+    good_man = save_manifest(smoke_observation(good_fil, seed=11),
+                             good_fil + ".manifest.json")
+    sub = _serve(spool_dir, "submit", "--canary", good_man,
+                 good_fil, *fast_flags)
+    _check(sub.returncode == 0 and "canary" in sub.stdout,
+           "submit --canary enqueues a tagged job", failures)
+    drain = _serve(spool_dir, *worker_args)
+    _check(drain.returncode == 0,
+           "worker --drain completes the canary job", failures)
+    health = _serve(spool_dir, "health", "--ledger", history)
+    print(health.stdout.strip())
+    _check(health.returncode == 0
+           and "canary_recovery" in health.stdout,
+           "recovered canary: health reports ok (exit 0)", failures)
+
+    # a canary whose injection is too faint to find: the search runs
+    # clean, the matcher finds nothing, the fleet must go crit
+    bad_fil = os.path.join(dirpath, "canary-faint.fil")
+    bad_man = save_manifest(
+        synthesize(bad_fil, period=16.0 * DEFAULT_TSAMP, duty=0.05,
+                   snr=1.0, seed=13),
+        bad_fil + ".manifest.json")
+    _serve(spool_dir, "submit", "--canary", bad_man, bad_fil,
+           *fast_flags)
+    _serve(spool_dir, *worker_args)
+    health_bad = _serve(spool_dir, "health", "--ledger", history)
+    print(health_bad.stdout.strip())
+    _check(health_bad.returncode != 0
+           and "canary_recovery" in health_bad.stdout
+           and "CRIT" in health_bad.stdout,
+           "missed canary drives canary_recovery to crit "
+           "(health exits nonzero)", failures)
+
+    # clean re-drain: a newer recovered-only canary sample returns the
+    # fleet to ok without purging history
+    good2_fil = os.path.join(dirpath, "canary-good2.fil")
+    good2_man = save_manifest(smoke_observation(good2_fil, seed=17),
+                              good2_fil + ".manifest.json")
+    _serve(spool_dir, "submit", "--canary", good2_man, good2_fil,
+           *fast_flags)
+    _serve(spool_dir, *worker_args)
+    health_again = _serve(spool_dir, "health", "--ledger", history)
+    print(health_again.stdout.strip())
+    _check(health_again.returncode == 0,
+           "clean re-drain returns health to ok", failures)
+
+    # canary isolation: the store's science reads must not see the
+    # canary records the three drains ingested
+    from peasoup_tpu.serve.store import CandidateStore
+
+    store = CandidateStore(os.path.join(spool_dir, "candidates.jsonl"))
+    _check(store.count() == 0
+           and len(store.records(include_canary=True)) > 0,
+           "canary records excluded from science reads "
+           "(include_canary=True still sees them)", failures)
+
+    print()
+    if failures:
+        print(f"sensitivity-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("sensitivity-smoke: all checks passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-sensitivity",
+        description="Peasoup-TPU - synthetic-pulsar sensitivity sweep "
+                    "/ canary smoke",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-sensitivity",
+                   help="scratch directory (--smoke wipes it)")
+    p.add_argument("--snrs", default=None,
+                   help="comma-separated injected SNRs "
+                        f"(default {','.join(str(s) for s in DEFAULT_SNRS)})")
+    p.add_argument("--periods", default=None,
+                   help="comma-separated injected periods, seconds")
+    p.add_argument("--accels", default=None,
+                   help="comma-separated injected accels, m/s^2")
+    p.add_argument("--dm", type=float, default=0.0,
+                   help="injected dispersion measure")
+    p.add_argument("--nsamps", type=int, default=4096,
+                   help="samples per injected observation")
+    p.add_argument("--size", type=int, default=2048,
+                   help="search FFT length the smear ramp is pinned to")
+    p.add_argument("--seed", type=int, default=0,
+                   help="noise seed (same seed -> identical sweep)")
+    p.add_argument("--history", default=None,
+                   help="bench history ledger for the "
+                        "kind:\"sensitivity\" record (default: repo "
+                        "benchmarks/history.jsonl)")
+    p.add_argument("--lattices", default=None,
+                   help="comma-separated trial-lattice dtypes to sweep "
+                        "per-dtype (records recovery_delta on the "
+                        "tuner sidecar)")
+    p.add_argument("--sidecar", default=None,
+                   help="tuner sidecar path for --lattices verdicts")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the sensitivity-smoke acceptance gate")
+    args = p.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke(args.dir, history=args.history)
+
+    def _floats(text, default):
+        if text is None:
+            return default
+        return tuple(float(x) for x in text.split(",") if x.strip())
+
+    kw = dict(
+        snrs=_floats(args.snrs, DEFAULT_SNRS),
+        periods=_floats(args.periods, DEFAULT_PERIODS),
+        accels=_floats(args.accels, DEFAULT_ACCELS),
+        dm=args.dm, nsamps=args.nsamps, size=args.size,
+        seed=args.seed,
+    )
+    os.makedirs(args.dir, exist_ok=True)
+    if args.lattices:
+        out = run_lattice_sweep(
+            args.dir,
+            lattices=tuple(d for d in args.lattices.split(",")
+                           if d.strip()),
+            sidecar=args.sidecar, history=args.history, **kw)
+        doc = out["reference"]
+        for dtype, verdict in out["parity"].items():
+            print(f"{dtype}: recovery_delta="
+                  f"{verdict['recovery_delta']:+g} "
+                  f"({'ok' if verdict['ok'] else 'FAILED'})")
+        print(f"picked: {out['picked']}")
+    else:
+        doc = run_sweep(args.dir, history=args.history, **kw)
+    print()
+    print(format_budget_table(doc["cells"]))
+    print(f"\nrecovery_fraction: {doc['recovery_fraction']:g}  "
+          f"min_detectable_snr: {doc['min_detectable_snr']}")
+    print(f"wrote {doc['report_path']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

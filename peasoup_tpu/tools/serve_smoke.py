@@ -27,29 +27,19 @@ import shutil
 import sys
 import warnings
 
-import numpy as np
-
 
 def _write_synthetic(path: str, nsamps: int = 4096, nchans: int = 16,
                      seed: int = 0, truncate_bytes: int = 0) -> str:
     """A small 8-bit filterbank with a pulse train; ``truncate_bytes``
     chops the data section short of what the header (written WITH
-    nsamples, so the promise is explicit) declares."""
-    from peasoup_tpu.io.sigproc import (
-        SigprocHeader, write_sigproc_header,
-    )
+    nsamples, so the promise is explicit) declares.  Thin wrapper over
+    the injection synthesizer's shared smoke recipe (byte-identical to
+    the historical private helper), so smoke inputs and injections are
+    one code path."""
+    from peasoup_tpu.obs.injection import smoke_observation
 
-    rng = np.random.default_rng(seed)
-    data = rng.integers(0, 32, size=(nsamps, nchans), dtype=np.uint8)
-    data[::16] += 60
-    hdr = SigprocHeader(nbits=8, nchans=nchans, tsamp=0.000256,
-                        fch1=1510.0, foff=-10.0, nsamples=nsamps)
-    with open(path, "wb") as f:
-        write_sigproc_header(f, hdr, include_nsamples=True)
-        payload = data.tobytes()
-        if truncate_bytes:
-            payload = payload[:-truncate_bytes]
-        f.write(payload)
+    smoke_observation(path, nsamps=nsamps, nchans=nchans, seed=seed,
+                      truncate_bytes=truncate_bytes)
     return path
 
 

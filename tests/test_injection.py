@@ -1,0 +1,504 @@
+"""Sensitivity-observatory tests (ISSUE 14): the injection
+synthesizer's determinism and dispersion exactness, recovery matching
+(harmonic folds + near-miss rejection), the per-stage SNR budget
+probe's monotone taps, canary jobs end-to-end through a worker drain
+with store isolation, the canary_recovery health rule's fixtures, the
+sensitivity ledger record schema, jerk round-trips through
+overview.xml / candidates.peasoup / the parsers, the lattice sidecar's
+recovery_delta field, and the load generator's canary mix."""
+
+import importlib
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from peasoup_tpu.data import Candidate
+from peasoup_tpu.obs.injection import (
+    amp_for_snr,
+    delay_table,
+    load_manifest,
+    match_candidates,
+    noise_sigma,
+    save_manifest,
+    smoke_observation,
+    synthesize,
+)
+from peasoup_tpu.obs.metrics import REGISTRY
+
+TSAMP = 0.000256
+
+#: fast search overrides shared by the end-to-end tests
+FAST = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0, "limit": 10}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+# --------------------------------------------------------------------------
+# synthesizer
+# --------------------------------------------------------------------------
+
+def test_synthesize_deterministic(tmp_path):
+    a = str(tmp_path / "a.fil")
+    b = str(tmp_path / "b.fil")
+    c = str(tmp_path / "c.fil")
+    man_a = synthesize(a, period=16 * TSAMP, snr=20.0, seed=3)
+    man_b = synthesize(b, period=16 * TSAMP, snr=20.0, seed=3)
+    man_c = synthesize(c, period=16 * TSAMP, snr=20.0, seed=4)
+    assert open(a, "rb").read() == open(b, "rb").read()
+    assert open(a, "rb").read() != open(c, "rb").read()
+    # manifests identical up to the path they describe
+    for k in man_a:
+        if k != "path":
+            assert man_a[k] == man_b[k], k
+    assert man_a["target_snr"] == 20.0 and man_a["amp"] > 0
+
+
+def test_synthesize_manifest_roundtrip(tmp_path):
+    fil = str(tmp_path / "x.fil")
+    man = synthesize(fil, freq=50.0, dm=12.5, accel=3.0, jerk=2e5,
+                     duty=0.07, snr=15.0, seed=1)
+    path = save_manifest(man, fil + ".manifest.json")
+    back = load_manifest(path)
+    assert back == json.loads(json.dumps(man))  # JSON-faithful
+    assert load_manifest(man) is man            # dict passthrough
+
+
+def test_delay_table_matches_ops():
+    dd = importlib.import_module("peasoup_tpu.ops.dedisperse")
+    ours = delay_table(64, TSAMP, 1510.0, -10.0)
+    theirs = np.asarray(dd.delay_table(64, TSAMP, 1510.0, -10.0))
+    np.testing.assert_array_equal(ours, theirs.astype(np.float32))
+
+
+def test_dispersion_exact(tmp_path):
+    """Channel j carries the channel-0 train delayed by exactly the
+    dedisperser's integer delay — so DM-trial dedispersion realigns
+    the injection losslessly."""
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.obs.injection import _delays_in_samples
+
+    dm, nchans, nsamps = 40.0, 8, 2048
+    fil = str(tmp_path / "dm.fil")
+    # noise_max=1 -> the noise floor is all zeros: the file IS the train
+    synthesize(fil, period=64 * TSAMP, dm=dm, duty=0.1, amp=100.0,
+               noise_max=1, nsamps=nsamps, nchans=nchans)
+    data = np.asarray(read_filterbank(fil).data)
+    delays = _delays_in_samples(dm, delay_table(nchans, TSAMP, 1510.0,
+                                                -10.0))
+    assert delays[-1] > 0  # the injection really is dispersed
+    for j in range(1, nchans):
+        d = int(delays[j])
+        np.testing.assert_array_equal(data[d:, j], data[:nsamps - d, 0])
+
+
+def test_smoke_observation_is_the_legacy_recipe(tmp_path):
+    """The consolidated smoke helper stays byte-identical to the
+    historical private ``_write_synthetic`` every smoke tool used."""
+    from peasoup_tpu.io.sigproc import SigprocHeader, write_sigproc_header
+
+    for seed, trunc in ((0, 0), (2, 1024)):
+        legacy = str(tmp_path / f"legacy{seed}.fil")
+        rng = np.random.default_rng(seed)
+        data = rng.integers(0, 32, size=(4096, 16), dtype=np.uint8)
+        data[::16] += 60
+        hdr = SigprocHeader(nbits=8, nchans=16, tsamp=TSAMP,
+                            fch1=1510.0, foff=-10.0, nsamples=4096)
+        with open(legacy, "wb") as f:
+            write_sigproc_header(f, hdr, include_nsamples=True)
+            payload = data.tobytes()
+            f.write(payload[:-trunc] if trunc else payload)
+        ours = str(tmp_path / f"ours{seed}.fil")
+        smoke_observation(ours, seed=seed, truncate_bytes=trunc)
+        assert open(ours, "rb").read() == open(legacy, "rb").read()
+
+
+def test_amp_calibration():
+    assert noise_sigma(32) == pytest.approx(np.sqrt((32 * 32 - 1) / 12))
+    a1 = amp_for_snr(10.0, duty=0.05, nsamps=4096, nchans=16,
+                     noise_max=32)
+    a2 = amp_for_snr(20.0, duty=0.05, nsamps=4096, nchans=16,
+                     noise_max=32)
+    assert a2 == pytest.approx(2 * a1)  # linear in target SNR
+    with pytest.raises(ValueError):
+        synthesize("/tmp/never.fil", period=1.0, freq=1.0, snr=1.0)
+    with pytest.raises(ValueError):
+        synthesize("/tmp/never.fil", period=1.0)
+
+
+# --------------------------------------------------------------------------
+# recovery matching
+# --------------------------------------------------------------------------
+
+def _manifest(freq=50.0, accel=0.0, jerk=0.0, size=4096):
+    return {"freq": freq, "period": 1.0 / freq, "dm": 0.0,
+            "accel": accel, "jerk": jerk, "size": size, "tsamp": TSAMP}
+
+
+def test_match_harmonic_folds():
+    man = _manifest()
+    hits = [
+        {"freq": 50.0, "snr": 9.0},    # fundamental
+        {"freq": 25.0, "snr": 7.0},    # 1/2 fold
+        {"freq": 100.0, "snr": 11.0},  # 2x fold
+        {"freq": 61.3, "snr": 50.0},   # unrelated, however bright
+    ]
+    v = match_candidates(man, hits)
+    assert v["recovered"] and v["n_matches"] == 3
+    assert v["best"]["freq"] == 100.0 and v["best_snr"] == 11.0
+    assert not match_candidates(man, [hits[3]])["recovered"]
+    assert match_candidates(man, [])["best_snr"] == 0.0
+
+
+def test_match_accel_jerk_windows():
+    tobs = 4096 * TSAMP
+    c = 299792458.0
+    man = _manifest(accel=10.0)
+    near = {"freq": 50.0, "snr": 5.0,
+            "acc": 10.0 + 0.5 * 2e-3 * c / tobs}
+    far = {"freq": 50.0, "snr": 5.0,
+           "acc": 10.0 + 2.5 * 2e-3 * c / tobs}
+    assert match_candidates(man, [near])["recovered"]
+    assert not match_candidates(man, [far])["recovered"]
+    # sign convention is resampler-relative: magnitudes compare
+    assert match_candidates(man, [dict(near, acc=-near["acc"])])[
+        "recovered"]
+    man_j = _manifest(jerk=1e6)
+    near_j = {"freq": 50.0, "snr": 5.0, "jerk": 1e6}
+    far_j = {"freq": 50.0, "snr": 5.0,
+             "jerk": 1e6 + 2.5 * 2e-3 * 6 * c / tobs ** 2}
+    assert match_candidates(man_j, [near_j])["recovered"]
+    assert not match_candidates(man_j, [far_j])["recovered"]
+
+
+def test_match_dm_window_and_objects():
+    man = _manifest()
+    cand = Candidate(freq=50.0, dm=3.0, snr=8.0)  # attr access path
+    assert match_candidates(man, [cand])["recovered"]
+    assert not match_candidates(man, [cand], dm_tol=1.0)["recovered"]
+    assert match_candidates(man, [cand], dm_tol=5.0)["recovered"]
+
+
+# --------------------------------------------------------------------------
+# per-stage SNR budget probe (one real search)
+# --------------------------------------------------------------------------
+
+def test_budget_probe_monotone(tmp_path):
+    from peasoup_tpu.io import read_filterbank
+    from peasoup_tpu.parallel.mesh import MeshPulsarSearch
+    from peasoup_tpu.search.plan import SearchConfig
+
+    fil = str(tmp_path / "inj.fil")
+    man = synthesize(fil, period=16 * TSAMP, snr=40.0, duty=0.05,
+                     seed=5, size=2048)
+    man_path = save_manifest(man, fil + ".manifest.json")
+    cfg = SearchConfig(dm_start=0.0, dm_end=20.0, min_snr=6.0, npdmp=0,
+                       limit=16, size=2048, injection_manifest=man_path)
+    result = MeshPulsarSearch(read_filterbank(fil), cfg).run()
+
+    probe = result.injection
+    assert probe is not None and probe["recovered"]
+    snr = probe["snr"]
+    # bin-centered injection: each later tap can only lose signal
+    # (harmonic summing may then lift it again, so only these three
+    # are ordered)
+    assert snr["whiten"] >= snr["interbin"] >= snr["fourier_bin"] > 0
+    assert snr["peak"] > 0 and snr["harmonic_best"] >= snr["interbin"]
+    assert probe["loss"]["scalloping"] >= 0
+    assert probe["loss"]["interbin_residual"] >= 0
+    assert set(probe["trial"]) == {"dm", "dm_idx", "acc", "jerk"}
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges.get("injection.recovered") == 1
+    assert gauges.get("injection.snr_whiten", 0) > 0
+
+
+# --------------------------------------------------------------------------
+# canary jobs end-to-end + store isolation (one in-process drain)
+# --------------------------------------------------------------------------
+
+def test_canary_drain_and_store_isolation(tmp_path):
+    from peasoup_tpu.serve import (
+        BackoffPolicy, CandidateStore, JobSpool, SurveyWorker,
+    )
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    good_fil = str(tmp_path / "good.fil")
+    good_man = smoke_observation(good_fil, seed=11)
+    faint_fil = str(tmp_path / "faint.fil")
+    faint_man = synthesize(faint_fil, period=16 * TSAMP, duty=0.05,
+                           snr=1.0, seed=13)
+    man_path = save_manifest(good_man, good_fil + ".manifest.json")
+    spool.submit(good_fil,
+                 dict(FAST, injection_manifest=man_path, size=2048),
+                 canary=good_man)
+    spool.submit(faint_fil, dict(FAST, size=2048), canary=faint_man)
+    worker = SurveyWorker(
+        spool, single_device=True,
+        backoff=BackoffPolicy(max_attempts=2, base_s=0.0),
+        history_path=None, sleeper=lambda s: None)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # canary_missed warns by design
+        summary = worker.drain()
+
+    # a missed canary is a health event, not a job failure
+    assert spool.counts()["done"] == 2 and summary["failed"] == 0
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters.get("canary.recovered") == 1
+    assert counters.get("canary.missed") == 1
+    assert counters.get("events.canary_missed") == 1
+
+    verdicts = {}
+    for rec in spool.jobs("done"):
+        verdicts[rec.input] = rec.summary["canary"]
+    assert verdicts[good_fil]["recovered"]
+    assert verdicts[good_fil]["best_snr"] > 0
+    assert not verdicts[faint_fil]["recovered"]
+
+    # canary candidates never reach science reads
+    store = CandidateStore(str(tmp_path / "jobs" / "candidates.jsonl"))
+    assert store.count() == 0
+    assert store.sources() == []
+    assert store.query(good_man["freq"], freq_tol=1e-2, max_harm=2) == []
+    assert store.coincident_groups(min_sources=1) == []
+    tagged = store.records(include_canary=True)
+    assert tagged and all(r.get("canary") is True for r in tagged)
+
+
+def test_store_canary_tagging_direct(tmp_path):
+    from peasoup_tpu.serve import CandidateStore
+
+    store = CandidateStore(str(tmp_path / "cands.jsonl"))
+    science = Candidate(freq=20.0, dm=5.0, snr=9.0, jerk=1e5)
+    probe = Candidate(freq=20.0, dm=5.0, snr=9.0)
+    store.ingest("job-a", "/obs/a.fil", [science], utc=1.0)
+    store.ingest("job-b", "/obs/b.fil", [probe], utc=2.0, canary=True)
+    recs = store.records()
+    assert len(recs) == 1 and "canary" not in recs[0]
+    assert recs[0]["jerk"] == pytest.approx(1e5)
+    both = store.records(include_canary=True)
+    assert len(both) == 2
+    # the coincidencer must not pair a science hit with its own probe
+    assert store.coincident_groups(freq_tol=1e-3, min_sources=2) == []
+
+
+# --------------------------------------------------------------------------
+# canary_recovery health rule (literal-dict fixtures)
+# --------------------------------------------------------------------------
+
+NOW = 100000.0
+
+
+def _ctx(samples, ledger=()):
+    from peasoup_tpu.serve.health import DEFAULT_WINDOW_S, HealthContext
+
+    recent = [s for s in samples
+              if s.get("ts", 0) >= NOW - DEFAULT_WINDOW_S]
+    return HealthContext(now=NOW, samples=samples, recent=recent,
+                         latest={}, queue={}, running=[],
+                         ledger=list(ledger))
+
+
+def _sample(ts, recovered=0, missed=0):
+    return {"v": 1, "ts": ts, "host": "host-0",
+            "counters": {"canary.recovered": recovered,
+                         "canary.missed": missed}}
+
+
+def _sens_rec(fraction):
+    return {"kind": "sensitivity",
+            "metrics": {"recovery_fraction": fraction}}
+
+
+def test_canary_rule_fixtures():
+    from peasoup_tpu.serve.health import (
+        CRIT, OK, WARN, rule_canary_recovery,
+    )
+
+    f = rule_canary_recovery(_ctx([_sample(NOW - 10)]))[0]
+    assert (f.rule, f.severity) == ("canary_recovery", OK)
+
+    f = rule_canary_recovery(_ctx([_sample(NOW - 10, recovered=2)]))[0]
+    assert f.severity == OK
+
+    f = rule_canary_recovery(
+        _ctx([_sample(NOW - 10, recovered=1, missed=1)]))[0]
+    assert f.severity == CRIT and "MISSED" in f.message
+
+    # a clean re-drain after a miss reports healthy again (last wins)
+    f = rule_canary_recovery(_ctx([
+        _sample(NOW - 60, missed=1), _sample(NOW - 10, recovered=1),
+    ]))[0]
+    assert f.severity == OK
+
+    # window recovery below 80% of the sweep-ledger median -> warn
+    # (1 of 2 recovered in-window vs median fraction 1.0); the miss is
+    # in an OLD sample so the latest-drain check stays clean
+    ledger = [_sens_rec(1.0), _sens_rec(1.0), _sens_rec(0.9)]
+    f = rule_canary_recovery(_ctx([
+        _sample(NOW - 200, missed=1), _sample(NOW - 10, recovered=1),
+    ], ledger))[0]
+    assert f.severity == WARN and "regressing" in f.message
+    # fewer than 3 sweeps: no baseline, same samples stay ok
+    f = rule_canary_recovery(_ctx([
+        _sample(NOW - 200, missed=1), _sample(NOW - 10, recovered=1),
+    ], ledger[:2]))[0]
+    assert f.severity == OK
+
+
+# --------------------------------------------------------------------------
+# sensitivity ledger record schema
+# --------------------------------------------------------------------------
+
+def test_sensitivity_ledger_record(tmp_path):
+    from peasoup_tpu.obs.history import load_history
+    from peasoup_tpu.tools.sensitivity import append_sensitivity_record
+
+    doc = {
+        "cells": [{"recovered": True}, {"recovered": True},
+                  {"recovered": False}],
+        "recovery_fraction": 2 / 3,
+        "min_detectable_snr": 12.0,
+        "elapsed_s": 4.2,
+        "transfer": [{"snr_in": 12.0, "fraction": 1.0}],
+        "config": {"snrs": [40.0, 12.0, 1.5]},
+    }
+    history = str(tmp_path / "history.jsonl")
+    append_sensitivity_record(doc, history)
+    recs = load_history(history, kinds=("sensitivity",))
+    assert len(recs) == 1
+    m = recs[0]["metrics"]
+    assert m["cells"] == 3
+    assert m["recovery_fraction"] == pytest.approx(2 / 3)
+    assert m["min_detectable_snr"] == 12.0
+    # an inconclusive sweep has no min_detectable_snr metric at all
+    doc2 = dict(doc, min_detectable_snr=None)
+    append_sensitivity_record(doc2, history)
+    m2 = load_history(history, kinds=("sensitivity",))[-1]["metrics"]
+    assert "min_detectable_snr" not in m2
+
+
+# --------------------------------------------------------------------------
+# jerk round-trips (overview.xml / candidates.peasoup / parsers)
+# --------------------------------------------------------------------------
+
+def _jerk_cand(jerk, freq=4.0):
+    return Candidate(dm=30.0, dm_idx=9, acc=1.5, jerk=jerk, nh=2,
+                     snr=50.0, freq=freq, opt_period=1.0 / freq)
+
+
+def test_xml_jerk_roundtrip(tmp_path):
+    from peasoup_tpu.output import OutputFileWriter, OverviewFile
+
+    w = OutputFileWriter()
+    w.add_candidates([_jerk_cand(2.5e6), _jerk_cand(0.0, freq=7.0)],
+                     {0: 0, 1: 128})
+    path = str(tmp_path / "overview.xml")
+    w.to_file(path)
+    arr = OverviewFile(path).as_array()
+    assert arr["jerk"][0] == pytest.approx(2.5e6)
+    assert arr["jerk"][1] == 0.0
+    # pre-jerk files (no <jerk> element) parse with a zero column
+    legacy = open(path).read().replace(
+        "      <jerk>2500000</jerk>\n", "").replace(
+        "      <jerk>0</jerk>\n", "")
+    assert "<jerk>" not in legacy
+    legacy_path = str(tmp_path / "legacy.xml")
+    open(legacy_path, "w").write(legacy)
+    ov = OverviewFile(legacy_path)
+    arr = ov.as_array()
+    assert list(arr["jerk"]) == [0.0, 0.0]
+    assert ov.get_candidate(0)["jerk"] == 0.0
+
+
+def test_binary_jerk_roundtrip(tmp_path):
+    from peasoup_tpu.output import (
+        CandidateFileParser, write_candidate_binary,
+    )
+
+    top = _jerk_cand(2.5e6)
+    top.append(_jerk_cand(-1.25e6, freq=8.0))
+    jerked = str(tmp_path / "jerked.peasoup")
+    mapping = write_candidate_binary([top], jerked)
+    with CandidateFileParser(jerked) as p:
+        _, hits = p.cand_from_offset(mapping[0])
+    assert list(hits["jerk"]) == [np.float32(2.5e6), np.float32(-1.25e6)]
+    assert hits[0]["snr"] == pytest.approx(50.0)
+    assert b"JRK0" in open(jerked, "rb").read()
+
+    # an all-zero-jerk file keeps the reference byte layout exactly
+    plain = str(tmp_path / "plain.peasoup")
+    write_candidate_binary([_jerk_cand(0.0)], plain)
+    blob = open(plain, "rb").read()
+    assert b"JRK0" not in blob
+    from peasoup_tpu.output.binary import POD_DTYPE
+
+    assert len(blob) == 4 + POD_DTYPE.itemsize  # ndets + one POD
+    with CandidateFileParser(plain) as p:
+        _, hits = p.cand_from_offset(0)
+    assert hits["jerk"][0] == 0.0
+
+
+# --------------------------------------------------------------------------
+# lattice sidecar recovery_delta
+# --------------------------------------------------------------------------
+
+def test_update_lattice_recovery_delta(tmp_path):
+    from peasoup_tpu.search.tuning import load_lattice, update_lattice
+
+    path = str(tmp_path / "tune.json")
+    update_lattice(path, "cpu", "dedisperse", 2048,
+                   costs={"f32": 1.0, "u8": 0.5},
+                   picked="u8",
+                   parity={"u8": {"ok": True, "max_snr_delta": 0.01,
+                                  "candidates_moved": 0,
+                                  "recovery_delta": 0.0},
+                           "bf16": {"ok": True, "max_snr_delta": 0.0,
+                                    "candidates_moved": 0}})
+    sec = load_lattice(path)
+    cell = sec["cpu"]["dedisperse/2048"]
+    assert cell["parity"]["u8"]["recovery_delta"] == 0.0
+    assert "recovery_delta" not in cell["parity"]["bf16"]
+
+
+# --------------------------------------------------------------------------
+# loadgen canary mix
+# --------------------------------------------------------------------------
+
+def test_job_mix_canary_disjoint_from_poison():
+    from peasoup_tpu.tools.loadgen import job_mix
+
+    rng = np.random.default_rng(0)
+    specs = job_mix(40, rng, poison_fraction=0.25, canary_fraction=0.25)
+    poison = {s["i"] for s in specs if s["poison"]}
+    canary = {s["i"] for s in specs if s["canary"]}
+    assert len(poison) == 10 and len(canary) == 10
+    assert not (poison & canary)
+    # deterministic for a fixed generator state
+    specs2 = job_mix(40, np.random.default_rng(0),
+                     poison_fraction=0.25, canary_fraction=0.25)
+    assert specs == specs2
+
+
+def test_write_observations_canary_manifest(tmp_path):
+    from peasoup_tpu.tools.loadgen import job_mix, write_observations
+
+    rng = np.random.default_rng(1)
+    specs = job_mix(4, rng, canary_fraction=0.5)
+    write_observations(specs, str(tmp_path / "obs"))
+    canaries = [s for s in specs if s["canary"]]
+    assert len(canaries) == 2
+    for s in canaries:
+        assert os.path.exists(s["path"])
+        assert os.path.exists(s["manifest_path"])
+        assert load_manifest(s["manifest_path"])["freq"] == \
+            s["canary_manifest"]["freq"]
+    for s in specs:
+        if not s["canary"]:
+            assert "canary_manifest" not in s
