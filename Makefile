@@ -12,8 +12,9 @@ PIPELINE_SMOKE_DIR ?= /tmp/peasoup-pipeline-smoke
 LOADGEN_SMOKE_DIR ?= /tmp/peasoup-loadgen-smoke
 JERK_SMOKE_DIR ?= /tmp/peasoup-jerk-smoke
 SENSITIVITY_SMOKE_DIR ?= /tmp/peasoup-sensitivity-smoke
+CHAOS_SMOKE_DIR ?= /tmp/peasoup-chaos-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -139,3 +140,15 @@ jerk-smoke:
 sensitivity-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.sensitivity --smoke \
 	    --dir $(SENSITIVITY_SMOKE_DIR)
+
+# chaos smoke test (ISSUE 15): seeded fault plan (worker SIGKILL
+# mid-job, one poison input, one over-quota tenant) against a live
+# supervised fleet under two-rate traffic — the supervisor must
+# detect/reap/respawn, health must return to exit 0 inside the
+# budget with zero jobs lost or double-run, the flooding tenant must
+# be deferred with a typed AdmissionError while the fair-share tenant
+# completes its whole quota, and a control phase with NO supervisor
+# must leave the same fault at health exit 1
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.chaos --smoke \
+	    --dir $(CHAOS_SMOKE_DIR)

@@ -66,6 +66,15 @@ p50/p95/p99 sojourn, and appending the ``kind="loadgen"`` ledger
 record the ``loadgen_saturation`` health rule reads its baseline
 from.
 
+``--chaos [budget_s]`` (default 360) runs the chaos-recovery
+micro-bench instead: the seeded fault plan of ``tools/chaos.py``
+(worker SIGKILL mid-job, one poison input, one over-quota tenant)
+against a live supervised fleet, reporting ``chaos_recovery_s`` —
+fault injection to ``health`` exit-0 — with zero jobs lost or
+double-run asserted before the number is reported; appends the
+``kind="chaos"`` ledger record the perf gate trends recovery time
+from.
+
 Every successful run appends one structured record (git sha, device,
 timers, per-stage device time, roofline utilization, compile counts,
 parity verdict) to ``benchmarks/history.jsonl`` through the shared
@@ -582,6 +591,71 @@ def run_sensitivity_bench() -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def chaos_arg(argv: list[str]) -> float | None:
+    """``--chaos [budget_s]``: run the supervised chaos-recovery
+    micro-bench (tools/chaos.py phase A only — no control phase)
+    instead of the e2e search benchmark (default 360s budget)."""
+    if "--chaos" not in argv:
+        return None
+    i = argv.index("--chaos")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(30.0, float(argv[i + 1]))
+    return 360.0
+
+
+def run_chaos_bench(budget_s: float) -> int:
+    """``bench.py --chaos``: the seeded fault plan (worker SIGKILL
+    mid-job + poison input + over-quota tenant) against a live
+    supervised fleet, printing one JSON line whose headline is
+    ``chaos_recovery_s`` — fault injection to health exit-0.  The
+    harness runs against a hermetic workdir ledger; the ``kind=
+    "chaos"`` record lands in benchmarks/history.jsonl (the
+    perf-gate trend) unless ``--no-history``."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.tools.chaos import run_smoke
+
+    work = tempfile.mkdtemp(prefix="peasoup-chaos-bench-")
+    try:
+        rc, report = run_smoke(work, budget_s=budget_s, seed=0,
+                               control=False)
+        recovery = report.get("recovery_s")
+        out = {
+            "metric": "chaos_recovery_s",
+            "value": recovery,
+            "unit": "seconds",
+            "jobs_total": report.get("jobs_total"),
+            "jobs_done": report.get("jobs_done"),
+            "jobs_failed": report.get("jobs_failed"),
+            "admission_rejected": report.get("admission_rejected"),
+            "supervise_actions": report.get("supervise_actions"),
+            "parity": ("recovered" if rc == 0 and recovery is not None
+                       else "CHAOS RECOVERY FAILED"),
+        }
+        print(json.dumps(out))
+        if rc == 0 and recovery is not None \
+                and "--no-history" not in sys.argv[1:]:
+            from peasoup_tpu.obs.history import (
+                append_history, make_history_record,
+            )
+            append_history(make_history_record(
+                "chaos",
+                {"chaos_recovery_s": recovery,
+                 "faults_injected": len(report.get("plan", [])),
+                 "jobs_total": report.get("jobs_total", 0),
+                 "jobs_done": report.get("jobs_done", 0),
+                 "jobs_failed": report.get("jobs_failed", 0),
+                 "admission_rejected":
+                     report.get("admission_rejected", 0)},
+                config={"seed": report.get("seed", 0),
+                        "budget_s": float(budget_s),
+                        "plan": report.get("plan", [])}))
+        return rc
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -610,6 +684,9 @@ def main() -> None:
         sys.exit(run_jerk_bench(jk))
     if "--sensitivity" in sys.argv[1:]:
         sys.exit(run_sensitivity_bench())
+    ch = chaos_arg(sys.argv[1:])
+    if ch is not None:
+        sys.exit(run_chaos_bench(ch))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

@@ -46,3 +46,18 @@ class DomainError(PeasoupError, ValueError):
 
 class CheckpointError(PeasoupError, ValueError):
     """Corrupt or torn checkpoint/resume state."""
+
+
+class AdmissionError(PeasoupError, RuntimeError):
+    """The spool refused a submit under admission control
+    (serve/queue.py): either the pending backlog is past the configured
+    knee, or the tenant's token-bucket rate limit is exhausted.  The
+    job was NOT enqueued; ``retry_after_s`` hints when a resubmit can
+    succeed (0.0 = unknown, re-check the backlog)."""
+
+    def __init__(self, message: str, *, tenant: str = "",
+                 reason: str = "", retry_after_s: float = 0.0):
+        super().__init__(message)
+        self.tenant = str(tenant)
+        self.reason = str(reason)
+        self.retry_after_s = float(retry_after_s)

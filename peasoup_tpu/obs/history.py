@@ -69,6 +69,26 @@ was inconclusive) and ``sweep_elapsed_s``, plus a top-level
 fraction, mean recovered SNR) that ``tools/perf_report.py`` renders
 as the transfer-curve table.
 
+``kind == "supervise"`` records are appended by the self-healing
+supervisor (``serve/supervisor.py``) — exactly one per EXECUTED
+action (dry-run and throttled plans never reach the ledger): metrics
+``tick`` / ``workers_alive`` / ``queue_pending`` / ``queue_running``
+at execution time, ``config.action`` naming the action, and a
+top-level ``action`` object carrying ``name``, ``rule``,
+``cooldown_s``, the action's ``outcome`` dict (what was reaped /
+spawned / retired / retuned) and the triggering rule's
+``finding_before`` / ``finding_after`` states — so "did the action
+actually clear the finding" is answerable per record, and cooldown
+enforcement is auditable from consecutive records' timestamps.
+
+``kind == "chaos"`` records are appended once per chaos-harness run
+(``tools/chaos.py``): metrics ``chaos_recovery_s`` (fault injection
+to health exit-0, the figure ``bench.py --chaos`` prints and
+``tools/perf_report.py`` trends/gates), ``faults_injected``,
+``jobs_total`` / ``jobs_done`` / ``jobs_failed`` and
+``admission_rejected``, with ``config`` echoing the seeded fault
+plan.
+
 Ledger I/O never raises into a benchmark run: append/load failures
 warn and return best-effort results.
 """

@@ -28,11 +28,22 @@ beams, flaky runs and worker crashes.  This package is that layer:
   ok/warn/crit rules + an SLO summary evaluated over the live
   per-host telemetry time-series (obs/telemetry.py shards), embedded
   in ``fleet_report.json`` v2 and surfaced by the ``health`` verb;
+* :mod:`~peasoup_tpu.serve.supervisor` — the self-healing plane: a
+  control loop that maps health findings to typed, rate-limited
+  actions (reap dead hosts' leases, spawn/retire real fleet-worker
+  subprocesses, retune ``--batch``) via the ``@supervisor_action``
+  registry, with per-action cooldowns and a global actions-per-window
+  cap;
 * :mod:`~peasoup_tpu.serve.cli` — ``python -m peasoup_tpu.serve``
-  with ``submit`` / ``worker`` / ``fleet-worker`` / ``status``
-  (``--watch`` live dashboard) / ``health`` / ``timeline`` (per-job
-  lifecycle waterfall from obs/timeline.py marks) / ``coincidence``
-  / ``requeue`` verbs.
+  with ``submit`` / ``worker`` / ``fleet-worker`` / ``supervise`` /
+  ``admission`` / ``status`` (``--watch`` live dashboard) /
+  ``health`` / ``timeline`` (per-job lifecycle waterfall from
+  obs/timeline.py marks) / ``coincidence`` / ``requeue`` verbs.
+
+Admission control lives in :mod:`~peasoup_tpu.serve.queue`: per-tenant
+submits, token-bucket rate limits and weighted fair-share claim
+ordering, gated by a backlog knee that raises a typed
+:class:`~peasoup_tpu.errors.AdmissionError`.
 """
 
 from .fleet import (
@@ -51,21 +62,47 @@ from .health import (
     health_rule,
     slo_summary,
 )
-from .queue import LEASE_EXPIRED, JobRecord, JobSpool
+from .queue import (
+    DEFAULT_TENANT,
+    LEASE_EXPIRED,
+    AdmissionPolicy,
+    JobRecord,
+    JobSpool,
+    TenantPolicy,
+)
 from .retry import (
     QUARANTINE,
     RETRY,
     BackoffPolicy,
     JobTimeoutError,
+    abandoned_count,
     classify_failure,
 )
 from .store import CandidateStore, ShardedCandidateStore
+from .supervisor import (
+    ACTIONS,
+    ActionSpec,
+    Supervisor,
+    WorkerPool,
+    supervisor_action,
+)
 from .worker import SurveyWorker
+from ..errors import AdmissionError
 
 __all__ = [
     "JobRecord",
     "JobSpool",
     "LEASE_EXPIRED",
+    "DEFAULT_TENANT",
+    "AdmissionError",
+    "AdmissionPolicy",
+    "TenantPolicy",
+    "abandoned_count",
+    "ACTIONS",
+    "ActionSpec",
+    "Supervisor",
+    "WorkerPool",
+    "supervisor_action",
     "BackoffPolicy",
     "JobTimeoutError",
     "classify_failure",

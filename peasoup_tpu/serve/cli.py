@@ -2,10 +2,15 @@
 
 Verbs::
 
-    submit        <files...> [--priority N] [--set key=value ...]
+    submit        <files...> [--priority N] [--tenant NAME]
+                  [--set key=value ...]
     worker        [--drain] [--max-jobs N] [--poll S] ...
     fleet-worker  [--host-id I --host-count N] [--label L]
                   [--lease-ttl S] [--heartbeat S] + worker options
+    supervise     [--interval S] [--ticks N] [--max-workers N]
+                  [--dry-run] [--worker-arg FLAG ...]
+    admission     [--show] [--max-pending N]
+                  [--tenant NAME --rate R --burst B --weight W]
     status        [--jobs] [--fleet] [--watch [--interval S]]
     health        [--json PATH] [--stale-after N] [--window S]
                   [--slo KEY=VALUE ...]
@@ -43,6 +48,16 @@ crit finding — CI/cron-able; ``status --watch`` re-renders the fleet
 table and the current findings every ``--interval`` seconds (a
 terminal dashboard; ``--iterations`` bounds it for tests and one-shot
 scripts).
+
+Self-healing plane (serve/supervisor.py): ``supervise`` runs the
+control loop that ACTS on the findings — reaping dead hosts' leases,
+spawning/retiring real fleet-worker subprocesses against the backlog
+trend, retuning ``--batch`` on bucket-mix drift — with per-action
+cooldowns and a global actions-per-window cap (``--dry-run`` prints
+the plan without executing).  ``admission`` shows or edits the
+spool's shared admission policy (``admission.json``): the backlog
+knee plus per-tenant token-bucket rates and fair-share weights that
+``submit --tenant`` is subject to.
 """
 
 from __future__ import annotations
@@ -89,6 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
                     default=[], metavar="KEY=VALUE",
                     help="SearchConfig override (repeatable), e.g. "
                          "--set dm_end=120 --set npdmp=8")
+    ps.add_argument("--tenant", default=None,
+                    help="tenant identity for admission control and "
+                         "fair-share claims (default tenant when "
+                         "omitted)")
     ps.add_argument("--canary", default=None, metavar="MANIFEST.json",
                     help="submit as a known-answer canary: the "
                          "injection manifest (obs/injection.py) rides "
@@ -116,6 +135,77 @@ def build_parser() -> argparse.ArgumentParser:
                          "host may reap this host's running jobs")
     pf.add_argument("--heartbeat", type=float, default=0.0,
                     help="lease refresh interval (0 = ttl/3)")
+
+    pv = sub.add_parser(
+        "supervise",
+        help="self-healing control loop: act on health findings "
+             "(reap leases, spawn/retire workers, retune batch)")
+    pv.add_argument("--interval", type=float, default=10.0,
+                    help="seconds between health evaluations")
+    pv.add_argument("--ticks", type=int, default=0,
+                    help="stop after N ticks (0 = run until signal)")
+    pv.add_argument("--max-workers", type=int, default=2,
+                    help="ceiling for supervisor-spawned fleet-worker "
+                         "subprocesses")
+    pv.add_argument("--batch", type=int, default=1,
+                    help="initial --batch for spawned workers "
+                         "(retune_batch may change it)")
+    pv.add_argument("--single_device", action="store_true",
+                    help="spawned workers use the host-loop driver")
+    pv.add_argument("--dry-run", action="store_true",
+                    help="plan and print actions without executing")
+    pv.add_argument("--lease-ttl", type=float, default=None,
+                    help="TTL the reap_expired action enforces")
+    pv.add_argument("--actions-window", type=float, default=120.0,
+                    help="global cap window in seconds")
+    pv.add_argument("--max-actions", type=int, default=6,
+                    help="max executed actions per window (flapping "
+                         "rules slow healing, never thrash)")
+    pv.add_argument("--cooldown", dest="cooldowns", action="append",
+                    default=[], metavar="ACTION=SECONDS",
+                    help="override one action's cooldown "
+                         "(repeatable), e.g. --cooldown scale_up=3")
+    pv.add_argument("--stale-after", type=float, default=None,
+                    help="health: missed intervals before a host is "
+                         "stale")
+    pv.add_argument("--window", type=float, default=None,
+                    help="health evaluation window in seconds")
+    pv.add_argument("--history", default=None,
+                    help="ledger path for kind:\"supervise\" records "
+                         "(default: repo benchmarks/history.jsonl)")
+    pv.add_argument("--ledger", default=None,
+                    help="bench history ledger for health baselines")
+    pv.add_argument("--telemetry-interval", type=float, default=None,
+                    help="supervisor's own queue-depth sampling "
+                         "cadence (default: min(--interval, 5); "
+                         "0 disables)")
+    pv.add_argument("--worker-arg", dest="worker_args",
+                    action="append", default=[], metavar="FLAG",
+                    help="extra argument passed verbatim to every "
+                         "spawned fleet-worker (repeatable), e.g. "
+                         "--worker-arg=--max-attempts "
+                         "--worker-arg=2")
+
+    pa = sub.add_parser(
+        "admission",
+        help="show or edit the spool's shared admission policy "
+             "(admission.json: backlog knee + per-tenant limits)")
+    pa.add_argument("--show", action="store_true",
+                    help="print the policy and per-tenant queue "
+                         "counts")
+    pa.add_argument("--max-pending", type=int, default=None,
+                    help="set the backlog knee (0 = unlimited)")
+    pa.add_argument("--tenant", default=None,
+                    help="tenant whose limits --rate/--burst/--weight "
+                         "set")
+    pa.add_argument("--rate", type=float, default=None,
+                    help="tenant token-bucket refill rate, submits/s "
+                         "(0 = unlimited)")
+    pa.add_argument("--burst", type=float, default=None,
+                    help="tenant token-bucket capacity")
+    pa.add_argument("--weight", type=float, default=None,
+                    help="tenant fair-share weight within a priority "
+                         "tier")
 
     pt = sub.add_parser("status", help="queue + store summary")
     pt.add_argument("--jobs", action="store_true",
@@ -213,6 +303,10 @@ def _add_worker_args(pw) -> None:
     pw.add_argument("--backoff-base", type=float, default=1.0,
                     help="first-retry backoff in seconds (doubles "
                          "per attempt, capped at 60)")
+    pw.add_argument("--backoff-jitter", type=float, default=0.25,
+                    help="decorrelation jitter fraction on retry "
+                         "delays so N workers don't thundering-herd "
+                         "the spool (0 = exact exponential)")
     pw.add_argument("--single_device", action="store_true",
                     help="host-loop driver instead of the mesh")
     pw.add_argument("-t", "--num_threads", type=int, default=14,
@@ -246,12 +340,16 @@ def cmd_submit(spool, args) -> int:
         # against the same manifest (search/pipeline.py)
         overrides.setdefault("injection_manifest",
                              os.path.abspath(args.canary))
+    from .queue import DEFAULT_TENANT
+
+    tenant = args.tenant or DEFAULT_TENANT
     for path in args.inputs:
         rec = spool.submit(path, overrides, priority=args.priority,
-                           canary=canary)
+                           canary=canary, tenant=tenant)
         tag = "  canary" if canary else ""
+        ten = f"  tenant={rec.tenant}" if args.tenant else ""
         print(f"submitted {rec.job_id}  priority={rec.priority}  "
-              f"{rec.input}{tag}")
+              f"{rec.input}{tag}{ten}")
     return 0
 
 
@@ -266,7 +364,8 @@ def cmd_worker(spool, args) -> int:
     worker = SurveyWorker(
         spool,
         backoff=BackoffPolicy(max_attempts=args.max_attempts,
-                              base_s=args.backoff_base),
+                              base_s=args.backoff_base,
+                              jitter=args.backoff_jitter),
         timeout_s=args.timeout,
         single_device=args.single_device,
         max_devices=args.max_num_threads,
@@ -311,7 +410,8 @@ def cmd_fleet_worker(spool, args) -> int:
                      else DEFAULT_LEASE_TTL_S),
         heartbeat_s=args.heartbeat or None,
         backoff=BackoffPolicy(max_attempts=args.max_attempts,
-                              base_s=args.backoff_base),
+                              base_s=args.backoff_base,
+                              jitter=args.backoff_jitter),
         timeout_s=args.timeout,
         single_device=args.single_device,
         max_devices=args.max_num_threads,
@@ -328,6 +428,111 @@ def cmd_fleet_worker(spool, args) -> int:
           f"{summary['claimed']} jobs ok in {summary['elapsed_s']}s "
           f"({summary['jobs_per_hour']} jobs/h)")
     return 0 if summary["failed"] == 0 else 1
+
+
+def cmd_supervise(spool, args) -> int:
+    import signal
+
+    from ..obs.events import configure_event_log
+    from .queue import DEFAULT_LEASE_TTL_S
+    from .supervisor import Supervisor, WorkerPool
+
+    configure_event_log(os.path.join(spool.root,
+                                     "supervisor-events.jsonl"))
+    worker_args = list(args.worker_args)
+    if args.single_device:
+        worker_args.append("--single_device")
+    if args.history:
+        worker_args += ["--history", args.history]
+    pool = WorkerPool(spool.root, max_workers=args.max_workers,
+                      batch=args.batch, worker_args=worker_args)
+    kw = {}
+    if args.window is not None:
+        kw["window_s"] = args.window
+    if args.stale_after is not None:
+        kw["stale_after"] = args.stale_after
+    telemetry = (args.telemetry_interval
+                 if args.telemetry_interval is not None
+                 else min(args.interval, 5.0))
+    cooldowns = {}
+    for item in args.cooldowns:
+        key, val = _parse_override(item)
+        cooldowns[key] = float(val)
+    sup = Supervisor(
+        spool, pool=pool, interval_s=args.interval,
+        lease_ttl_s=(args.lease_ttl if args.lease_ttl is not None
+                     else DEFAULT_LEASE_TTL_S),
+        dry_run=args.dry_run,
+        actions_window_s=args.actions_window,
+        max_actions_per_window=args.max_actions,
+        cooldowns=cooldowns,
+        history_path=args.history, ledger_path=args.ledger,
+        telemetry_interval_s=telemetry, **kw)
+
+    def _graceful(signum, frame):
+        sup.stop()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    print(f"supervisor: spool {spool.root}  interval "
+          f"{args.interval:g}s  max-workers {args.max_workers}"
+          f"{'  DRY-RUN' if args.dry_run else ''}")
+    try:
+        ticks = sup.run(ticks=args.ticks)
+    finally:
+        pool.stop_all()
+    executed = len(sup.actions_taken)
+    print(f"supervisor: {ticks} tick(s), {executed} action(s) "
+          f"executed")
+    return 0
+
+
+def cmd_admission(spool, args) -> int:
+    from dataclasses import replace
+
+    from ..errors import ConfigError
+    from .queue import AdmissionPolicy, TenantPolicy
+
+    pol = AdmissionPolicy.load(spool.root)
+    changed = False
+    if args.max_pending is not None:
+        pol.max_pending = int(args.max_pending)
+        changed = True
+    tenant_knobs = [k for k in ("rate", "burst", "weight")
+                    if getattr(args, k) is not None]
+    if tenant_knobs and not args.tenant:
+        raise ConfigError(
+            "--rate/--burst/--weight need --tenant NAME")
+    if args.tenant and tenant_knobs:
+        cur = pol.tenants.get(args.tenant, TenantPolicy())
+        updates = {}
+        if args.rate is not None:
+            updates["rate_per_s"] = float(args.rate)
+        if args.burst is not None:
+            updates["burst"] = float(args.burst)
+        if args.weight is not None:
+            updates["weight"] = float(args.weight)
+        pol.tenants[args.tenant] = replace(cur, **updates)
+        changed = True
+    if changed:
+        print(f"wrote {pol.save(spool.root)}")
+    knee = pol.max_pending or "unlimited"
+    print(f"max_pending: {knee}")
+    counts = spool.tenant_counts() if (args.show or not changed) \
+        else {}
+    names = sorted(set(pol.tenants) | set(counts))
+    for name in names:
+        tp = pol.for_tenant(name)
+        rate = f"{tp.rate_per_s:g}/s burst {tp.burst:g}" \
+            if tp.rate_per_s else "unlimited"
+        line = (f"tenant {name}: rate {rate}, "
+                f"weight {tp.weight:g}")
+        if name in counts:
+            line += "  [" + "  ".join(
+                f"{s}={n}" for s, n in counts[name].items()
+                if n) + "]"
+        print(line)
+    return 0
 
 
 def _print_fleet_table(report: dict) -> None:
@@ -583,6 +788,8 @@ def main(argv=None) -> int:
         "submit": cmd_submit,
         "worker": cmd_worker,
         "fleet-worker": cmd_fleet_worker,
+        "supervise": cmd_supervise,
+        "admission": cmd_admission,
         "status": cmd_status,
         "health": cmd_health,
         "coincidence": cmd_coincidence,

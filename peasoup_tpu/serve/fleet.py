@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from ..errors import ConfigError
 from ..obs.metrics import REGISTRY as METRICS
 from .queue import DEFAULT_LEASE_TTL_S, JobRecord, JobSpool
+from .retry import abandoned_count
 from .store import ShardedCandidateStore, safe_label
 from .worker import SurveyWorker
 
@@ -244,6 +245,10 @@ class FleetWorker(SurveyWorker):
                 "telemetry") if k in summary},
             "scheduler": sched(snap["counters"]),
             "gauges": sched(snap["gauges"]),
+            #: timed-out job threads still running in this process
+            #: (serve/retry.py run_with_timeout abandons them; each
+            #: may hold a device until its dispatch returns)
+            "abandoned_threads": abandoned_count(),
             "shard": os.path.basename(self.store.path),
         }
         d = os.path.join(self.spool.root, FLEET_DIR)

@@ -121,6 +121,10 @@ class HealthContext:
     window_s: float = DEFAULT_WINDOW_S
     stale_after: float = DEFAULT_STALE_AFTER
     slo: dict = field(default_factory=lambda: dict(DEFAULT_SLO))
+    #: pending-queue geometry mix: overrides-fingerprint -> job count
+    #: (cheap, header-free proxy for the batcher's bucket key), capped
+    #: at _BUCKET_SCAN_CAP records so health stays O(small)
+    pending_buckets: dict = field(default_factory=dict)
 
 
 def default_ts_dir(spool: JobSpool) -> str:
@@ -128,6 +132,25 @@ def default_ts_dir(spool: JobSpool) -> str:
     (same place as the per-host status snapshots; the ``ts-`` prefix
     and ``.jsonl`` suffix keep the two namespaces disjoint)."""
     return os.path.join(spool.root, "fleet")
+
+
+#: at most this many pending records are read for the bucket mix
+_BUCKET_SCAN_CAP = 256
+
+
+def pending_bucket_mix(spool: JobSpool,
+                       cap: int = _BUCKET_SCAN_CAP) -> dict:
+    """Count pending jobs per overrides-fingerprint (sorted key=value
+    repr).  Jobs with identical overrides are *candidates* for one
+    batched dispatch (the worker's bucket key adds the data header,
+    which health deliberately does not read — no I/O amplification);
+    a dominant fingerprint therefore bounds the achievable batch."""
+    mix: dict = {}
+    for rec in spool.pending_jobs()[:max(int(cap), 0)]:
+        key = ",".join(f"{k}={v!r}" for k, v in
+                       sorted((rec.overrides or {}).items())) or "-"
+        mix[key] = mix.get(key, 0) + 1
+    return mix
 
 
 def build_context(spool: JobSpool, *, ts_dir: str | None = None,
@@ -162,6 +185,7 @@ def build_context(spool: JobSpool, *, ts_dir: str | None = None,
         window_s=float(window_s),
         stale_after=float(stale_after),
         slo=targets,
+        pending_buckets=pending_bucket_mix(spool),
     )
 
 
@@ -573,6 +597,53 @@ def rule_canary_recovery(ctx: HealthContext) -> list[HealthFinding]:
         "canary_recovery", OK,
         f"latest canary drain recovered {last['recovered']} "
         f"injected pulsar(s), none missed", data=data)]
+
+
+@health_rule
+def rule_batch_mix(ctx: HealthContext) -> list[HealthFinding]:
+    """Bucket-mix drift: the pending queue's geometry mix no longer
+    matches the workers' configured ``--batch``.
+
+    Warn-only (a mis-sized batch wastes throughput, it does not lose
+    jobs): (1) a dominant same-overrides bucket much deeper than the
+    dispatch batch means batching upside is being left on the table;
+    (2) a batch > 1 whose windowed mean fill is under half the batch
+    means the mix fragmented and the batch wait is pure overhead.
+    ``data.suggest_batch`` carries the retune hint the supervisor's
+    ``retune_batch`` action applies to respawned workers."""
+    pending = sum(int(n) for n in ctx.pending_buckets.values())
+    if pending <= 0:
+        return [HealthFinding(
+            "batch_mix", OK, "no pending jobs to batch", data={})]
+    dominant = max(ctx.pending_buckets.values())
+    batches = [s.get("gauges", {}).get("search.batch")
+               for s in ctx.latest.values()]
+    batches = [int(b) for b in batches if b]
+    batch = max(batches) if batches else 1
+    dispatches = _recent_counter(ctx, "scheduler.batched_dispatches")
+    fill = _recent_counter(ctx, "scheduler.batch_fill")
+    data = {"pending": pending, "dominant_bucket": int(dominant),
+            "buckets": len(ctx.pending_buckets), "batch": batch,
+            "dispatches_in_window": dispatches,
+            "fill_in_window": fill}
+    if dominant >= max(2 * batch, 4):
+        data["suggest_batch"] = int(min(dominant, 8))
+        return [HealthFinding(
+            "batch_mix", WARN,
+            f"dominant pending bucket holds {dominant} same-geometry "
+            f"job(s) but workers dispatch batch={batch} — retune "
+            f"--batch toward {data['suggest_batch']}", data=data)]
+    if batch > 1 and dispatches >= 3 and fill < 0.5 * batch * dispatches:
+        mean_fill = fill / dispatches
+        data["suggest_batch"] = max(1, round(mean_fill))
+        return [HealthFinding(
+            "batch_mix", WARN,
+            f"batch={batch} but windowed mean fill is "
+            f"{mean_fill:.1f} — the mix fragmented; retune --batch "
+            f"toward {data['suggest_batch']}", data=data)]
+    return [HealthFinding(
+        "batch_mix", OK,
+        f"dominant bucket {dominant} vs batch {batch}", data=data)]
 
 
 # -- SLO summary -----------------------------------------------------------

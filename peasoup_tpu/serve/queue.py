@@ -41,6 +41,28 @@ appended to its failure log (attempt history intact), generalising
 the operator-driven ``requeue`` to automatic dead-host recovery.
 ``os.rename`` atomicity is the arbiter for reapers exactly as for
 claimers, so concurrent reapers converge on one pending record.
+
+Admission control (multi-tenant spools): every record carries a
+``tenant`` (legacy records load as :data:`DEFAULT_TENANT`), and an
+:class:`AdmissionPolicy` — persisted at ``<spool>/admission.json`` so
+submitters, workers and the supervisor share one config — gates
+submits with a queue-depth knee plus per-tenant token buckets (typed
+:class:`~peasoup_tpu.errors.AdmissionError` on refusal, the job is NOT
+enqueued) and orders claims by weighted fair share: within a priority
+tier, tenants' FIFOs are interleaved by weighted virtual finish time
+(deficit-round-robin equivalent: a weight-2 tenant drains twice as
+fast as a weight-1 tenant), so one tenant's million jobs cannot
+starve the rest.  A single-tenant tier reduces exactly to the
+historical priority-FIFO order.
+
+Crash consistency: with ``durable=True`` (the default; env
+``PEASOUP_SPOOL_FSYNC=0`` opts out) record writes fsync the tmp file
+before ``os.replace`` and the durability-critical transitions
+(submit / claim / done / failed / release / requeue / reap) fsync the
+affected state directories after the rename, so a host power-cut
+cannot tear a record or lose a rename that a peer already observed.
+High-frequency lease heartbeats stay un-fsynced: a lost beat is
+recoverable by design (the reaper just sees an older one).
 """
 
 from __future__ import annotations
@@ -50,13 +72,20 @@ import os
 import time
 from dataclasses import asdict, dataclass, field
 
-from ..errors import ConfigError
+from ..errors import AdmissionError, ConfigError
 from ..obs import timeline
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
 
 #: spool subdirectories, in lifecycle order
 STATES = ("pending", "running", "done", "failed")
+
+#: tenant stamped on submits that don't name one; legacy (pre-tenant)
+#: records load as this through from_obj's known-field filter
+DEFAULT_TENANT = "default"
+
+#: shared admission-policy file under the spool root
+ADMISSION_BASENAME = "admission.json"
 
 #: failure-log classification stamped by the lease reaper (alongside
 #: serve/retry.py's QUARANTINE / RETRY, which classify exceptions)
@@ -98,6 +127,9 @@ class JobRecord:
     #: Empty dict = a normal science job; pre-canary records load
     #: unchanged through from_obj's known-field filter
     canary: dict = field(default_factory=dict)
+    #: submitting tenant for admission control / fair share; legacy
+    #: records (no field in the JSON) load as DEFAULT_TENANT
+    tenant: str = DEFAULT_TENANT
     v: int = _RECORD_VERSION
 
     def to_json(self) -> str:
@@ -115,15 +147,120 @@ def _new_job_id() -> str:
     return f"{time.time_ns():016x}-{os.urandom(3).hex()}"
 
 
+@dataclass(frozen=True)
+class TenantPolicy:
+    """Per-tenant admission knobs.
+
+    ``rate_per_s`` > 0 enables a token bucket: a submit spends one
+    token, tokens refill at ``rate_per_s`` up to ``burst`` capacity;
+    an empty bucket raises :class:`AdmissionError` with a
+    ``retry_after_s`` hint.  ``weight`` sets the tenant's fair share
+    of claims within a priority tier (relative to the other tenants'
+    weights).  The zero-value policy (rate 0) is unlimited."""
+
+    rate_per_s: float = 0.0
+    burst: float = 1.0
+    weight: float = 1.0
+
+
+@dataclass
+class AdmissionPolicy:
+    """Spool-wide admission config: backlog knee + per-tenant limits.
+
+    ``max_pending`` > 0 rejects every submit (any tenant) while the
+    pending backlog is at or past the knee — overload degrades into
+    typed, retryable refusals instead of an unbounded spool.
+    Persisted at ``<spool>/admission.json`` (see :meth:`save`) so
+    submitters, workers and the supervisor share one config; a spool
+    with no file runs the permissive default (everything admitted,
+    equal weights)."""
+
+    max_pending: int = 0
+    tenants: dict = field(default_factory=dict)
+
+    def for_tenant(self, tenant: str) -> TenantPolicy:
+        pol = self.tenants.get(str(tenant or DEFAULT_TENANT))
+        return pol if pol is not None else TenantPolicy()
+
+    def weight(self, tenant: str) -> float:
+        w = float(self.for_tenant(tenant).weight)
+        return w if w > 0 else 1.0
+
+    def to_obj(self) -> dict:
+        return {
+            "v": 1,
+            "max_pending": int(self.max_pending),
+            "tenants": {name: asdict(pol)
+                        for name, pol in sorted(self.tenants.items())},
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "AdmissionPolicy":
+        tenants = {}
+        for name, pol in (obj.get("tenants") or {}).items():
+            known = {f for f in TenantPolicy.__dataclass_fields__}
+            tenants[str(name)] = TenantPolicy(
+                **{k: v for k, v in dict(pol).items() if k in known})
+        return cls(max_pending=int(obj.get("max_pending", 0) or 0),
+                   tenants=tenants)
+
+    @classmethod
+    def load(cls, root: str) -> "AdmissionPolicy":
+        """Policy from ``<root>/admission.json``; missing or corrupt
+        reads as the permissive default (admission must never brick
+        the spool)."""
+        path = os.path.join(root, ADMISSION_BASENAME)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError):
+            return cls()
+        try:
+            return cls.from_obj(obj if isinstance(obj, dict) else {})
+        except (TypeError, ValueError) as exc:
+            warn_event("admission_policy_corrupt",
+                       f"unreadable admission policy {path!r}: {exc}",
+                       path=path, error=str(exc))
+            return cls()
+
+    def save(self, root: str) -> str:
+        path = os.path.join(root, ADMISSION_BASENAME)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_obj(), f, sort_keys=True, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+
 class JobSpool:
     """Priority job queue over the directory layout above."""
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, *,
+                 admission: AdmissionPolicy | None = None,
+                 durable: bool | None = None, clock=None):
         self.root = os.path.abspath(root)
         for state in STATES:
             os.makedirs(os.path.join(self.root, state), exist_ok=True)
         os.makedirs(os.path.join(self.root, "work"), exist_ok=True)
         os.makedirs(os.path.join(self.root, "leases"), exist_ok=True)
+        #: admission policy snapshot (loaded once per JobSpool; CLI
+        #: verbs build a fresh spool per invocation, so edits to
+        #: admission.json take effect on the next command)
+        self.admission = (AdmissionPolicy.load(self.root)
+                          if admission is None else admission)
+        #: fsync records + state dirs on durability-critical
+        #: transitions (env PEASOUP_SPOOL_FSYNC=0 opts out fleet-wide)
+        self.durable = (
+            os.environ.get("PEASOUP_SPOOL_FSYNC", "1") != "0"
+            if durable is None else bool(durable))
+        #: injectable wall clock for token buckets (tests)
+        self._clock = clock or time.time
+        #: per-tenant token buckets: tenant -> (tokens, last_refill).
+        #: In-memory per spool instance — rate limiting is a
+        #: per-submitter-process courtesy throttle, the shared
+        #: max_pending knee is the cross-process backstop.
+        self._buckets: dict = {}
 
     # -- paths -------------------------------------------------------------
 
@@ -163,10 +300,28 @@ class JobSpool:
 
     # -- record I/O --------------------------------------------------------
 
+    def _fsync_dir(self, path: str) -> None:
+        """Flush a directory's metadata (the rename itself) to disk.
+        Best-effort: some filesystems refuse O_RDONLY dir fsync —
+        durability degrades, correctness does not."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
     def _write(self, path: str, rec: JobRecord) -> None:
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write(rec.to_json() + "\n")
+            if self.durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
 
     def _read(self, path: str) -> JobRecord | None:
@@ -186,26 +341,82 @@ class JobSpool:
 
     # -- submit / claim ----------------------------------------------------
 
+    def _admit(self, tenant: str) -> None:
+        """Admission gate for one submit: spool-wide backlog knee
+        first, then the tenant's token bucket.  Raises
+        :class:`AdmissionError` (the submit never happens) and counts
+        ``scheduler.admission_deferred`` / ``..._rejected``."""
+        pol = self.admission
+        if pol is None:
+            return
+        knee = int(pol.max_pending or 0)
+        if knee > 0:
+            backlog = self.counts()["pending"]
+            if backlog >= knee:
+                METRICS.inc("scheduler.admission_deferred")
+                warn_event(
+                    "admission_deferred",
+                    f"submit deferred for tenant {tenant!r}: pending "
+                    f"backlog {backlog} is at the knee ({knee})",
+                    tenant=tenant, backlog=backlog, max_pending=knee)
+                raise AdmissionError(
+                    f"queue backlog {backlog} >= knee {knee}; "
+                    f"resubmit after the fleet drains",
+                    tenant=tenant, reason="backlog")
+        tp = pol.for_tenant(tenant)
+        rate = float(tp.rate_per_s or 0.0)
+        if rate <= 0:
+            return
+        cap = max(1.0, float(tp.burst))
+        now = float(self._clock())
+        tokens, last = self._buckets.get(tenant, (cap, now))
+        tokens = min(cap, tokens + max(0.0, now - last) * rate)
+        if tokens < 1.0:
+            self._buckets[tenant] = (tokens, now)
+            retry_after = (1.0 - tokens) / rate
+            METRICS.inc("scheduler.admission_rejected")
+            warn_event(
+                "admission_rejected",
+                f"submit rejected for tenant {tenant!r}: token bucket "
+                f"empty (rate {rate:g}/s, burst {cap:g}); retry in "
+                f"{retry_after:.2f}s",
+                tenant=tenant, rate_per_s=rate, burst=cap,
+                retry_after_s=round(retry_after, 3))
+            raise AdmissionError(
+                f"tenant {tenant!r} over rate limit "
+                f"({rate:g} submits/s, burst {cap:g})",
+                tenant=tenant, reason="rate_limit",
+                retry_after_s=retry_after)
+        self._buckets[tenant] = (tokens - 1.0, now)
+
     def submit(self, input_path: str, overrides: dict | None = None,
-               priority: int = 0,
-               canary: dict | None = None) -> JobRecord:
+               priority: int = 0, canary: dict | None = None,
+               tenant: str = DEFAULT_TENANT) -> JobRecord:
         """Enqueue one observation; returns the pending record.
 
         ``canary``: injection manifest dict for a known-answer canary
         job — the worker matches the result against it on completion
         and the store tags its candidates out of science queries.
+        ``tenant``: accounting identity for admission control and
+        fair-share claims; may raise :class:`AdmissionError` when the
+        spool's policy refuses the submit (job NOT enqueued).
         """
+        tenant = str(tenant or DEFAULT_TENANT)
+        self._admit(tenant)
         rec = JobRecord(
             job_id=_new_job_id(),
             input=os.path.abspath(input_path),
             priority=int(priority),
             overrides=dict(overrides or {}),
             canary=dict(canary or {}),
+            tenant=tenant,
             submitted_utc=time.time(),
         )
         self._write(self._path("pending", rec.job_id), rec)
+        if self.durable:
+            self._fsync_dir(os.path.join(self.root, "pending"))
         self._mark(rec, "submit", t_wall=rec.submitted_utc,
-                   priority=rec.priority)
+                   priority=rec.priority, tenant=tenant)
         METRICS.inc("scheduler.submitted")
         return rec
 
@@ -223,14 +434,79 @@ class JobSpool:
         out.sort(key=lambda r: (-r.priority, r.submitted_utc, r.job_id))
         return out
 
-    def peek(self) -> JobRecord | None:
-        """Best pending job WITHOUT claiming it (the worker's prefetch
-        hint; another worker may still win the claim)."""
+    def claim_order(self) -> list[JobRecord]:
+        """Pending jobs in fair-share claim order.
+
+        Priority tiers stay strict (a higher tier always drains
+        first).  WITHIN a tier, each tenant's jobs form a FIFO and the
+        FIFOs are interleaved by weighted virtual finish time — job
+        index ``i`` (0-based) of a weight-``w`` tenant is ranked at
+        ``(inflight + i + 1) / w``, ties broken by submit time, where
+        ``inflight`` is the tenant's current running-job count.  The
+        inflight anchor makes the order the stateless equivalent of
+        deficit round-robin ACROSS consecutive claims, not just within
+        one snapshot: each claim a tenant wins raises its next job's
+        virtual time, so a weight-2 tenant receives two claims for
+        every one a weight-1 tenant gets, and every tenant with
+        pending work is served within one full round (starvation-free)
+        instead of the heaviest tenant re-winning a freshly recomputed
+        rank on every claim.  A single-tenant tier reduces exactly to
+        the historical priority-FIFO order.
+        """
         jobs = self.pending_jobs()
+        pol = self.admission
+        out: list[JobRecord] = []
+        tier: list[JobRecord] = []
+        inflight: dict | None = None
+
+        def _inflight(name: str) -> int:
+            nonlocal inflight
+            if inflight is None:
+                inflight = {}
+                for r in self.jobs("running"):
+                    t = r.tenant or DEFAULT_TENANT
+                    inflight[t] = inflight.get(t, 0) + 1
+            return inflight.get(name, 0)
+
+        def _flush() -> None:
+            if not tier:
+                return
+            tenants: dict = {}
+            for r in tier:
+                tenants.setdefault(r.tenant or DEFAULT_TENANT,
+                                   []).append(r)
+            if len(tenants) == 1:
+                out.extend(tier)
+            else:
+                keyed = []
+                for name, recs in tenants.items():
+                    w = pol.weight(name) if pol is not None else 1.0
+                    base = _inflight(name)
+                    for i, r in enumerate(recs):
+                        keyed.append(((base + i + 1) / w,
+                                      r.submitted_utc, r.job_id, r))
+                keyed.sort(key=lambda kv: kv[:3])
+                out.extend(r for _, _, _, r in keyed)
+            tier.clear()
+
+        prio = None
+        for r in jobs:
+            if prio is not None and r.priority != prio:
+                _flush()
+            prio = r.priority
+            tier.append(r)
+        _flush()
+        return out
+
+    def peek(self) -> JobRecord | None:
+        """Next claimable job WITHOUT claiming it (the worker's
+        prefetch hint; another worker may still win the claim)."""
+        jobs = self.claim_order()
         return jobs[0] if jobs else None
 
     def claim(self, worker: str = "", host: str = "") -> JobRecord | None:
-        """Claim the best pending job via atomic rename, or None.
+        """Claim the next job in fair-share order via atomic rename,
+        or None.
 
         Safe against concurrent claimers — on one machine or across
         hosts sharing the spool filesystem: the rename is the arbiter,
@@ -238,7 +514,7 @@ class JobSpool:
         record carries ``worker`` and ``host``, and a lease file is
         dropped for the reaper (kept fresh via :meth:`heartbeat`).
         """
-        for rec in self.pending_jobs():
+        for rec in self.claim_order():
             src = self._path("pending", rec.job_id)
             dst = self._path("running", rec.job_id)
             try:
@@ -251,6 +527,9 @@ class JobSpool:
             rec.attempts += 1
             self._observe_queue_wait(rec)
             self._write(dst, rec)
+            if self.durable:
+                self._fsync_dir(os.path.join(self.root, "pending"))
+                self._fsync_dir(os.path.join(self.root, "running"))
             self.heartbeat(rec)
             self._mark(rec, "claim", t_wall=rec.claimed_utc,
                        worker=worker)
@@ -282,6 +561,9 @@ class JobSpool:
         rec.attempts += 1
         self._observe_queue_wait(rec)
         self._write(dst, rec)
+        if self.durable:
+            self._fsync_dir(os.path.join(self.root, "pending"))
+            self._fsync_dir(os.path.join(self.root, "running"))
         self.heartbeat(rec)
         self._mark(rec, "claim", t_wall=rec.claimed_utc,
                    worker=worker)
@@ -388,6 +670,9 @@ class JobSpool:
                 f"{self.root})")
         self._write(src, rec)
         os.rename(src, self._path(dst_state, rec.job_id))
+        if self.durable:
+            self._fsync_dir(os.path.join(self.root, src_state))
+            self._fsync_dir(os.path.join(self.root, dst_state))
 
     def update(self, rec: JobRecord, state: str = "running") -> None:
         """Rewrite a record in place (attempt metadata, failure log)."""
@@ -463,3 +748,15 @@ class JobSpool:
                 if n.endswith(".json"))
             for state in STATES
         }
+
+    def tenant_counts(self) -> dict[str, dict[str, int]]:
+        """Per-tenant state counts (reads every record — an
+        inspection/CLI surface, not a hot path)."""
+        out: dict[str, dict[str, int]] = {}
+        for state in STATES:
+            for rec in self.jobs(state):
+                name = rec.tenant or DEFAULT_TENANT
+                per = out.setdefault(name,
+                                     {s: 0 for s in STATES})
+                per[state] += 1
+        return out

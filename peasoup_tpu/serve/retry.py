@@ -16,6 +16,8 @@ classified, and injectable in tests (pass a fake ``sleeper``).
 
 from __future__ import annotations
 
+import os
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -27,6 +29,8 @@ from ..errors import (
     InputFileError,
     PeasoupError,
 )
+from ..obs.events import warn_event
+from ..obs.metrics import REGISTRY as METRICS
 
 #: classification labels stored on the job's failure log
 QUARANTINE = "quarantine"
@@ -64,19 +68,42 @@ def classify_failure(exc: BaseException) -> str:
     return RETRY
 
 
+#: process-wide jitter source for workers that enable ``jitter`` but
+#: don't inject their own rng — seeded from the pid so N fleet-worker
+#: processes retrying the same transient fault draw DIFFERENT delay
+#: sequences (decorrelated), yet each process is deterministic.
+_JITTER_RNG = random.Random(os.getpid())
+
+
 @dataclass(frozen=True)
 class BackoffPolicy:
     """Bounded exponential backoff: attempt ``k`` (1-based) waits
-    ``min(base_s * factor**(k-1), max_s)`` before re-queueing."""
+    ``min(base_s * factor**(k-1), max_s)`` before re-queueing.
+
+    ``jitter`` (fraction in [0, 1)) decorrelates the herd: the delay is
+    drawn uniformly from ``[d*(1-jitter), d*(1+jitter)]`` (still capped
+    at ``max_s``), so N workers that hit the same transient fault at
+    the same instant do not hammer the spool in lock-step on every
+    retry wave.  Default 0.0 keeps delays exact for schedulers/tests
+    that assert on them; pass ``rng`` (a ``random.Random``) to make the
+    jittered sequence reproducible."""
 
     max_attempts: int = 3
     base_s: float = 1.0
     factor: float = 2.0
     max_s: float = 60.0
+    jitter: float = 0.0
+    rng: random.Random | None = None
 
     def delay_for(self, attempt: int) -> float:
         k = max(int(attempt), 1)
-        return float(min(self.base_s * self.factor ** (k - 1), self.max_s))
+        d = float(min(self.base_s * self.factor ** (k - 1), self.max_s))
+        j = float(self.jitter)
+        if j > 0.0 and d > 0.0:
+            rng = self.rng if self.rng is not None else _JITTER_RNG
+            d *= 1.0 - j + 2.0 * j * rng.random()
+            d = float(min(d, self.max_s))
+        return d
 
     def exhausted(self, attempt: int) -> bool:
         return int(attempt) >= self.max_attempts
@@ -89,6 +116,23 @@ def pause(seconds: float, sleeper=None) -> None:
         (sleeper or time.sleep)(float(seconds))
 
 
+#: daemon threads abandoned by :func:`run_with_timeout` — they cannot
+#: be cancelled, but they must not be *invisible*: `abandoned_count()`
+#: prunes the dead and reports how many are still burning a device,
+#: and the host status snapshot surfaces the number per host.
+_ABANDONED: list = []
+_ABANDONED_LOCK = threading.Lock()
+
+
+def abandoned_count() -> int:
+    """Live count of timed-out job threads still running in this
+    process (each may still hold a device until its dispatch returns).
+    Finished threads are pruned on every call."""
+    with _ABANDONED_LOCK:
+        _ABANDONED[:] = [t for t in _ABANDONED if t.is_alive()]
+        return len(_ABANDONED)
+
+
 def run_with_timeout(fn, timeout_s: float, label: str = "job"):
     """Run ``fn()`` with a wall-clock budget.
 
@@ -97,7 +141,11 @@ def run_with_timeout(fn, timeout_s: float, label: str = "job"):
     worker thread is abandoned as a daemon (a blocked XLA dispatch
     cannot be interrupted from Python; the abandoned attempt finishes
     or dies with the process, and the job record has already moved
-    on).  Exceptions from ``fn`` propagate unchanged.
+    on).  Every abandonment is accounted: ``scheduler.timeout_abandoned``
+    counter + ``job_timeout_abandoned`` event + the live count from
+    :func:`abandoned_count` in the host status snapshot, so a worker
+    quietly accumulating zombie dispatches is visible to `health`.
+    Exceptions from ``fn`` propagate unchanged.
     """
     if not timeout_s or timeout_s <= 0:
         return fn()
@@ -114,6 +162,17 @@ def run_with_timeout(fn, timeout_s: float, label: str = "job"):
     t.start()
     t.join(float(timeout_s))
     if t.is_alive():
+        with _ABANDONED_LOCK:
+            _ABANDONED.append(t)
+        METRICS.inc("scheduler.timeout_abandoned")
+        live = abandoned_count()
+        warn_event(
+            "job_timeout_abandoned",
+            f"{label} timed out after {timeout_s:.1f}s; its attempt "
+            f"thread keeps running detached ({live} live abandoned "
+            f"thread(s) in this process)",
+            label=str(label), timeout_s=float(timeout_s),
+            live_abandoned=int(live))
         raise JobTimeoutError(
             f"{label} exceeded its {timeout_s:.1f}s budget (the "
             f"attempt thread is abandoned; the job is eligible for "

@@ -1,0 +1,524 @@
+"""Self-healing supervisor: the loop that ACTS on health findings.
+
+PRs 7/10/12/14 built the sensing plane — leases, health rules, SLO
+summaries, saturation knees, canaries — but a human still had to read
+the findings and type ``requeue --expired`` or start another worker.
+This module closes the loop: a long-running control process evaluates
+the :mod:`serve.health` rules every tick and maps findings to typed,
+rate-limited **actions** through an ``@supervisor_action`` registry
+that mirrors ``@health_rule``:
+
+    finding (rule, severity)  ->  action         ->  effect
+    stale_host        crit    ->  reap_expired   ->  dead host's leases
+                                                     reaped, jobs back
+                                                     to pending/
+    queue_backlog  warn/crit  ->  scale_up       ->  one more real
+                                                     fleet-worker
+                                                     subprocess (up to
+                                                     --max-workers)
+    queue_backlog     ok      ->  retire_idle    ->  newest worker
+                                                     retired after
+                                                     sustained empty
+                                                     queue
+    batch_mix      warn/crit  ->  retune_batch   ->  respawned workers
+                                                     get the suggested
+                                                     --batch
+
+Safety over liveness: every action has a per-action cooldown and the
+loop has a global actions-per-window cap, so a flapping rule can slow
+the fleet's healing but can never thrash it.  Every EXECUTED action is
+recorded three ways — a typed ``supervise_action`` event, a
+``kind:"supervise"`` ledger record carrying the before/after finding
+state (did the action actually clear the finding?), and the
+``supervisor.json`` status snapshot under the spool root.  Dry-run
+mode plans and prints but never executes.
+
+The loop is PSL008-clean (waits via ``threading.Event.wait``) and
+fully injectable — clock, sleeper-equivalent (the Event), subprocess
+spawner — so the unit tests drive ticks synchronously with a fake
+clock while ``tools/chaos.py`` exercises the real thing against
+SIGKILLed workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass
+
+from ..obs.events import get_event_log
+from ..obs.history import append_history, make_history_record
+from ..obs.metrics import REGISTRY as METRICS
+from ..obs.telemetry import TelemetrySampler, shard_path
+from .health import (
+    CRIT,
+    DEFAULT_STALE_AFTER,
+    DEFAULT_WINDOW_S,
+    OK,
+    WARN,
+    build_context,
+    evaluate,
+)
+from .queue import DEFAULT_LEASE_TTL_S, JobSpool
+
+#: default per-action cooldowns live on the specs; these two bound the
+#: loop globally — at most MAX_ACTIONS executed in any WINDOW seconds
+DEFAULT_ACTIONS_WINDOW_S = 120.0
+DEFAULT_MAX_ACTIONS_PER_WINDOW = 6
+
+
+# -- action registry (mirrors serve/health.py's @health_rule) --------------
+
+@dataclass(frozen=True)
+class ActionSpec:
+    """One registered supervisor action: which rule/severities it
+    answers, its cooldown, and the callable that does the work."""
+
+    name: str
+    rule: str
+    severities: tuple
+    cooldown_s: float
+    fn: object
+
+    def matches(self, finding: dict) -> bool:
+        return (finding.get("rule") == self.rule
+                and finding.get("severity") in self.severities)
+
+
+ACTIONS: list[ActionSpec] = []
+
+
+def supervisor_action(name: str, *, rule: str, severities=(CRIT,),
+                      cooldown_s: float = 30.0):
+    """Register an action against a health rule's findings.
+
+    The decorated function runs as ``fn(sup, finding)`` where ``sup``
+    is the :class:`Supervisor` and ``finding`` the triggering finding
+    as a dict.  Return a JSON-able dict describing what was done, or
+    ``None`` to declare the action inapplicable this tick (no
+    cooldown consumed, nothing recorded).  Raising marks the action
+    executed-with-error (cooldown consumed — a crashing action must
+    not retry every tick).  See CONTRIBUTING "Adding a supervisor
+    action".
+    """
+    def deco(fn):
+        ACTIONS.append(ActionSpec(
+            name=str(name), rule=str(rule),
+            severities=tuple(severities),
+            cooldown_s=float(cooldown_s), fn=fn))
+        return fn
+    return deco
+
+
+# -- worker pool -----------------------------------------------------------
+
+class WorkerPool:
+    """Real ``fleet-worker`` subprocesses owned by the supervisor.
+
+    Workers are spawned as ``sup-<n>`` with ``--host-id 0
+    --host-count 1`` (fair single-host claim arbitration is the
+    spool's rename, not the id) and poll forever until retired
+    (SIGTERM).  ``popen`` is injectable so tests can count spawns
+    without forking; ``batch`` is mutable — retune_batch changes it
+    and the next spawn picks it up (running workers keep theirs)."""
+
+    def __init__(self, spool_root: str, *, max_workers: int = 2,
+                 batch: int = 1, worker_args=None, popen=None,
+                 env=None):
+        self.spool_root = str(spool_root)
+        self.max_workers = int(max_workers)
+        self.batch = int(batch)
+        self.worker_args = list(worker_args or [])
+        self._popen = popen or subprocess.Popen
+        self.env = env
+        self.spawned = 0
+        self.procs: list[dict] = []
+
+    def _cmd(self, label: str) -> list[str]:
+        return [sys.executable, "-m", "peasoup_tpu.serve",
+                "--spool", self.spool_root, "fleet-worker",
+                "--host-id", "0", "--host-count", "1",
+                "--label", label,
+                "--batch", str(self.batch)] + self.worker_args
+
+    def reap(self) -> None:
+        """Forget workers whose process exited (crashed or killed —
+        the lease reaper recovers their jobs; the pool just frees the
+        slot so scale_up can replace them)."""
+        self.procs = [w for w in self.procs
+                      if w["proc"].poll() is None]
+
+    def alive(self) -> list[dict]:
+        self.reap()
+        return list(self.procs)
+
+    def spawn(self) -> dict | None:
+        """Start one more worker, or None at ``max_workers``."""
+        if len(self.alive()) >= self.max_workers:
+            return None
+        label = f"sup-{self.spawned}"
+        self.spawned += 1
+        proc = self._popen(self._cmd(label), env=self.env)
+        info = {"label": label, "pid": int(getattr(proc, "pid", 0)),
+                "batch": self.batch, "proc": proc}
+        self.procs.append(info)
+        return info
+
+    def retire(self) -> dict | None:
+        """SIGTERM the newest worker (LIFO keeps the longest-running
+        worker's warm compile cache alive), or None if the pool is
+        empty."""
+        live = self.alive()
+        if not live:
+            return None
+        info = live[-1]
+        try:
+            info["proc"].terminate()
+        except OSError:
+            pass
+        self.procs.remove(info)
+        return info
+
+    def stop_all(self, timeout_s: float = 10.0) -> None:
+        for info in list(self.procs):
+            try:
+                info["proc"].terminate()
+            except OSError:
+                pass
+        for info in list(self.procs):
+            try:
+                info["proc"].wait(timeout=timeout_s)
+            except Exception:
+                try:
+                    info["proc"].kill()
+                except OSError:
+                    pass
+        self.procs = []
+
+    def describe(self) -> list[dict]:
+        return [{"label": w["label"], "pid": w["pid"],
+                 "batch": w["batch"]} for w in self.alive()]
+
+
+# -- the built-in actions --------------------------------------------------
+
+@supervisor_action("reap_expired", rule="stale_host",
+                   severities=(CRIT,), cooldown_s=10.0)
+def action_reap_expired(sup: "Supervisor", finding: dict) -> dict:
+    """A silent host holds running-job leases: run the reaper the
+    operator would have run.  Reaping zero jobs is still an executed
+    action (the lease may simply not have aged past the TTL yet; the
+    cooldown paces the retries)."""
+    reaped = sup.spool.reap_expired(sup.lease_ttl_s, now=sup.clock())
+    return {"reaped": len(reaped),
+            "job_ids": [r.job_id for r in reaped][:16]}
+
+
+@supervisor_action("scale_up", rule="queue_backlog",
+                   severities=(WARN, CRIT), cooldown_s=15.0)
+def action_scale_up(sup: "Supervisor", finding: dict) -> dict | None:
+    """Backlog trending up: add one real fleet-worker, bounded by the
+    pool's ``max_workers``.  One worker per firing — the cooldown
+    spaces spawns so the backlog trend can react before the next."""
+    sup.idle_ticks = 0
+    info = sup.pool.spawn()
+    if info is None:
+        return None  # already at capacity — nothing to do
+    return {"spawned": info["label"], "pid": info["pid"],
+            "batch": info["batch"],
+            "workers_alive": len(sup.pool.alive())}
+
+
+@supervisor_action("retire_idle", rule="queue_backlog",
+                   severities=(OK,), cooldown_s=30.0)
+def action_retire_idle(sup: "Supervisor", finding: dict) -> dict | None:
+    """Sustained empty queue: retire the newest worker.  Requires
+    ``low_depth_ticks`` consecutive idle ticks (queue AND running
+    empty) so a momentary lull between submit waves doesn't churn
+    workers."""
+    counts = sup.spool.counts()
+    if counts.get("pending", 0) or counts.get("running", 0):
+        sup.idle_ticks = 0
+        return None
+    sup.idle_ticks += 1
+    if sup.idle_ticks < sup.low_depth_ticks or not sup.pool.alive():
+        return None
+    info = sup.pool.retire()
+    if info is None:
+        return None
+    return {"retired": info["label"], "pid": info["pid"],
+            "idle_ticks": sup.idle_ticks,
+            "workers_alive": len(sup.pool.alive())}
+
+
+@supervisor_action("retune_batch", rule="batch_mix",
+                   severities=(WARN, CRIT), cooldown_s=60.0)
+def action_retune_batch(sup: "Supervisor", finding: dict) -> dict | None:
+    """Bucket-mix drift: adopt the rule's ``suggest_batch`` for future
+    spawns (running workers keep their batch; the pool applies the new
+    value when scale_up next fires or a crashed worker is replaced)."""
+    suggest = int((finding.get("data") or {}).get("suggest_batch")
+                  or 0)
+    if suggest < 1:
+        return None
+    new = min(suggest, sup.max_batch)
+    if new == sup.pool.batch:
+        return None
+    old = sup.pool.batch
+    sup.pool.batch = new
+    return {"batch_old": old, "batch_new": new}
+
+
+# -- the control loop ------------------------------------------------------
+
+class Supervisor:
+    """Evaluate health each tick; map findings to rate-limited actions.
+
+    Injectables: ``clock`` (token buckets, cooldowns, ledger stamps),
+    ``pool`` (a :class:`WorkerPool` or a test double), and the tick
+    wait runs on a ``threading.Event`` so ``stop()`` — e.g. from a
+    SIGTERM handler — interrupts a sleeping loop immediately.
+    ``telemetry_interval_s > 0`` runs the supervisor's own
+    :class:`TelemetrySampler` (host label ``supervisor``) carrying
+    queue depths, so the backlog trend stays observable even when
+    every worker is dead — exactly the moment scale_up is needed.
+    """
+
+    def __init__(self, spool: JobSpool, *, pool: WorkerPool | None = None,
+                 interval_s: float = 10.0,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 max_workers: int = 2, dry_run: bool = False,
+                 actions_window_s: float = DEFAULT_ACTIONS_WINDOW_S,
+                 max_actions_per_window: int =
+                 DEFAULT_MAX_ACTIONS_PER_WINDOW,
+                 cooldowns: dict | None = None,
+                 history_path: str | None = None,
+                 ledger_path: str | None = None,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 stale_after: float = DEFAULT_STALE_AFTER,
+                 slo: dict | None = None,
+                 low_depth_ticks: int = 3, max_batch: int = 8,
+                 telemetry_interval_s: float = 0.0,
+                 clock=None, out=print):
+        self.spool = spool
+        self.pool = pool if pool is not None else WorkerPool(
+            spool.root, max_workers=max_workers)
+        self.interval_s = float(interval_s)
+        self.lease_ttl_s = float(lease_ttl_s)
+        self.dry_run = bool(dry_run)
+        self.actions_window_s = float(actions_window_s)
+        self.max_actions_per_window = int(max_actions_per_window)
+        self.cooldowns = dict(cooldowns or {})
+        self.history_path = history_path
+        self.ledger_path = ledger_path
+        self.window_s = float(window_s)
+        self.stale_after = float(stale_after)
+        self.slo = slo
+        self.low_depth_ticks = int(low_depth_ticks)
+        self.max_batch = int(max_batch)
+        self.telemetry_interval_s = float(telemetry_interval_s)
+        self.clock = clock or time.time
+        self.out = out
+        self.idle_ticks = 0
+        self.tick_count = 0
+        self.actions_taken: list[dict] = []
+        self._last_fired: dict[str, float] = {}
+        self._exec_times: list[float] = []
+        self._stop = threading.Event()
+
+    # -- planning ----------------------------------------------------------
+
+    def _context(self, now: float):
+        return build_context(
+            self.spool, now=now, window_s=self.window_s,
+            stale_after=self.stale_after, slo=self.slo,
+            ledger_path=self.ledger_path)
+
+    def plan(self, report: dict) -> list[tuple[ActionSpec, dict]]:
+        """Match findings to registered actions; one firing per action
+        per tick (reap_expired covers every stale host in one call, so
+        N crit hosts still plan a single reap)."""
+        out = []
+        fired = set()
+        for finding in report.get("findings", []):
+            for spec in ACTIONS:
+                if spec.name in fired or not spec.matches(finding):
+                    continue
+                fired.add(spec.name)
+                out.append((spec, dict(finding)))
+        return out
+
+    def _throttled(self, spec: ActionSpec, now: float) -> str | None:
+        """Cooldown / global-cap gate; returns the refusal reason or
+        None (clear to execute)."""
+        cooldown = float(self.cooldowns.get(spec.name,
+                                            spec.cooldown_s))
+        last = self._last_fired.get(spec.name)
+        if last is not None and now - last < cooldown:
+            return (f"cooldown: {now - last:.1f}s since last "
+                    f"{spec.name} < {cooldown:.1f}s")
+        self._exec_times = [t for t in self._exec_times
+                            if now - t <= self.actions_window_s]
+        if len(self._exec_times) >= self.max_actions_per_window:
+            return (f"global cap: {len(self._exec_times)} action(s) "
+                    f"in the last {self.actions_window_s:.0f}s "
+                    f"(max {self.max_actions_per_window})")
+        return None
+
+    # -- execution ---------------------------------------------------------
+
+    def _finding_for_rule(self, rule: str, now: float) -> dict | None:
+        """Re-evaluate and return the worst finding for one rule (the
+        'after' state recorded with each action)."""
+        report = evaluate(self._context(now))
+        best = None
+        for finding in report.get("findings", []):
+            if finding.get("rule") != rule:
+                continue
+            if best is None or (finding.get("severity") != OK
+                                and best.get("severity") == OK):
+                best = finding
+        return best
+
+    def _record(self, spec: ActionSpec, before: dict, after,
+                outcome: dict, now: float) -> None:
+        severity_before = before.get("severity", "")
+        severity_after = (after or {}).get("severity", "")
+        get_event_log().emit(
+            "supervise_action",
+            f"supervisor action {spec.name} for rule {spec.rule} "
+            f"({severity_before} -> {severity_after or '?'})",
+            action=spec.name, rule=spec.rule,
+            severity_before=severity_before,
+            severity_after=severity_after, outcome=outcome)
+        counts = self.spool.counts()
+        rec = make_history_record(
+            "supervise",
+            {"tick": self.tick_count,
+             "workers_alive": len(self.pool.alive()),
+             "queue_pending": counts.get("pending", 0),
+             "queue_running": counts.get("running", 0)},
+            config={"spool": self.spool.root, "action": spec.name,
+                    "dry_run": self.dry_run},
+            extra={"action": {
+                "name": spec.name, "rule": spec.rule,
+                "cooldown_s": float(self.cooldowns.get(
+                    spec.name, spec.cooldown_s)),
+                "outcome": outcome,
+                "finding_before": before,
+                "finding_after": after,
+            }})
+        append_history(rec, self.history_path)
+
+    def tick(self) -> list[dict]:
+        """One control cycle: evaluate -> plan -> gate -> execute ->
+        record.  Returns one result dict per planned action."""
+        now = float(self.clock())
+        self.tick_count += 1
+        report = evaluate(self._context(now))
+        results = []
+        for spec, finding in self.plan(report):
+            entry = {"action": spec.name, "rule": spec.rule,
+                     "severity": finding.get("severity", ""),
+                     "executed": False}
+            if self.dry_run:
+                entry["dry_run"] = True
+                self.out(f"supervise[dry-run]: would run {spec.name} "
+                         f"for {spec.rule} "
+                         f"({finding.get('severity')}): "
+                         f"{finding.get('message', '')}")
+                results.append(entry)
+                continue
+            reason = self._throttled(spec, now)
+            if reason is not None:
+                entry["throttled"] = reason
+                METRICS.inc("supervisor.throttled")
+                results.append(entry)
+                continue
+            try:
+                outcome = spec.fn(self, finding)
+            except Exception as exc:  # a crashing action is an outcome
+                outcome = {"error": f"{type(exc).__name__}: {exc}"}
+            if outcome is None:
+                continue  # inapplicable — no cooldown, no record
+            self._last_fired[spec.name] = now
+            self._exec_times.append(now)
+            METRICS.inc("supervisor.actions")
+            METRICS.inc(f"supervisor.action.{spec.name}")
+            after = self._finding_for_rule(spec.rule, self.clock())
+            self._record(spec, finding, after, outcome, now)
+            entry.update(executed=True, outcome=outcome,
+                         severity_after=(after or {}).get(
+                             "severity", ""))
+            self.actions_taken.append(entry)
+            self.out(f"supervise: {spec.name} for {spec.rule} "
+                     f"({finding.get('severity')}) -> {outcome}")
+            results.append(entry)
+        self.write_status(report, results)
+        return results
+
+    # -- status / lifecycle ------------------------------------------------
+
+    def status_path(self) -> str:
+        return os.path.join(self.spool.root, "supervisor.json")
+
+    def write_status(self, report: dict, results: list[dict]) -> None:
+        """Atomic ``supervisor.json`` snapshot (NOT under fleet/ — it
+        is not a worker host status).  The chaos harness reads worker
+        pids from here."""
+        doc = {
+            "v": 1,
+            "utc": round(float(self.clock()), 3),
+            "pid": os.getpid(),
+            "tick": self.tick_count,
+            "dry_run": self.dry_run,
+            "interval_s": self.interval_s,
+            "severity": report.get("severity", ""),
+            "queue": report.get("queue", {}),
+            "workers": self.pool.describe(),
+            "batch": self.pool.batch,
+            "actions_total": len(self.actions_taken),
+            "last_results": results[-8:],
+        }
+        path = self.status_path()
+        tmp = path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError:
+            pass  # status is advisory; the loop must not die for it
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, ticks: int = 0) -> int:
+        """Run the loop: forever (``ticks=0``) or a fixed tick count.
+        Returns ticks executed.  The caller owns pool shutdown (the
+        CLI stops it; tests may want the workers to outlive a run)."""
+        sampler = None
+        if self.telemetry_interval_s > 0:
+            fleet_dir = os.path.join(self.spool.root, "fleet")
+            sampler = TelemetrySampler(
+                shard_path(fleet_dir, "supervisor"), "supervisor",
+                self.telemetry_interval_s,
+                extras=lambda: {"queue": self.spool.counts()})
+            sampler.start()
+        done = 0
+        try:
+            while not self._stop.is_set():
+                self.tick()
+                done += 1
+                if ticks and done >= ticks:
+                    break
+                if self._stop.wait(self.interval_s):
+                    break
+        finally:
+            if sampler is not None:
+                sampler.stop()
+        return done

@@ -51,6 +51,7 @@ from .queue import JobRecord, JobSpool
 from .retry import (
     QUARANTINE,
     BackoffPolicy,
+    abandoned_count,
     classify_failure,
     pause,
     run_with_timeout,
@@ -779,6 +780,9 @@ class SurveyWorker:
             "jobs_per_hour": round(jobs_per_hour, 3),
             "geometry_buckets": len(self.geometries),
             "batch": self.batch,
+            # timed-out attempt threads still alive in this process
+            # (run_with_timeout abandons them; serve/retry.py)
+            "timeout_abandoned": abandoned_count(),
         }
         if sampler is not None:
             summary["telemetry"] = {

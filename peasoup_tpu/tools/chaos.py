@@ -1,0 +1,471 @@
+"""Chaos harness (``make chaos-smoke``): prove the fleet self-heals.
+
+The fleet/health/loadgen smokes prove the *sensing* plane; this
+driver proves the *acting* plane (serve/supervisor.py) by injecting
+real faults into a real-subprocess fleet under live two-rate loadgen
+traffic and asserting the system returns to ``health`` exit 0 on its
+own, with zero jobs lost or double-run.
+
+Seeded fault plan (``--seed``), per ISSUE 15's smoke recipe:
+
+* **worker SIGKILL mid-job** — the claimed job's lease goes stale;
+  the supervisor must detect (``stale_host`` crit), reap
+  (``reap_expired`` action) and respawn capacity (``scale_up``), and
+  the job must finish on its second attempt — exactly one
+  ``lease_expired`` failure entry, never a double-run;
+* **one poison input** — a filterbank truncated mid-data must be
+  quarantined (typed, attempt 1) without poisoning the drain;
+* **one over-quota tenant** — a flooding tenant is deferred with a
+  typed :class:`~peasoup_tpu.errors.AdmissionError` by its token
+  bucket while the fair-share tenant's jobs all complete within the
+  recovery budget.
+
+Phase B (control) re-runs the SIGKILL fault with NO supervisor and
+asserts ``health`` stays at exit 1 — proving the loop, not the
+absence of faults, is what heals.
+
+The module also exposes the raw fault primitives (SIGSTOP/SIGCONT
+freeze, spool-record corruption, lease clock-skew, input truncation)
+for targeted tests; the smoke exercises the ISSUE recipe only —
+a corrupted *pending* record, for instance, deliberately never
+drains, so it cannot sit in a health-gated drain loop.
+
+``--smoke`` appends one ``kind:"chaos"`` ledger record whose headline
+``chaos_recovery_s`` (fault injection -> health exit 0) is what
+``bench.py --chaos`` prints and ``tools/perf_report.py`` trends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import warnings
+
+from .fleet_smoke import FAST, _check, _write_synthetic
+
+#: default wall-clock budget for the supervised phase (submit ->
+#: fault -> full recovery)
+DEFAULT_BUDGET_S = 360.0
+
+#: worker flags the supervisor passes to every spawned fleet-worker
+WORKER_ARGS = [
+    "--max-attempts", "2", "--backoff-base", "0",
+    "--lease-ttl", "5", "--heartbeat", "0.5",
+    "--telemetry-interval", "0.25", "--poll", "0.3",
+]
+
+
+# -- fault primitives ------------------------------------------------------
+
+def sigkill(pid: int) -> None:
+    """Hard-kill a worker mid-job (no cleanup, lease goes stale)."""
+    os.kill(int(pid), signal.SIGKILL)
+
+
+def freeze(pid: int) -> None:
+    """SIGSTOP a worker: telemetry and heartbeats freeze but the
+    process survives — indistinguishable from a wedged host until
+    thawed."""
+    os.kill(int(pid), signal.SIGSTOP)
+
+
+def thaw(pid: int) -> None:
+    os.kill(int(pid), signal.SIGCONT)
+
+
+def truncate_input(path: str, keep_bytes: int) -> str:
+    """Chop an input file short of what its header declares (poison:
+    typed quarantine at the worker)."""
+    with open(path, "rb+") as f:
+        f.truncate(max(0, int(keep_bytes)))
+    return path
+
+
+def corrupt_record(spool, state: str, job_id: str) -> str:
+    """Overwrite a job record with garbage (readers must warn
+    ``job_record_corrupt`` and skip, never crash)."""
+    path = os.path.join(spool.root, state, f"{job_id}.json")
+    with open(path, "w") as f:
+        f.write("{torn json" + os.urandom(4).hex())
+    return path
+
+
+def clock_skew_lease(spool, job_id: str, skew_s: float) -> None:
+    """Rewrite a lease heartbeat as if the writer's clock were off by
+    ``skew_s`` seconds (negative = heartbeat from the past, ages the
+    lease toward reaping)."""
+    lease = spool.lease_info(job_id) or {"v": 1, "job_id": job_id}
+    lease["utc"] = round(float(lease.get("utc", time.time()))
+                         + float(skew_s), 3)
+    path = spool._lease_path(job_id)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(lease, f)
+    os.replace(tmp, path)
+
+
+def make_plan(seed: int) -> list[dict]:
+    """The smoke's seeded fault plan.  The fault *set* is fixed (the
+    ISSUE recipe); the seed varies the arrival schedule and which
+    science job is poisoned, so repeated CI runs walk different
+    interleavings while any single run reproduces from its seed."""
+    rng = random.Random(int(seed))
+    return [
+        {"fault": "sigkill_worker", "when": "first claim"},
+        {"fault": "poison_input",
+         "science_slot": rng.randrange(5)},
+        {"fault": "overquota_tenant", "tenant": "flood",
+         "submits": 8},
+    ]
+
+
+# -- process helpers -------------------------------------------------------
+
+def _serve(spool_dir: str, *verb_args: str) -> list[str]:
+    return [sys.executable, "-m", "peasoup_tpu.serve",
+            "--spool", spool_dir] + list(verb_args)
+
+
+def _health_cmd(spool_dir: str, history: str) -> list[str]:
+    return _serve(spool_dir, "health", "--stale-after", "6",
+                  "--window", "45", "--ledger", history)
+
+
+def _health_exit(spool_dir: str, history: str, env: dict) -> int:
+    proc = subprocess.run(_health_cmd(spool_dir, history), env=env,
+                          capture_output=True, text=True, timeout=120)
+    return proc.returncode
+
+
+def _read_status(spool_dir: str) -> dict:
+    try:
+        with open(os.path.join(spool_dir, "supervisor.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _stop_proc(proc, timeout_s: float = 20.0) -> None:
+    if proc is None or proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(timeout=10.0)
+
+
+# -- the smoke -------------------------------------------------------------
+
+def run_smoke(workdir: str, *, budget_s: float = DEFAULT_BUDGET_S,
+              seed: int = 0, history: str | None = None,
+              control: bool = True) -> tuple[int, dict]:
+    """Run the seeded chaos plan; returns (exit_code, report)."""
+    from peasoup_tpu.errors import AdmissionError
+    from peasoup_tpu.obs.history import (
+        append_history,
+        load_history,
+        make_history_record,
+    )
+    from peasoup_tpu.serve import (
+        LEASE_EXPIRED,
+        AdmissionPolicy,
+        JobSpool,
+        TenantPolicy,
+    )
+    from peasoup_tpu.serve.retry import pause
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir)
+    spool_dir = os.path.join(workdir, "jobs")
+    history = history or os.path.join(workdir, "history.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    failures: list[str] = []
+    plan = make_plan(seed)
+    print("chaos plan (seed %d):" % seed)
+    for fault in plan:
+        print("  " + json.dumps(fault, sort_keys=True))
+
+    # admission policy BEFORE the spool loads it: science is the
+    # fair-share tenant (weight 2, unlimited rate), flood is capped at
+    # a 3-submit burst refilling slowly
+    os.makedirs(spool_dir, exist_ok=True)
+    AdmissionPolicy(max_pending=64, tenants={
+        "science": TenantPolicy(weight=2.0),
+        "flood": TenantPolicy(rate_per_s=0.2, burst=3.0, weight=1.0),
+    }).save(spool_dir)
+    spool = JobSpool(spool_dir)
+
+    # ---- phase A: supervised fleet under the fault plan --------------
+    sup_proc = subprocess.Popen(
+        _serve(spool_dir, "supervise", "--interval", "1",
+               "--ticks", "0", "--max-workers", "2",
+               "--single_device", "--lease-ttl", "5",
+               "--stale-after", "6", "--window", "45",
+               "--actions-window", "60", "--max-actions", "10",
+               "--cooldown", "scale_up=3",
+               "--cooldown", "reap_expired=4",
+               "--telemetry-interval", "0.3",
+               "--history", history, "--ledger", history,
+               *[f"--worker-arg={a}" for a in WORKER_ARGS]),
+        env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+    t0 = time.time()
+    deadline = t0 + float(budget_s)
+    report: dict = {"v": 1, "seed": int(seed), "plan": plan}
+    killed_pid = None
+    killed_job = None
+    t_fault = None
+    recovery_s = None
+    try:
+        # two-rate drive: a slow science trickle, then a fast wave the
+        # flood tenant piggybacks on (its burst is 3; the rest must be
+        # refused with a typed AdmissionError)
+        poison_slot = plan[1]["science_slot"]
+        science_jobs = []
+        rng = random.Random(seed + 1)
+        for i in range(5):
+            path = _write_synthetic(
+                os.path.join(workdir, f"sci{i}.fil"), seed=i)
+            if i == poison_slot:
+                truncate_input(path, os.path.getsize(path) - 1024)
+            science_jobs.append(
+                spool.submit(path, FAST, tenant="science"))
+            pause(0.4 + 0.2 * rng.random() if i < 2 else 0.05)
+        flood_jobs, rejected = [], 0
+        for i in range(int(plan[2]["submits"])):
+            path = _write_synthetic(
+                os.path.join(workdir, f"flood{i}.fil"), seed=10 + i)
+            try:
+                flood_jobs.append(
+                    spool.submit(path, FAST, tenant="flood"))
+            except AdmissionError as exc:
+                rejected += 1
+                assert exc.tenant == "flood"
+        _check(rejected == 5 and len(flood_jobs) == 3,
+               f"over-quota tenant deferred with AdmissionError "
+               f"(3 admitted, {rejected} rejected)", failures)
+        all_jobs = science_jobs + flood_jobs
+
+        # wait for the supervisor to scale up and a worker to claim
+        workers: list = []
+        while time.time() < deadline:
+            status = _read_status(spool_dir)
+            workers = status.get("workers", [])
+            if workers and spool.counts()["running"] >= 1:
+                break
+            pause(0.2)
+        running = spool.jobs("running")
+        _check(bool(running) and bool(workers),
+               "supervisor spawned a worker that claimed a job",
+               failures)
+
+        # FAULT: SIGKILL the worker that owns a running job's lease
+        by_label = {w["label"]: w["pid"] for w in workers}
+        for rec in running:
+            if rec.host in by_label:
+                killed_job, killed_pid = rec, by_label[rec.host]
+                break
+        if killed_job is None and running:
+            killed_job = running[0]
+            killed_pid = workers[0]["pid"]
+        _check(killed_pid is not None,
+               "found a worker pid holding a running-job lease",
+               failures)
+        if killed_pid is not None:
+            sigkill(killed_pid)
+        t_fault = time.time()
+        print(f"chaos: SIGKILL worker pid {killed_pid} holding job "
+              f"{killed_job.job_id if killed_job else '?'} "
+              f"at t+{t_fault - t0:.1f}s")
+
+        # recovery: all jobs terminal AND health exit 0, inside budget
+        done_ids: set = set()
+        while time.time() < deadline:
+            counts = spool.counts()
+            terminal = counts["done"] + counts["failed"]
+            if terminal >= len(all_jobs) \
+                    and counts["running"] == counts["pending"] == 0:
+                if _health_exit(spool_dir, history, env) == 0:
+                    recovery_s = time.time() - t_fault
+                    break
+            pause(0.5)
+        _check(recovery_s is not None,
+               f"health back to exit 0 within the "
+               f"{budget_s:.0f}s budget", failures)
+        if recovery_s is not None:
+            print(f"chaos: recovered in {recovery_s:.1f}s after the "
+                  f"fault")
+
+        # zero lost, zero double-run: every job exactly once terminal,
+        # attempts prove single execution (a double-run REQUIRES a
+        # second claim, which increments attempts)
+        done = {r.job_id: r for r in spool.jobs("done")}
+        failed = {r.job_id: r for r in spool.jobs("failed")}
+        ids = [r.job_id for r in all_jobs]
+        _check(all((j in done) != (j in failed) for j in ids)
+               and len(done) + len(failed) == len(ids),
+               "zero lost jobs (every submit exactly once terminal)",
+               failures)
+        poison_id = science_jobs[poison_slot].job_id
+        _check(poison_id in failed
+               and failed[poison_id].failures[0]["classification"]
+               == "quarantine"
+               and failed[poison_id].attempts == 1,
+               "poison input quarantined (typed, attempt 1)",
+               failures)
+        kid = killed_job.job_id if killed_job else None
+        krec = done.get(kid)
+        _check(krec is not None and krec.attempts == 2
+               and [f["classification"] for f in krec.failures]
+               == [LEASE_EXPIRED],
+               "killed job reaped + finished on attempt 2 (exactly "
+               "one lease_expired entry)", failures)
+        clean = [r for j, r in done.items()
+                 if j != kid]
+        _check(all(r.attempts == 1 for r in clean),
+               "zero double-runs (all other done jobs: attempt 1)",
+               failures)
+        sci_done = [j.job_id for j in science_jobs
+                    if j.job_id in done or j.job_id in failed]
+        _check(len(sci_done) == len(science_jobs),
+               "fair-share tenant completed its whole quota despite "
+               "the flood", failures)
+
+        # the supervisor's paper trail: typed events + ledger records
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sup_recs = load_history(history, kinds=["supervise"])
+        actions = [r.get("action", {}).get("name") for r in sup_recs]
+        _check(actions.count("scale_up") >= 2,
+               f"scale_up respawned capacity after the kill "
+               f"(ledger: {actions})", failures)
+        _check("reap_expired" in actions,
+               "reap_expired action recorded in the ledger", failures)
+        _check(all(r.get("action", {}).get("finding_before")
+                   for r in sup_recs),
+               "every supervise record carries before/after finding "
+               "state", failures)
+        events_path = os.path.join(spool_dir,
+                                   "supervisor-events.jsonl")
+        kinds = []
+        if os.path.exists(events_path):
+            with open(events_path) as f:
+                kinds = [json.loads(line).get("kind")
+                         for line in f if line.strip()]
+        _check(kinds.count("supervise_action") == len(sup_recs),
+               "one typed supervise_action event per ledger record",
+               failures)
+    finally:
+        _stop_proc(sup_proc)
+        out = sup_proc.stdout.read() if sup_proc.stdout else ""
+        print("---- supervisor ----")
+        print("\n".join(out.strip().splitlines()[-12:]))
+
+    counts = spool.counts()
+    report.update(
+        recovery_s=(round(recovery_s, 3)
+                    if recovery_s is not None else None),
+        jobs_total=len(all_jobs),
+        jobs_done=counts["done"],
+        jobs_failed=counts["failed"],
+        admission_rejected=rejected,
+        supervise_actions=actions,
+    )
+
+    # ---- phase B: same fault, NO supervisor -> health stays 1 --------
+    if control:
+        control_dir = os.path.join(workdir, "jobs-control")
+        cspool = JobSpool(control_dir)
+        cfil = _write_synthetic(os.path.join(workdir, "ctl.fil"),
+                                seed=99)
+        crec = cspool.submit(cfil, FAST)
+        wproc = subprocess.Popen(
+            _serve(control_dir, "fleet-worker", "--host-id", "0",
+                   "--host-count", "1", "--label", "ctl-0",
+                   "--single_device", *WORKER_ARGS),
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        cdeadline = time.time() + 120.0
+        while cspool.counts()["running"] == 0 \
+                and time.time() < cdeadline:
+            pause(0.1)
+        _check(cspool.counts()["running"] == 1,
+               "control: worker claimed mid-job", failures)
+        sigkill(wproc.pid)
+        wproc.wait(timeout=30)
+        pause(6.0)  # past the 5s lease TTL and staleness threshold
+        rc1 = _health_exit(control_dir, history, env)
+        pause(3.0)
+        rc2 = _health_exit(control_dir, history, env)
+        _check(rc1 == 1 and rc2 == 1,
+               "control: without a supervisor the same fault leaves "
+               "health at exit 1", failures)
+        _check(cspool.counts()["running"] == 1
+               and cspool.get(crec.job_id)[0] == "running",
+               "control: the job stays stuck in running/ (nothing "
+               "heals it)", failures)
+        report["control_health_exits"] = [rc1, rc2]
+
+    # ---- ledger record + report --------------------------------------
+    if recovery_s is not None:
+        rec = make_history_record(
+            "chaos",
+            {"chaos_recovery_s": round(recovery_s, 3),
+             "faults_injected": len(plan),
+             "jobs_total": report["jobs_total"],
+             "jobs_done": report["jobs_done"],
+             "jobs_failed": report["jobs_failed"],
+             "admission_rejected": rejected},
+            config={"seed": int(seed), "budget_s": float(budget_s),
+                    "plan": plan})
+        append_history(rec, history)
+    report_path = os.path.join(workdir, "chaos_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, sort_keys=True, indent=1)
+    print(f"wrote {report_path}")
+
+    if failures:
+        print(f"\nchaos-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1, report
+    print("\nchaos-smoke: all checks passed")
+    return 0, report
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-chaos",
+        description="Peasoup-TPU - chaos harness: fault injection "
+                    "against the self-healing fleet")
+    p.add_argument("--smoke", action="store_true",
+                   help="run the seeded smoke plan (the make target)")
+    p.add_argument("--dir", default="/tmp/peasoup-chaos-smoke",
+                   help="scratch directory (wiped)")
+    p.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                   help="recovery budget in seconds")
+    p.add_argument("--seed", type=int, default=0,
+                   help="fault-plan seed")
+    p.add_argument("--history", default=None,
+                   help="ledger path for the kind:\"chaos\" record "
+                        "(default: <dir>/history.jsonl, hermetic)")
+    p.add_argument("--no-control", action="store_true",
+                   help="skip the no-supervisor control phase")
+    args = p.parse_args(argv)
+    rc, _ = run_smoke(args.dir, budget_s=args.budget, seed=args.seed,
+                      history=args.history,
+                      control=not args.no_control)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

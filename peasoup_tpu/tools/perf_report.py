@@ -45,12 +45,15 @@ GATE_METRIC = "e2e_s"
 #: (bench.py's ``peaks_device_s`` / ``search_device_s`` metrics) are
 #: gated too, as is the jerk bench's per-trial cost
 #: (``jerk_s_per_ktrial``, from ``kind:"jerk"`` records — ISSUE 13),
-#: and the sensitivity sweep's ``recovery_fraction`` (from
+#: the sensitivity sweep's ``recovery_fraction`` (from
 #: ``kind:"sensitivity"`` records — ISSUE 14; higher is better, see
-#: below).  A metric with fewer than 2 records passes vacuously —
-#: ledgers predating a metric stay green.
+#: below), and the chaos harness's ``chaos_recovery_s`` (from
+#: ``kind:"chaos"`` records — ISSUE 15; fault injection to health
+#: exit-0, lower is better).  A metric with fewer than 2 records
+#: passes vacuously — ledgers predating a metric stay green.
 STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
-                      "jerk_s_per_ktrial", "recovery_fraction")
+                      "jerk_s_per_ktrial", "recovery_fraction",
+                      "chaos_recovery_s")
 
 #: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
 #: device seconds per wall second — a drop means the dispatch pipeline
@@ -341,6 +344,41 @@ def sensitivity_table(ledger: str | None = None,
     return "\n".join(lines)
 
 
+def chaos_table(ledger: str | None = None, limit: int = 12) -> str:
+    """Chaos-recovery history (``kind:"chaos"`` ledger records —
+    ISSUE 15): how fast the supervisor brought ``health`` back to
+    exit 0 after the seeded fault plan, next to the run's job and
+    admission accounting, so "is the fleet still self-healing, and is
+    it getting slower at it" is trendable from the default report
+    view."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("chaos",))
+    if not records:
+        return ""
+    lines = [f"chaos recovery ({len(records)} record(s); newest "
+             f"last):",
+             f"  {'ts':<20}{'faults':>7}{'jobs':>6}{'done':>6}"
+             f"{'failed':>7}{'rejected':>9}{'recov_s':>9}"]
+    for rec in records[-limit:]:
+        m = rec.get("metrics", {})
+        lines.append(
+            f"  {str(rec.get('ts', ''))[:19]:<20}"
+            f"{int(m.get('faults_injected', 0)):>7}"
+            f"{int(m.get('jobs_total', 0)):>6}"
+            f"{int(m.get('jobs_done', 0)):>6}"
+            f"{int(m.get('jobs_failed', 0)):>7}"
+            f"{int(m.get('admission_rejected', 0)):>9}"
+            f"{float(m.get('chaos_recovery_s', 0.0)):>9.3g}")
+    vals = [float(r["metrics"]["chaos_recovery_s"]) for r in records
+            if isinstance(r.get("metrics", {}).get("chaos_recovery_s"),
+                          (int, float))]
+    if vals:
+        lines.append(f"  recovery trend: {sparkline(vals)}  "
+                     f"(median {_median(vals):.4g} s, last "
+                     f"{vals[-1]:.4g} s)")
+    return "\n".join(lines)
+
+
 def stage_table(records: list[dict]) -> str:
     """Trailing per-stage device-time and utilization figures (from the
     newest record that carries them)."""
@@ -476,7 +514,7 @@ def main(argv=None) -> int:
             try:
                 gate_records = records + load_history(
                     args.ledger or default_ledger_path(),
-                    kinds=("jerk", "sensitivity"))
+                    kinds=("jerk", "sensitivity", "chaos"))
             except OSError:
                 pass
         codes, msgs = [], []
@@ -529,6 +567,10 @@ def main(argv=None) -> int:
         if sn:
             print()
             print(sn)
+        ct = chaos_table(args.ledger)
+        if ct:
+            print()
+            print(ct)
     if gate_msg:
         print()
         print(gate_msg)

@@ -702,3 +702,61 @@ def test_loadgen_saturation_nonpositive_knee_is_ok():
 
 def test_loadgen_saturation_registered_in_rule_set():
     assert rule_loadgen_saturation in RULES
+
+
+# --------------------------------------------------------------------------
+# rule: batch_mix (ISSUE 15 — the retune_batch action's sensor)
+# --------------------------------------------------------------------------
+
+def test_batch_mix_ok_without_pending_work():
+    from peasoup_tpu.serve.health import rule_batch_mix
+
+    ctx = _ctx([_sample("h0", NOW - 5.0)], pending_buckets={})
+    (f,) = rule_batch_mix(ctx)
+    assert f.severity == OK
+
+
+def test_batch_mix_warns_on_dominant_bucket_with_suggestion():
+    """A deep same-geometry bucket against batch=1 workers: warn with
+    the retune hint the supervisor's retune_batch action applies
+    (clamped to 8)."""
+    from peasoup_tpu.serve.health import rule_batch_mix
+
+    ctx = _ctx(
+        [_sample("h0", NOW - 5.0, gauges={"search.batch": 1})],
+        pending_buckets={"dm_end=20.0": 6, "dm_end=60.0": 1})
+    (f,) = rule_batch_mix(ctx)
+    assert f.severity == WARN
+    assert f.data["suggest_batch"] == 6
+    assert f.data["dominant_bucket"] == 6
+
+    # a 20-deep bucket suggests at most 8
+    ctx = _ctx(
+        [_sample("h0", NOW - 5.0, gauges={"search.batch": 1})],
+        pending_buckets={"dm_end=20.0": 20})
+    (f,) = rule_batch_mix(ctx)
+    assert f.severity == WARN and f.data["suggest_batch"] == 8
+
+
+def test_batch_mix_warns_on_fragmented_underfill():
+    """batch > 1 whose windowed mean fill collapsed: the batch wait is
+    pure overhead, suggest stepping down toward the measured fill."""
+    from peasoup_tpu.serve.health import rule_batch_mix
+
+    ctx = _ctx(
+        [_sample("h0", NOW - 5.0, gauges={"search.batch": 4},
+                 counters={"scheduler.batched_dispatches": 4,
+                           "scheduler.batch_fill": 4})],
+        pending_buckets={"a": 2, "b": 1})
+    (f,) = rule_batch_mix(ctx)
+    assert f.severity == WARN
+    assert f.data["suggest_batch"] == 1
+
+    # healthy fill at the same batch: ok
+    ctx = _ctx(
+        [_sample("h0", NOW - 5.0, gauges={"search.batch": 4},
+                 counters={"scheduler.batched_dispatches": 4,
+                           "scheduler.batch_fill": 14})],
+        pending_buckets={"a": 2, "b": 1})
+    (f,) = rule_batch_mix(ctx)
+    assert f.severity == OK

@@ -632,3 +632,209 @@ def test_drain_ledger_records_sojourn_percentiles(tmp_path):
     # the submit mark belongs to the submitter's ledger)
     assert m["timeline_marks"] >= 6
     assert m["timeline_overhead_s"] >= 0.0
+
+
+# --------------------------------------------------------------------------
+# admission control + fair share (ISSUE 15)
+# --------------------------------------------------------------------------
+
+
+def test_admission_knee_raises_typed_error(tmp_path):
+    """Past the backlog knee the spool refuses submits with a typed
+    AdmissionError (reason "backlog") instead of letting the queue
+    grow without bound."""
+    from peasoup_tpu.errors import AdmissionError
+    from peasoup_tpu.serve import AdmissionPolicy
+
+    spool = JobSpool(str(tmp_path / "jobs"),
+                     admission=AdmissionPolicy(max_pending=3))
+    for i in range(3):
+        spool.submit(f"/tmp/{i}.fil")
+    with pytest.warns(UserWarning, match="backlog"):
+        with pytest.raises(AdmissionError) as err:
+            spool.submit("/tmp/over.fil")
+    assert err.value.reason == "backlog"
+    assert spool.counts()["pending"] == 3  # the refused job never landed
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.admission_deferred"] == 1
+    # draining below the knee re-opens admission
+    spool.claim("w")
+    spool.submit("/tmp/ok-again.fil")
+
+
+def test_admission_token_bucket_injectable_clock(tmp_path):
+    """Per-tenant rate limit: burst tokens spend down, refill follows
+    the injected clock, and the typed error carries retry_after_s."""
+    from peasoup_tpu.errors import AdmissionError
+    from peasoup_tpu.serve import AdmissionPolicy, TenantPolicy
+
+    t = {"now": 1000.0}
+    spool = JobSpool(
+        str(tmp_path / "jobs"),
+        admission=AdmissionPolicy(tenants={
+            "flood": TenantPolicy(rate_per_s=1.0, burst=2.0),
+        }),
+        clock=lambda: t["now"])
+    spool.submit("/tmp/a.fil", tenant="flood")
+    spool.submit("/tmp/b.fil", tenant="flood")
+    with pytest.warns(UserWarning, match="token bucket"):
+        with pytest.raises(AdmissionError) as err:
+            spool.submit("/tmp/c.fil", tenant="flood")
+    assert err.value.reason == "rate_limit"
+    assert err.value.tenant == "flood"
+    assert err.value.retry_after_s > 0.0
+    # an unlimited tenant is never rate-limited
+    spool.submit("/tmp/science.fil", tenant="science")
+    # the bucket refills with the clock
+    t["now"] += 1.5
+    spool.submit("/tmp/c.fil", tenant="flood")
+    assert REGISTRY.snapshot()["counters"][
+        "scheduler.admission_rejected"] == 1
+
+
+def test_legacy_job_record_defaults_tenant(tmp_path):
+    """Records written before the tenant field loads as the default
+    tenant (rolling upgrade: old pending jobs stay claimable)."""
+    from peasoup_tpu.serve import DEFAULT_TENANT
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    rec = spool.submit("/tmp/x.fil")
+    path = os.path.join(spool.root, "pending", f"{rec.job_id}.json")
+    obj = json.load(open(path))
+    del obj["tenant"]
+    obj["some_future_field"] = "ignored"  # additions tolerated too
+    with open(path, "w") as f:
+        json.dump(obj, f)
+    (loaded,) = spool.pending_jobs()
+    assert loaded.tenant == DEFAULT_TENANT
+    assert spool.tenant_counts() == {
+        DEFAULT_TENANT: {"pending": 1, "running": 0, "done": 0,
+                         "failed": 0}}
+
+
+def test_fair_share_interleave_and_single_tenant_fifo(tmp_path):
+    """Weight-2 science gets two claims per flood claim within the
+    tier; a spool with one tenant keeps the historical FIFO order."""
+    from peasoup_tpu.serve import AdmissionPolicy, TenantPolicy
+
+    spool = JobSpool(
+        str(tmp_path / "jobs"),
+        admission=AdmissionPolicy(tenants={
+            "science": TenantPolicy(weight=2.0),
+            "flood": TenantPolicy(weight=1.0),
+        }))
+    sci = [spool.submit(f"/tmp/s{i}.fil", tenant="science")
+           for i in range(6)]
+    fld = [spool.submit(f"/tmp/f{i}.fil", tenant="flood")
+           for i in range(3)]
+    names = {r.job_id: r.tenant for r in sci + fld}
+    # science ranks (i+1)/2, flood (i+1)/1; rank ties go to the
+    # earlier submit (science here) -> two science claims per flood
+    order = [names[r.job_id] for r in spool.claim_order()]
+    assert order == ["science", "science", "flood",
+                     "science", "science", "flood",
+                     "science", "science", "flood"]
+
+    solo = JobSpool(str(tmp_path / "solo"))
+    subs = [solo.submit(f"/tmp/{i}.fil") for i in range(4)]
+    assert [r.job_id for r in solo.claim_order()] \
+        == [r.job_id for r in subs]
+
+
+# --------------------------------------------------------------------------
+# backoff jitter + abandoned-timeout accounting (ISSUE 15)
+# --------------------------------------------------------------------------
+
+
+def test_backoff_jitter_spreads_deterministically():
+    """Jittered delays stay inside [d*(1-j), d*(1+j)] (capped at
+    max_s), actually SPREAD (not constant), and reproduce exactly from
+    an injected rng.  jitter=0 keeps the exact legacy sequence."""
+    import random as _random
+
+    exact = BackoffPolicy(base_s=1.0, factor=2.0, max_s=60.0)
+    assert [exact.delay_for(k) for k in (1, 2, 3)] == [1.0, 2.0, 4.0]
+
+    def delays(seed):
+        pol = BackoffPolicy(base_s=1.0, factor=2.0, max_s=5.0,
+                            jitter=0.25, rng=_random.Random(seed))
+        return [pol.delay_for(k) for k in range(1, 6)]
+
+    a, b = delays(7), delays(7)
+    assert a == b  # reproducible from the seed
+    assert delays(8) != a  # different seed decorrelates
+    for k, d in enumerate(a, start=1):
+        nominal = min(1.0 * 2.0 ** (k - 1), 5.0)
+        assert nominal * 0.75 <= d <= min(nominal * 1.25, 5.0)
+    assert len(set(a)) > 1  # the jitter actually moves the delays
+
+
+def test_run_with_timeout_accounts_abandoned_thread():
+    """A timed-out attempt's thread cannot be cancelled, but it must
+    be visible: counter + typed event + a live count that prunes once
+    the zombie finishes."""
+    from peasoup_tpu.serve import abandoned_count
+    from peasoup_tpu.serve.retry import (
+        JobTimeoutError, run_with_timeout,
+    )
+
+    release = threading.Event()
+    with pytest.warns(UserWarning, match="timed out"):
+        with pytest.raises(JobTimeoutError, match="budget"):
+            run_with_timeout(lambda: release.wait(30.0), 0.05,
+                             label="job zombie-1")
+    assert abandoned_count() >= 1
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters["scheduler.timeout_abandoned"] == 1
+    assert counters["events.job_timeout_abandoned"] == 1
+
+    release.set()  # let the zombie finish; the count must prune
+    deadline = 50
+    while abandoned_count() > 0 and deadline > 0:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert abandoned_count() == 0
+
+
+# --------------------------------------------------------------------------
+# spool crash-consistency (ISSUE 15: fsync + torn records)
+# --------------------------------------------------------------------------
+
+
+def test_spool_durability_flag_and_env_escape_hatch(tmp_path,
+                                                    monkeypatch):
+    spool = JobSpool(str(tmp_path / "a"), durable=True)
+    assert spool.durable is True
+    rec = spool.submit("/tmp/x.fil")  # exercises the fsync path
+    assert spool.claim("w").job_id == rec.job_id
+    monkeypatch.setenv("PEASOUP_SPOOL_FSYNC", "0")
+    assert JobSpool(str(tmp_path / "b")).durable is False
+    monkeypatch.delenv("PEASOUP_SPOOL_FSYNC")
+    assert JobSpool(str(tmp_path / "c")).durable is True
+
+
+def test_torn_tmp_write_never_corrupts_state(tmp_path):
+    """A crash between the record tmp-write and its rename must leave
+    the spool consistent: the half-written tmp is invisible to every
+    lister and claimer, and the original record (when the crash was a
+    rewrite) survives untouched."""
+    spool = JobSpool(str(tmp_path / "jobs"), durable=True)
+    rec = spool.submit("/tmp/x.fil", {"dm_end": 25.0})
+
+    # crash mid-rewrite: a torn tmp next to the real record
+    pend = os.path.join(spool.root, "pending")
+    with open(os.path.join(pend, f"{rec.job_id}.json.tmp999"),
+              "w") as f:
+        f.write('{"v": 1, "job_id": "torn-half-wri')
+    # crash mid-submit: a torn tmp for a record that never landed
+    with open(os.path.join(pend, "neverborn.json.tmp42"), "w") as f:
+        f.write("{")
+
+    (only,) = spool.pending_jobs()
+    assert only.job_id == rec.job_id
+    assert only.overrides == {"dm_end": 25.0}
+    assert spool.counts()["pending"] == 1  # tmps are not records
+    job = spool.claim("w")
+    assert job.job_id == rec.job_id and job.attempts == 1
+    state, loaded = spool.get(rec.job_id)
+    assert state == "running" and loaded.overrides == {"dm_end": 25.0}
