@@ -13,8 +13,9 @@ LOADGEN_SMOKE_DIR ?= /tmp/peasoup-loadgen-smoke
 JERK_SMOKE_DIR ?= /tmp/peasoup-jerk-smoke
 SENSITIVITY_SMOKE_DIR ?= /tmp/peasoup-sensitivity-smoke
 CHAOS_SMOKE_DIR ?= /tmp/peasoup-chaos-smoke
+OBS_SMOKE_DIR ?= /tmp/peasoup-obs-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -152,3 +153,28 @@ sensitivity-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.chaos --smoke \
 	    --dir $(CHAOS_SMOKE_DIR)
+
+# flight-recorder smoke test (ISSUE 16): the obs verb family against
+# the checked-in fixtures — `obs diff` must regenerate the trace
+# summary from the two fixture run reports, `obs baseline` must pass
+# (exit 0) over the clean fixture ledger, and ingest/query/top must
+# round-trip every fixture stream through a scratch warehouse
+obs-smoke:
+	rm -rf $(OBS_SMOKE_DIR)
+	mkdir -p $(OBS_SMOKE_DIR)
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs diff \
+	    benchmarks/fixtures/run_report_r5.json \
+	    benchmarks/fixtures/run_report_r6.json \
+	    --out $(OBS_SMOKE_DIR)/trace_summary.md
+	cmp $(OBS_SMOKE_DIR)/trace_summary.md benchmarks/trace_summary_r6.md
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs baseline \
+	    --ledger benchmarks/fixtures/history_fixture.jsonl
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs ingest \
+	    --dir $(OBS_SMOKE_DIR)/warehouse \
+	    --report benchmarks/fixtures/run_report_r5.json \
+	    --report benchmarks/fixtures/run_report_r6.json \
+	    --ledger benchmarks/fixtures/history_fixture.jsonl
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs top \
+	    --dir $(OBS_SMOKE_DIR)/warehouse -n 5 --metric span.device_s
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs query \
+	    --dir $(OBS_SMOKE_DIR)/warehouse --stage peaks --limit 10

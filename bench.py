@@ -889,6 +889,31 @@ def main() -> None:
             },
             parity=out["parity"],
         ))
+        # flight recorder (ISSUE 16): regenerate the run-over-run
+        # trace summary mechanically from the last two bench records —
+        # benchmarks/trace_summary_r<N>.md is `peasoup-tpu obs diff`
+        # output, never hand-written prose
+        try:
+            from peasoup_tpu.obs.diff import (
+                diff_bench_records,
+                write_trace_summary,
+            )
+            from peasoup_tpu.obs.history import load_history
+
+            recs = [r for r in load_history(kinds=["bench"])
+                    if r.get("stage_device_s")]
+            if len(recs) >= 2:
+                spath = os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "benchmarks", f"trace_summary_r{len(recs)}.md")
+                write_trace_summary(
+                    spath, diff_bench_records(
+                        recs[-2], recs[-1],
+                        label_a=recs[-2].get("ts", "previous"),
+                        label_b=recs[-1].get("ts", "latest")))
+                print(f"wrote {spath}", file=sys.stderr)
+        except Exception as exc:  # a diff must never fail the bench
+            print(f"trace summary skipped: {exc!r}", file=sys.stderr)
     if "--gate" in sys.argv[1:]:
         from peasoup_tpu.tools.perf_report import main as gate_main
 

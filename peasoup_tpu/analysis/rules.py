@@ -18,6 +18,9 @@ PSL007   hand-written FLOP/byte/bandwidth constant outside
 PSL008   bare ``time.sleep`` outside ``serve/retry.py`` (scheduler
          waits must be bounded, classified and injectable — route
          them through the retry layer's BackoffPolicy/pause)
+PSL009   literal ``METRICS.inc``/``METRICS.gauge`` name missing from
+         ``obs/catalog.py`` (every metric name is a queryable
+         contract — an uncatalogued name is a dangling wire)
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -707,6 +710,65 @@ class NoBareSleepRule(Rule):
                 )
 
 
+# --------------------------------------------------------------------------
+# PSL009 — uncatalogued metric names
+# --------------------------------------------------------------------------
+
+#: receiver spellings of the metrics registry whose ``.inc``/``.gauge``
+#: this rule audits: the process-wide aliases plus any attribute or
+#: local that *is* a registry (``self._registry``, ``reg``)
+_METRIC_RECEIVERS = {"METRICS", "REGISTRY", "reg"}
+
+
+class MetricsCatalogRule(Rule):
+    """Every literal counter/gauge name must appear in
+    ``obs/catalog.py`` (:data:`~peasoup_tpu.obs.catalog.CATALOG`, or
+    match a documented :data:`~peasoup_tpu.obs.catalog.DYNAMIC_PREFIXES`
+    family).  The warehouse, the health rules and every dashboard
+    join on metric *names*; a name emitted in code but absent from
+    the catalog is a dangling wire nobody will ever query — and a
+    typo'd name is a silent fork of an existing series.  Dynamically
+    built names (f-strings) are exempt per call site but their prefix
+    must be catalogued as a family.  Deliberate exceptions carry a
+    ``# psl: disable=PSL009 -- reason`` pragma."""
+
+    id = "PSL009"
+    title = "metric name missing from obs/catalog.py"
+
+    def applies(self, relpath: str) -> bool:
+        if relpath == "peasoup_tpu/obs/catalog.py":
+            return False
+        return (relpath.startswith("peasoup_tpu/")
+                and relpath.endswith(".py"))
+
+    def run(self, sf: SourceFile):
+        from ..obs.catalog import is_cataloged
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _dotted(node.func)
+            parts = name.split(".")
+            if len(parts) < 2 or parts[-1] not in {"inc", "gauge"}:
+                continue
+            recv = parts[-2]
+            if recv not in _METRIC_RECEIVERS \
+                    and not recv.endswith("registry"):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue  # dynamic name: the prefix is the contract
+            if not is_cataloged(arg.value):
+                yield sf.violation(
+                    self.id, node,
+                    f"metric name {arg.value!r} is not in "
+                    f"peasoup_tpu/obs/catalog.py — add it to CATALOG "
+                    f"(or a DYNAMIC_PREFIXES family) so the name is "
+                    f"a queryable, documented contract",
+                )
+
+
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
     NoHostSyncInJitRule(),
@@ -716,6 +778,7 @@ ALL_RULES: tuple[Rule, ...] = (
     SpanApiRule(),
     CostModelAuthorityRule(),
     NoBareSleepRule(),
+    MetricsCatalogRule(),
 )
 
 

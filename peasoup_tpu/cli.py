@@ -216,6 +216,12 @@ def main(argv=None) -> int:
         return coincidencer_main(argv[1:])
     if argv and argv[0] == "accmap":
         return accmap_main(argv[1:])
+    # `peasoup-tpu obs query|top|tail|diff|baseline|ingest` — the
+    # flight-recorder verb family (ISSUE 16)
+    if argv and argv[0] == "obs":
+        from .obs.cli import main as obs_main
+
+        return obs_main(argv[1:])
     args = build_parser().parse_args(argv)
     cfg = args_to_config(args)
     if not args.no_compile_cache:

@@ -27,7 +27,16 @@ counted, logged and reported per run, so this package provides:
   ``perf`` section;
 * :mod:`.history` — the bench history ledger
   (``benchmarks/history.jsonl``) every benchmark entry point appends
-  to, read by ``python -m peasoup_tpu.tools.perf_report``.
+  to, read by ``python -m peasoup_tpu.tools.perf_report``;
+* :mod:`.warehouse` — the flight recorder (ISSUE 16): every stream
+  above flattened into ONE schema-versioned, append-only row store
+  keyed by (run, stage, geometry fingerprint, device kind, host);
+* :mod:`.baseline` — rolling robust (median/MAD) baselines per
+  warehouse key, emitting typed ``kind:"anomaly"`` records;
+* :mod:`.diff` — span-tree-aligned structural diff of two runs,
+  rendered as the generated ``trace_summary_rN.md``;
+* :mod:`.catalog` — the metrics catalog every literal
+  ``METRICS.inc``/``gauge`` name must appear in (lint rule PSL009).
 """
 
 from .metrics import REGISTRY, MetricsRegistry, install_compile_hook
@@ -49,6 +58,15 @@ from .costmodel import (
     record_run_costs,
 )
 from .history import append_history, load_history, make_history_record
+from .warehouse import Warehouse, geometry_fingerprint, host_rollup
+from .baseline import (
+    baseline_band,
+    baseline_table,
+    history_anomalies,
+    write_anomalies,
+)
+from .diff import diff_bench_records, diff_reports, render_markdown
+from .catalog import CATALOG, DYNAMIC_PREFIXES, is_cataloged
 
 __all__ = [
     "REGISTRY", "MetricsRegistry", "install_compile_hook",
@@ -58,4 +76,9 @@ __all__ = [
     "PipelineGeometry", "StageCost", "device_peak", "perf_section",
     "pipeline_costs", "record_run_costs",
     "append_history", "load_history", "make_history_record",
+    "Warehouse", "geometry_fingerprint", "host_rollup",
+    "baseline_band", "baseline_table", "history_anomalies",
+    "write_anomalies",
+    "diff_bench_records", "diff_reports", "render_markdown",
+    "CATALOG", "DYNAMIC_PREFIXES", "is_cataloged",
 ]

@@ -1,0 +1,278 @@
+"""Rolling robust baselines + typed anomaly records (ISSUE 16).
+
+The perf gate used to compare one headline metric against a fixed
+ratio; a slow per-stage drift, or a regression confined to one
+geometry or device kind, sailed under it.  This module keeps a
+*robust* baseline — median and MAD (median absolute deviation) — per
+warehouse key and flags departures as typed ``kind:"anomaly"``
+records that the history ledger, ``serve/health.py``'s ``anomaly``
+rule and ``tools/chaos.py`` all consume.
+
+Statistics, not vibes:
+
+* the center is the **median** (one historic outlier cannot poison
+  the baseline — pinned by the PR-4 gate tests);
+* the spread is the **MAD** scaled by 1.4826 (unbiased for a normal
+  distribution), so the band is ``median ± z·1.4826·MAD``;
+* a quiet history has MAD ≈ 0, which would flag noise — so every
+  band has an **absolute floor** (``floor_frac·|median|`` and/or
+  ``floor_abs``), giving the gate its fixed-threshold behaviour back
+  exactly when the history is too clean to estimate spread;
+* everything is a pure function of the record list — deterministic
+  given checked-in history, no wall clock anywhere.
+
+Anomaly record shape (version :data:`ANOMALY_VERSION`)::
+
+    {"v": 1, "kind": "anomaly", "ts": <from the offending record>,
+     "key": {"stage", "geometry", "device_kind", "host"},
+     "metric": ..., "value": ..., "median": ..., "mad": ...,
+     "band": ..., "z_score": ..., "severity": "warn"|"crit"}
+"""
+
+from __future__ import annotations
+
+from .warehouse import geometry_fingerprint
+
+#: scale factor making the MAD a consistent sigma estimator
+MAD_SCALE = 1.4826
+
+#: default z-score beyond which a point is anomalous
+DEFAULT_Z = 4.0
+
+#: default absolute floor as a fraction of |median| — the statistical
+#: band never collapses below this, so a near-constant history keeps
+#:  the old fixed-ratio behaviour
+DEFAULT_FLOOR_FRAC = 0.4
+
+#: z-score (in band units) past which an anomaly is "crit" not "warn"
+CRIT_BAND_FACTOR = 2.0
+
+ANOMALY_VERSION = 1
+ANOMALY_KIND = "anomaly"
+
+
+def median(values) -> float:
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return 0.0
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def robust_stats(values) -> tuple[float, float]:
+    """(median, MAD) of ``values``."""
+    med = median(values)
+    return med, median(abs(float(v) - med) for v in values)
+
+
+def baseline_band(values, *, z: float = DEFAULT_Z,
+                  floor_frac: float = DEFAULT_FLOOR_FRAC,
+                  floor_abs: float = 0.0) -> tuple[float, float]:
+    """(median, half-width) of the acceptance band around the
+    baseline: ``max(z·1.4826·MAD, floor_frac·|median|, floor_abs)``."""
+    med, mad = robust_stats(values)
+    half = max(float(z) * MAD_SCALE * mad,
+               float(floor_frac) * abs(med), float(floor_abs))
+    return med, half
+
+
+def _severity(excess: float, half: float) -> str:
+    return ("crit" if half > 0
+            and excess > CRIT_BAND_FACTOR * half else "warn")
+
+
+def make_anomaly(*, ts, key: dict, metric: str, value: float,
+                 med: float, mad: float, half: float,
+                 direction: str) -> dict:
+    sigma = MAD_SCALE * mad
+    excess = abs(float(value) - med)
+    return {
+        "v": ANOMALY_VERSION,
+        "kind": ANOMALY_KIND,
+        "ts": ts,
+        "key": {
+            "stage": str(key.get("stage", "")),
+            "geometry": str(key.get("geometry", "")),
+            "device_kind": str(key.get("device_kind", "")),
+            "host": str(key.get("host", "")),
+        },
+        "metric": str(metric),
+        "value": round(float(value), 6),
+        "median": round(med, 6),
+        "mad": round(mad, 6),
+        "band": round(half, 6),
+        "z_score": round(excess / sigma, 3) if sigma > 0 else None,
+        "direction": direction,
+        "severity": _severity(excess, half),
+    }
+
+
+def detect_point(value: float, window_values, *, ts, key: dict,
+                 metric: str, z: float = DEFAULT_Z,
+                 floor_frac: float = DEFAULT_FLOOR_FRAC,
+                 floor_abs: float = 0.0,
+                 higher_is_better: bool = False,
+                 min_n: int = 3) -> dict | None:
+    """Judge one head value against its trailing window; returns an
+    anomaly record or ``None``.  Fewer than ``min_n`` window points
+    means no baseline — vacuously healthy, never a guess."""
+    window_values = [float(v) for v in window_values]
+    if len(window_values) < int(min_n):
+        return None
+    med, half = baseline_band(window_values, z=z,
+                              floor_frac=floor_frac,
+                              floor_abs=floor_abs)
+    value = float(value)
+    if higher_is_better:
+        bad = value < med - half
+        direction = "low"
+    else:
+        bad = value > med + half
+        direction = "high"
+    if not bad:
+        return None
+    _, mad = robust_stats(window_values)
+    return make_anomaly(ts=ts, key=key, metric=metric, value=value,
+                        med=med, mad=mad, half=half,
+                        direction=direction)
+
+
+# --------------------------------------------------------------------------
+# history ledger: per-stage baselines across bench rounds
+# --------------------------------------------------------------------------
+
+def _history_key(rec: dict) -> tuple[str, str]:
+    cfg = rec.get("config", {}) or {}
+    geom = geometry_fingerprint(cfg.get("geometry", cfg))
+    kind = str((rec.get("device", {}) or {}).get("kind", ""))
+    return geom, kind
+
+#: per-stage absolute floor in seconds — micro-stages jitter by more
+#: than their MAD on a shared CI host; below this a delta is noise
+STAGE_FLOOR_S = 1e-3
+
+
+def history_anomalies(records, *, window: int = 8,
+                      z: float = DEFAULT_Z,
+                      floor_frac: float = DEFAULT_FLOOR_FRAC,
+                      floor_abs: float = STAGE_FLOOR_S,
+                      min_n: int = 3) -> list[dict]:
+    """Judge the NEWEST record of each (geometry, device kind) group
+    against its trailing window, per stage: the head's
+    ``stage_device_s[stage]`` outside the band yields exactly one
+    anomaly attributed to that (stage, geometry, device kind) key.
+
+    Pure and deterministic: same ledger in, same anomalies out."""
+    groups: dict[tuple, list[dict]] = {}
+    for rec in records:
+        if rec.get("stage_device_s"):
+            groups.setdefault(_history_key(rec), []).append(rec)
+    anomalies: list[dict] = []
+    for (geom, device_kind), recs in groups.items():
+        if len(recs) < int(min_n) + 1:
+            continue
+        head, trail = recs[-1], recs[-1 - int(window):-1]
+        for stage, value in sorted(head["stage_device_s"].items()):
+            series = [float(r["stage_device_s"][stage]) for r in trail
+                      if stage in r.get("stage_device_s", {})]
+            anom = detect_point(
+                value, series, ts=head.get("ts"),
+                key={"stage": stage, "geometry": geom,
+                     "device_kind": device_kind},
+                metric="stage_device_s", z=z, floor_frac=floor_frac,
+                floor_abs=floor_abs, min_n=min_n)
+            if anom is not None:
+                anomalies.append(anom)
+    return anomalies
+
+
+def baseline_table(records, *, window: int = 8,
+                   min_n: int = 3) -> list[dict]:
+    """Per-(stage, geometry, device kind) baseline summary rows for
+    ``obs baseline``: n, median, MAD, band and the latest value."""
+    groups: dict[tuple, list[dict]] = {}
+    for rec in records:
+        if rec.get("stage_device_s"):
+            groups.setdefault(_history_key(rec), []).append(rec)
+    table: list[dict] = []
+    for (geom, device_kind), recs in sorted(groups.items()):
+        stages = sorted({s for r in recs for s in r["stage_device_s"]})
+        for stage in stages:
+            series = [float(r["stage_device_s"][stage])
+                      for r in recs[-int(window) - 1:]
+                      if stage in r["stage_device_s"]]
+            if len(series) < int(min_n):
+                continue
+            med, half = baseline_band(series[:-1] or series,
+                                      floor_abs=STAGE_FLOOR_S)
+            _, mad = robust_stats(series[:-1] or series)
+            table.append({
+                "stage": stage, "geometry": geom,
+                "device_kind": device_kind, "n": len(series),
+                "median_s": round(med, 6), "mad_s": round(mad, 6),
+                "band_s": round(half, 6),
+                "last_s": round(series[-1], 6),
+            })
+    return table
+
+
+# --------------------------------------------------------------------------
+# telemetry shards: fleet-presence anomalies (the chaos window check)
+# --------------------------------------------------------------------------
+
+def fleet_presence_anomalies(ts_dir: str, *, t_start: float,
+                             t_end: float, bin_s: float = 1.0,
+                             z: float = DEFAULT_Z,
+                             floor_frac: float = 0.25,
+                             min_bins: int = 8) -> list[dict]:
+    """Anomalies in the *number of distinct hosts sampling* per time
+    bin over ``[t_start, t_end]`` — a killed worker's shard goes
+    silent, the fleet presence drops below its own baseline, and each
+    offending bin yields one ``kind:"anomaly"`` record (host key
+    ``"fleet"``).  Once the supervisor respawns capacity the presence
+    recovers and later bins are clean — exactly the emitted-then-
+    cleared shape ``tools/chaos.py`` asserts."""
+    from .telemetry import read_samples
+
+    t_start, t_end = float(t_start), float(t_end)
+    bin_s = max(0.1, float(bin_s))
+    n_bins = int((t_end - t_start) / bin_s)
+    if n_bins < int(min_bins):
+        return []
+    hosts_per_bin: list[set] = [set() for _ in range(n_bins)]
+    for sample in read_samples(ts_dir, since=t_start):
+        idx = int((float(sample.get("ts", 0.0)) - t_start) / bin_s)
+        if 0 <= idx < n_bins:
+            hosts_per_bin[idx].add(sample.get("host", ""))
+    counts = [float(len(hosts)) for hosts in hosts_per_bin]
+    anomalies: list[dict] = []
+    for idx, count in enumerate(counts):
+        window = counts[:idx] + counts[idx + 1:]
+        anom = detect_point(
+            count, window,
+            ts=round(t_start + (idx + 0.5) * bin_s, 3),
+            key={"stage": "presence", "host": "fleet"},
+            metric="fleet_hosts_sampling", z=z,
+            floor_frac=floor_frac, higher_is_better=True,
+            min_n=min_bins - 1)
+        if anom is not None:
+            anomalies.append(anom)
+    return anomalies
+
+
+# --------------------------------------------------------------------------
+# ledger plumbing
+# --------------------------------------------------------------------------
+
+def write_anomalies(anomalies, ledger_path: str) -> int:
+    """Append anomaly records to the history ledger verbatim (their
+    ``ts`` is the offending record's, NOT "now" — determinism), so
+    ``load_history(path, kinds=("anomaly",))`` and the health rule
+    see them."""
+    from .history import append_history
+
+    for anom in anomalies:
+        append_history(dict(anom), ledger_path)
+    return len(anomalies)

@@ -1,0 +1,282 @@
+"""The ``obs`` CLI verb family (ISSUE 16).
+
+``python -m peasoup_tpu.cli obs <verb>`` — the operator's door into
+the flight recorder:
+
+* ``obs ingest``  — flatten artifacts (run reports, the history
+  ledger, telemetry shards, timelines) into a warehouse directory;
+* ``obs query``   — filtered rows (run/stage/host/metric/source);
+* ``obs top``     — largest-valued rows for a metric prefix;
+* ``obs tail``    — most recent rows;
+* ``obs diff``    — structural diff of two run reports (or the last
+  two bench rounds of a ledger), rendered as markdown;
+* ``obs baseline`` — robust per-key baselines over a ledger plus any
+  anomalies the newest round trips.
+
+Exit codes: 0 ok; 1 when ``baseline`` finds anomalies (gate-shaped);
+2 on unusable inputs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _row_line(row: dict) -> str:
+    key = "/".join(p for p in (row.get("run"), row.get("stage"),
+                               row.get("host")) if p)
+    return (f"{row.get('ts', 0.0):>14.3f}  {row.get('source', ''):<9} "
+            f"{row.get('metric', ''):<28} "
+            f"{row.get('value', 0.0):>14.6f}  {key}")
+
+
+def _print_rows(rows, as_json: bool) -> None:
+    if as_json:
+        json.dump({"rows": rows}, sys.stdout, indent=1,
+                  sort_keys=True)
+        print()
+        return
+    for row in rows:
+        print(_row_line(row))
+    print(f"({len(rows)} row(s))")
+
+
+def _warehouse(args):
+    from .warehouse import Warehouse
+
+    return Warehouse(args.dir)
+
+
+def _filters(args) -> dict:
+    return {k: getattr(args, k) for k in
+            ("run", "stage", "host", "metric", "source")
+            if getattr(args, k, None)}
+
+
+def cmd_ingest(args) -> int:
+    from .history import load_history
+    from .warehouse import Warehouse
+
+    wh = Warehouse(args.dir)
+    total = 0
+    for path in args.report or []:
+        from .diff import load_report
+
+        try:
+            report = load_report(path)
+        except (OSError, ValueError) as exc:
+            print(f"obs ingest: cannot read report {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+        total += wh.ingest_run_report(report, run=args.run or path)
+    if args.ledger:
+        total += wh.ingest_history(load_history(args.ledger))
+    if args.ts_dir:
+        total += wh.ingest_telemetry(args.ts_dir)
+    if args.timeline:
+        total += wh.ingest_timeline(args.timeline,
+                                    run=args.run or "")
+    print(f"ingested {total} row(s) into {args.dir}")
+    return 0
+
+
+def cmd_query(args) -> int:
+    rows = _warehouse(args).rows(since=args.since, **_filters(args))
+    _print_rows(rows[:args.limit] if args.limit else rows,
+                args.json)
+    return 0
+
+
+def cmd_top(args) -> int:
+    rows = _warehouse(args).top(args.n, **_filters(args))
+    _print_rows(rows, args.json)
+    return 0
+
+
+def cmd_tail(args) -> int:
+    rows = _warehouse(args).tail(args.n, **_filters(args))
+    _print_rows(rows, args.json)
+    return 0
+
+
+def cmd_diff(args) -> int:
+    from .diff import (
+        diff_bench_records,
+        diff_reports,
+        load_report,
+        render_markdown,
+    )
+
+    if args.ledger:
+        from .history import load_history
+
+        recs = [r for r in load_history(args.ledger, kinds=("bench",))
+                if r.get("stage_device_s")]
+        if len(recs) < 2:
+            print("obs diff: need at least two bench records with "
+                  "stage_device_s in the ledger", file=sys.stderr)
+            return 2
+        diff = diff_bench_records(
+            recs[-2], recs[-1],
+            label_a=recs[-2].get("ts", "previous"),
+            label_b=recs[-1].get("ts", "latest"))
+    else:
+        if len(args.reports) != 2:
+            print("obs diff: need exactly two run-report paths "
+                  "(or --ledger)", file=sys.stderr)
+            return 2
+        try:
+            a = load_report(args.reports[0])
+            b = load_report(args.reports[1])
+        except (OSError, ValueError) as exc:
+            print(f"obs diff: {exc}", file=sys.stderr)
+            return 2
+        diff = diff_reports(a, b, label_a=args.reports[0],
+                            label_b=args.reports[1])
+    if args.json:
+        json.dump(diff, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        text = render_markdown(diff)
+        if args.out:
+            import os
+
+            tmp = args.out + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, args.out)
+            print(f"wrote {args.out}")
+        else:
+            print(text, end="")
+    return 0
+
+
+def cmd_baseline(args) -> int:
+    from .baseline import baseline_table, history_anomalies
+    from .history import load_history
+
+    records = load_history(args.ledger, kinds=("bench",))
+    table = baseline_table(records, window=args.window)
+    anomalies = history_anomalies(records, window=args.window,
+                                  z=args.z,
+                                  floor_frac=args.floor_frac)
+    if args.json:
+        json.dump({"baselines": table, "anomalies": anomalies},
+                  sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        if table:
+            print(f"{'stage':<14} {'device kind':<14} {'n':>3} "
+                  f"{'median_s':>10} {'band_s':>10} {'last_s':>10}")
+            for row in table:
+                print(f"{row['stage']:<14} "
+                      f"{row['device_kind'] or '-':<14} "
+                      f"{row['n']:>3} {row['median_s']:>10.4f} "
+                      f"{row['band_s']:>10.4f} "
+                      f"{row['last_s']:>10.4f}")
+        else:
+            print("no bench records with stage_device_s in "
+                  f"{args.ledger!r}")
+        for anom in anomalies:
+            key = anom["key"]
+            print(f"ANOMALY {key['stage']} "
+                  f"[{key['device_kind'] or '-'}/"
+                  f"{key['geometry'] or '-'}]: "
+                  f"{anom['value']:.4f}s vs median "
+                  f"{anom['median']:.4f}s +/- {anom['band']:.4f}s "
+                  f"({anom['severity']})")
+    if anomalies and args.write_ledger:
+        from .baseline import write_anomalies
+
+        write_anomalies(anomalies, args.ledger)
+        print(f"appended {len(anomalies)} anomaly record(s) to "
+              f"{args.ledger}")
+    return 1 if anomalies else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="peasoup obs",
+        description="Peasoup-TPU flight recorder: query the unified "
+                    "observability warehouse")
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    def common(sp):
+        sp.add_argument("--dir", default="warehouse",
+                        help="warehouse directory")
+        sp.add_argument("--run", default=None)
+        sp.add_argument("--stage", default=None)
+        sp.add_argument("--host", default=None)
+        sp.add_argument("--metric", default=None,
+                        help="metric name prefix")
+        sp.add_argument("--source", default=None,
+                        help="report|span|roofline|history|telemetry"
+                             "|timeline")
+        sp.add_argument("--json", action="store_true")
+
+    sp = sub.add_parser("ingest", help="flatten artifacts into the "
+                                       "warehouse")
+    sp.add_argument("--dir", default="warehouse")
+    sp.add_argument("--report", action="append",
+                    help="run_report.json path (repeatable)")
+    sp.add_argument("--ledger", default=None,
+                    help="history.jsonl to ingest")
+    sp.add_argument("--ts-dir", default=None,
+                    help="fleet/ telemetry shard dir to ingest")
+    sp.add_argument("--timeline", default=None,
+                    help="timeline.jsonl (or its workdir) to ingest")
+    sp.add_argument("--run", default=None,
+                    help="run id to stamp on ingested report rows")
+    sp.set_defaults(fn=cmd_ingest)
+
+    sp = sub.add_parser("query", help="filtered warehouse rows")
+    common(sp)
+    sp.add_argument("--since", type=float, default=None,
+                    help="epoch-seconds lower bound")
+    sp.add_argument("--limit", type=int, default=0)
+    sp.set_defaults(fn=cmd_query)
+
+    sp = sub.add_parser("top", help="largest-valued rows")
+    common(sp)
+    sp.add_argument("-n", type=int, default=10)
+    sp.set_defaults(fn=cmd_top)
+
+    sp = sub.add_parser("tail", help="most recent rows")
+    common(sp)
+    sp.add_argument("-n", type=int, default=10)
+    sp.set_defaults(fn=cmd_tail)
+
+    sp = sub.add_parser("diff", help="structural diff of two runs")
+    sp.add_argument("reports", nargs="*",
+                    help="two run_report.json paths")
+    sp.add_argument("--ledger", default=None,
+                    help="diff the last two bench rounds of this "
+                         "ledger instead")
+    sp.add_argument("--out", default=None,
+                    help="write markdown here instead of stdout")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_diff)
+
+    sp = sub.add_parser("baseline", help="robust per-key baselines "
+                                         "+ anomalies")
+    sp.add_argument("--ledger", default="benchmarks/history.jsonl")
+    sp.add_argument("--window", type=int, default=8)
+    sp.add_argument("--z", type=float, default=4.0)
+    sp.add_argument("--floor-frac", type=float, default=0.4)
+    sp.add_argument("--write-ledger", action="store_true",
+                    help="append found anomalies to the ledger as "
+                         "kind:\"anomaly\" records")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_baseline)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

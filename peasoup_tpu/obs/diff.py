@@ -1,0 +1,269 @@
+"""Span-tree-aligned structural diff of two runs (ISSUE 16).
+
+``benchmarks/trace_summary_r*.md`` used to be written by a human
+reading two profiler captures side by side.  The figures it quotes —
+per-op device-time deltas, which ops appeared/vanished, compile-count
+movement, the wall-clock line — are all mechanical joins over data
+the run reports already carry, so this module computes them:
+
+* :func:`diff_reports` — align two ``run_report.json`` documents on
+  span name / stage name and produce per-entry host+device deltas,
+  jit compile-count deltas, roofline-utilization deltas and
+  candidate-set deltas;
+* :func:`diff_bench_records` — the same join over two history-ledger
+  bench records (``stage_device_s`` / ``compile_counts`` /
+  ``utilization`` / headline metrics);
+* :func:`render_markdown` — the trace-summary-shaped markdown that
+  ``bench.py`` now writes as ``trace_summary_rN.md`` automatically
+  and ``peasoup obs diff`` prints.
+
+Everything is a pure function of the two input documents — no clock,
+no globals — so a diff of two checked-in fixtures is reproducible
+byte for byte.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .warehouse import geometry_fingerprint
+
+DIFF_VERSION = 1
+
+
+def load_report(path: str) -> dict:
+    """Load one ``run_report.json`` (raises on a missing/corrupt file:
+    the CLI turns this into a clean exit 2)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path!r}: not a run report (not an object)")
+    return doc
+
+
+def _entry_diff(a: dict, b: dict, field: str) -> dict:
+    va = float(a.get(field, 0.0) or 0.0)
+    vb = float(b.get(field, 0.0) or 0.0)
+    out = {
+        "a": round(va, 6), "b": round(vb, 6),
+        "delta": round(vb - va, 6),
+        "count_a": int(a.get("count", 0)),
+        "count_b": int(b.get("count", 0)),
+    }
+    if va > 0:
+        out["ratio"] = round(vb / va, 4)
+    elif vb > 0:
+        out["new"] = True
+    return out
+
+
+def _table_diff(ta: dict, tb: dict, field: str) -> dict:
+    """Align two name->record tables on name; deltas for ``field``,
+    ordered by descending |delta| with a name tiebreak — fully
+    deterministic, so the rendered summary is byte-reproducible
+    regardless of hash seeds."""
+    ta, tb = ta or {}, tb or {}
+    names = sorted(set(ta) | set(tb))
+    rows = {name: _entry_diff(ta.get(name, {}), tb.get(name, {}),
+                              field)
+            for name in names}
+    return dict(sorted(rows.items(),
+                       key=lambda kv: (-abs(kv[1]["delta"]), kv[0])))
+
+
+def _scalar_diff(va, vb, ndigits: int = 6) -> dict:
+    va = float(va or 0.0)
+    vb = float(vb or 0.0)
+    out = {"a": round(va, ndigits), "b": round(vb, ndigits),
+           "delta": round(vb - va, ndigits)}
+    if va > 0:
+        out["ratio"] = round(vb / va, 4)
+    return out
+
+
+def diff_reports(a: dict, b: dict, *, label_a: str = "a",
+                 label_b: str = "b") -> dict:
+    """Structural diff of two run reports (see module docstring)."""
+    perf_a = a.get("perf", {}) or {}
+    perf_b = b.get("perf", {}) or {}
+    util_a = {s: r.get("utilization") for s, r in
+              (perf_a.get("stages", {}) or {}).items()
+              if r.get("utilization") is not None}
+    util_b = {s: r.get("utilization") for s, r in
+              (perf_b.get("stages", {}) or {}).items()
+              if r.get("utilization") is not None}
+    fp_a = geometry_fingerprint(perf_a.get("geometry"))
+    fp_b = geometry_fingerprint(perf_b.get("geometry"))
+    kinds = [d.get("kind", "") for d in
+             (a.get("device", {}) or {}).get("devices", [])]
+    kinds_b = [d.get("kind", "") for d in
+               (b.get("device", {}) or {}).get("devices", [])]
+    return {
+        "v": DIFF_VERSION,
+        "labels": [str(label_a), str(label_b)],
+        "e2e_s": _scalar_diff((a.get("timers", {}) or {}).get("total"),
+                              (b.get("timers", {}) or {}).get("total")),
+        "spans": _table_diff(a.get("spans"), b.get("spans"),
+                             "device_s"),
+        "stages": _table_diff(a.get("stage_timers"),
+                              b.get("stage_timers"), "device_s"),
+        "stages_host": _table_diff(a.get("stage_timers"),
+                                   b.get("stage_timers"), "host_s"),
+        "compiles": _scalar_diff(
+            (a.get("jit", {}) or {}).get("backend_compiles"),
+            (b.get("jit", {}) or {}).get("backend_compiles")),
+        "compile_s": _scalar_diff(
+            (a.get("jit", {}) or {}).get("compile_s"),
+            (b.get("jit", {}) or {}).get("compile_s")),
+        "utilization": {
+            s: _scalar_diff(util_a.get(s), util_b.get(s))
+            for s in sorted(set(util_a) | set(util_b))},
+        "candidates": _scalar_diff(
+            (a.get("candidates", {}) or {}).get("count"),
+            (b.get("candidates", {}) or {}).get("count")),
+        "geometry": {"a": fp_a, "b": fp_b, "same": fp_a == fp_b},
+        "device_kind": {"a": kinds[0] if kinds else "",
+                        "b": kinds_b[0] if kinds_b else ""},
+    }
+
+
+def diff_bench_records(a: dict, b: dict, *, label_a: str = "a",
+                       label_b: str = "b") -> dict:
+    """The same structural diff over two history-ledger records
+    (bench rounds): ``stage_device_s``, ``compile_counts``,
+    ``utilization`` and the headline ``e2e_s`` metric."""
+    sa = {s: {"device_s": v}
+          for s, v in (a.get("stage_device_s", {}) or {}).items()}
+    sb = {s: {"device_s": v}
+          for s, v in (b.get("stage_device_s", {}) or {}).items()}
+    fp_a = geometry_fingerprint(
+        (a.get("config", {}) or {}).get("geometry",
+                                        a.get("config", {})))
+    fp_b = geometry_fingerprint(
+        (b.get("config", {}) or {}).get("geometry",
+                                        b.get("config", {})))
+    util_a = a.get("utilization", {}) or {}
+    util_b = b.get("utilization", {}) or {}
+    return {
+        "v": DIFF_VERSION,
+        "labels": [str(label_a), str(label_b)],
+        "e2e_s": _scalar_diff(
+            (a.get("metrics", {}) or {}).get("e2e_s"),
+            (b.get("metrics", {}) or {}).get("e2e_s")),
+        "spans": {},
+        "stages": _table_diff(sa, sb, "device_s"),
+        "stages_host": {},
+        "compiles": _scalar_diff(
+            (a.get("compile_counts", {}) or {}).get("timed"),
+            (b.get("compile_counts", {}) or {}).get("timed")),
+        "compile_s": _scalar_diff(0.0, 0.0),
+        "utilization": {
+            s: _scalar_diff(util_a.get(s), util_b.get(s))
+            for s in sorted(set(util_a) | set(util_b))},
+        "candidates": _scalar_diff(0.0, 0.0),
+        "geometry": {"a": fp_a, "b": fp_b, "same": fp_a == fp_b},
+        "device_kind": {
+            "a": (a.get("device", {}) or {}).get("kind", ""),
+            "b": (b.get("device", {}) or {}).get("kind", "")},
+    }
+
+
+# --------------------------------------------------------------------------
+# markdown rendering (the generated trace_summary_rN.md)
+# --------------------------------------------------------------------------
+
+def _ms(seconds: float) -> str:
+    return f"{float(seconds) * 1e3:.1f}"
+
+
+def _fmt_ratio(row: dict) -> str:
+    if row.get("new"):
+        return "new"
+    if "ratio" in row:
+        return f"{row['ratio']:.2f}x"
+    return "-"
+
+
+def _movers_table(rows: dict, heading: str, out: list,
+                  limit: int = 12) -> None:
+    rows = {name: row for name, row in rows.items()
+            if row["a"] or row["b"]}
+    if not rows:
+        return
+    out.append(heading)
+    out.append("")
+    out.append("| ms (a) | ms (b) | delta ms | ratio | count a->b "
+               "| name |")
+    out.append("|---|---|---|---|---|---|")
+    for name, row in list(rows.items())[:limit]:
+        out.append(
+            f"| {_ms(row['a'])} | {_ms(row['b'])} "
+            f"| {float(row['delta']) * 1e3:+.1f} | {_fmt_ratio(row)} "
+            f"| {row['count_a']}->{row['count_b']} | {name} |")
+    out.append("")
+
+
+def render_markdown(diff: dict, *, title: str | None = None) -> str:
+    """Render one structural diff as a trace-summary-shaped markdown
+    document (deterministic: pure function of the diff)."""
+    la, lb = diff.get("labels", ["a", "b"])
+    out: list[str] = []
+    out.append(title or f"# Run-to-run diff: {la} -> {lb}")
+    out.append("")
+    out.append(f"Generated by `peasoup obs diff` "
+               f"(schema v{diff.get('v', DIFF_VERSION)}).")
+    out.append("")
+    e2e = diff.get("e2e_s", {})
+    if e2e.get("a") or e2e.get("b"):
+        ratio = f", {e2e['ratio']:.2f}x" if "ratio" in e2e else ""
+        out.append(f"Wall-clock e2e: {e2e['a']:.3f} s -> "
+                   f"{e2e['b']:.3f} s ({e2e['delta']:+.3f} s{ratio})")
+    comp = diff.get("compiles", {})
+    out.append(f"Backend compiles: {comp.get('a', 0):.0f} -> "
+               f"{comp.get('b', 0):.0f} "
+               f"({comp.get('delta', 0):+.0f})")
+    geom = diff.get("geometry", {})
+    if geom:
+        note = ("same geometry"
+                if geom.get("same") else "GEOMETRY CHANGED")
+        out.append(f"Geometry: {geom.get('a') or '-'} -> "
+                   f"{geom.get('b') or '-'} ({note})")
+    dev = diff.get("device_kind", {})
+    if dev.get("a") or dev.get("b"):
+        out.append(f"Device: {dev.get('a') or '-'} -> "
+                   f"{dev.get('b') or '-'}")
+    out.append("")
+    _movers_table(diff.get("spans", {}),
+                  "Top device-time movers (span table):", out)
+    _movers_table(diff.get("stages", {}),
+                  "Per-stage device time:", out)
+    util = {s: row for s, row in diff.get("utilization", {}).items()
+            if row.get("a") or row.get("b")}
+    if util:
+        out.append("Roofline utilization:")
+        out.append("")
+        out.append("| stage | util (a) | util (b) | delta |")
+        out.append("|---|---|---|---|")
+        for stage, row in util.items():
+            out.append(f"| {stage} | {row['a']:.3f} | {row['b']:.3f} "
+                       f"| {row['delta']:+.3f} |")
+        out.append("")
+    cand = diff.get("candidates", {})
+    if cand.get("a") or cand.get("b"):
+        out.append(f"Candidates: {cand['a']:.0f} -> {cand['b']:.0f} "
+                   f"({cand['delta']:+.0f})")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def write_trace_summary(path: str, diff: dict, *,
+                        title: str | None = None) -> str:
+    """Write the rendered markdown atomically; returns the path."""
+    import os
+
+    text = render_markdown(diff, title=title)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path

@@ -54,6 +54,12 @@ FLOOD_LIMIT = 20
 #: flood-window length in seconds
 FLOOD_WINDOW_S = 60.0
 
+#: rotate the on-disk log past this size (ISSUE 16): the live file is
+#: renamed to ``<path>.1`` (dropping any previous generation) —
+#: the same bounded-disk scheme as the ``ts-<host>.jsonl`` telemetry
+#: shards and the warehouse segments
+DEFAULT_MAX_LOG_BYTES = 1024 * 1024
+
 
 def _json_safe(value):
     """Best-effort conversion of numpy scalars/arrays and misc objects
@@ -86,8 +92,10 @@ class EventLog:
     def __init__(self, path: str = "", registry=None, *,
                  flood_limit: int = FLOOD_LIMIT,
                  flood_window_s: float = FLOOD_WINDOW_S,
+                 max_log_bytes: int = DEFAULT_MAX_LOG_BYTES,
                  clock=time.time):
         self.path = path or ""
+        self.max_log_bytes = int(max_log_bytes)
         self._registry = registry if registry is not None else REGISTRY
         self._lock = threading.Lock()
         self._file = None
@@ -137,6 +145,30 @@ class EventLog:
                      "window_s": self.flood_window_s},
         }
 
+    def _maybe_rotate(self) -> None:
+        """Rotate the live log to ``<path>.1`` past the byte budget
+        (one retained generation, like the telemetry shards) so a
+        long-lived worker bounds its per-job/event disk footprint.
+        Caller holds the lock; errors are swallowed (a stat race must
+        not kill the emitting run)."""
+        if self.max_log_bytes <= 0:
+            return
+        try:
+            if os.path.getsize(self.path) < self.max_log_bytes:
+                return
+        except OSError:
+            return  # no file yet
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+
     def emit(self, kind: str, message: str = "", **fields) -> dict:
         """Record one typed event; returns the record written."""
         kind = str(kind)
@@ -154,6 +186,7 @@ class EventLog:
             persist, summary = self._flood_admit(kind, now)
             if self.path and not self._io_failed:
                 try:
+                    self._maybe_rotate()
                     if self._file is None:
                         d = os.path.dirname(self.path)
                         if d:
@@ -202,16 +235,19 @@ def get_event_log() -> EventLog:
     return _LOG
 
 
-def configure_event_log(path: str) -> EventLog:
+def configure_event_log(path: str, *,
+                        max_log_bytes: int = DEFAULT_MAX_LOG_BYTES
+                        ) -> EventLog:
     """Point the process-wide event log at ``path`` (e.g. the CLI's
     ``<outdir>/events.jsonl``).  Replaces the previous sink; already-
     emitted events are not rewritten.  The file is created immediately
     (even if no event ever fires) so "clean run" and "no log
-    configured" are distinguishable artefacts."""
+    configured" are distinguishable artefacts.  ``max_log_bytes``
+    bounds the on-disk size via ``.1`` rotation (0 disables)."""
     global _LOG
     with _global_lock:
         _LOG.close()
-        _LOG = EventLog(path)
+        _LOG = EventLog(path, max_log_bytes=max_log_bytes)
         if path:
             try:
                 d = os.path.dirname(path)

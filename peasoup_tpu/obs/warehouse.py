@@ -1,0 +1,547 @@
+"""The flight recorder's system of record (ISSUE 16).
+
+PRs 1/11/12/14 left five disjoint artifact streams on disk —
+``run_report.json`` (per run), the span table inside it, per-job
+``timeline.jsonl`` marks, per-host ``fleet/ts-<host>.jsonl``
+telemetry shards and the ``benchmarks/history.jsonl`` ledger — and
+every cross-run question ("why is r07 slower than r06?") required a
+human to open all five.  This module ingests each stream into ONE
+schema-versioned, append-only store of flat *rows*, keyed by
+
+    (run id, stage, geometry fingerprint, device kind, host)
+
+so :mod:`.baseline` can maintain robust per-key baselines and
+:mod:`.diff` can align any two runs structurally (the Dapper
+trace-aggregation shape: raw spans below, queryable rollups above).
+
+Design rules, inherited from the telemetry plane:
+
+* **Append-only segments with bounded disk.**  Rows land in
+  ``segment.jsonl``; once it exceeds ``max_segment_bytes`` it is
+  *sealed* by renaming to ``segment.jsonl.1`` (dropping any previous
+  sealed generation) — byte-for-byte the ``ts-<host>.jsonl``
+  ``.1``-generation scheme from :mod:`.telemetry`, so a long-lived
+  fleet's warehouse occupies at most two segment files.
+* **Torn lines are skipped, never fatal** (a killed writer must not
+  poison later readers); lines with ``v`` *newer* than
+  :data:`WAREHOUSE_VERSION` are skipped and counted, and the reader
+  emits one counted ``warehouse_schema_skew`` warn_event per read —
+  old readers degrade gracefully against new writers.
+* **Merged ordering is by row timestamp**, not file order, so rows
+  ingested from hosts with skewed clocks interleave deterministically
+  (stable sort on ``(ts, host, source, metric)``).
+* **The index is derived state.**  ``index.json`` summarises per-run
+  row counts / time spans for ``obs query``; it is rebuilt from the
+  segments whenever it is missing or stale, never trusted blindly.
+
+I/O failures degrade to a warning + latched no-op, like the sampler:
+the warehouse must never kill the run it is recording.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+
+#: schema version stamped on every row; readers skip (and count)
+#: rows from the future
+WAREHOUSE_VERSION = 1
+
+#: seal (rotate) the live segment past this size — same default scale
+#: as the telemetry shards
+DEFAULT_MAX_SEGMENT_BYTES = 4 * 1024 * 1024
+
+SEGMENT_BASENAME = "segment.jsonl"
+INDEX_BASENAME = "index.json"
+
+#: unicode ramp shared by ``status --watch`` and ``perf_report``
+SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 24) -> str:
+    """Render ``values`` as a fixed-height unicode sparkline."""
+    vals = [float(v) for v in values][-int(width):]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return SPARK_BLOCKS[0] * len(vals)
+    scale = (len(SPARK_BLOCKS) - 1) / (hi - lo)
+    return "".join(SPARK_BLOCKS[int((v - lo) * scale)] for v in vals)
+
+
+def geometry_fingerprint(geometry) -> str:
+    """Stable short fingerprint of a geometry (or any config) dict —
+    the key component that lets baselines refuse to compare runs of
+    different problem shapes."""
+    if not geometry:
+        return ""
+    blob = json.dumps(geometry, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def _iso_to_epoch(ts) -> float | None:
+    """Parse the ledger/report ISO-8601 UTC stamp to epoch seconds."""
+    if isinstance(ts, (int, float)):
+        return float(ts)
+    if not ts:
+        return None
+    try:
+        import datetime
+
+        s = str(ts).replace("Z", "+00:00")
+        dt = datetime.datetime.fromisoformat(s)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
+    except ValueError:
+        return None
+
+
+def make_row(*, ts: float, run: str, source: str, metric: str,
+             value: float, stage: str = "", geometry: str = "",
+             device_kind: str = "", host: str = "",
+             data: dict | None = None) -> dict:
+    """One warehouse row.  ``(run, stage, geometry, device_kind,
+    host)`` is the key; ``metric``/``value`` the measurement."""
+    row = {
+        "v": WAREHOUSE_VERSION,
+        "ts": round(float(ts), 6),
+        "run": str(run),
+        "source": str(source),
+        "stage": str(stage),
+        "geometry": str(geometry),
+        "device_kind": str(device_kind),
+        "host": str(host),
+        "metric": str(metric),
+        "value": float(value),
+    }
+    if data:
+        row["data"] = data
+    return row
+
+
+def row_key(row: dict) -> tuple:
+    """The warehouse key of a row (run id excluded: baselines compare
+    the same (stage, geometry, device kind, host) *across* runs)."""
+    return (row.get("stage", ""), row.get("geometry", ""),
+            row.get("device_kind", ""), row.get("host", ""))
+
+
+class Warehouse:
+    """One warehouse directory: live + sealed segment, index."""
+
+    def __init__(self, root: str, *,
+                 max_segment_bytes: int = DEFAULT_MAX_SEGMENT_BYTES,
+                 clock=time.time):
+        self.root = str(root)
+        self.max_segment_bytes = int(max_segment_bytes)
+        self._clock = clock
+        self._io_failed = False
+        #: per-read skip statistics ({"torn": n, "skew": n}), for
+        #: tests and the CLI's footer line
+        self.last_skipped = {"torn": 0, "skew": 0}
+
+    # -- paths -------------------------------------------------------------
+
+    @property
+    def segment_path(self) -> str:
+        return os.path.join(self.root, SEGMENT_BASENAME)
+
+    @property
+    def index_path(self) -> str:
+        return os.path.join(self.root, INDEX_BASENAME)
+
+    def _generations(self) -> list[str]:
+        """Sealed-then-live segment paths, oldest first."""
+        return [self.segment_path + ".1", self.segment_path]
+
+    # -- writing -----------------------------------------------------------
+
+    def append_rows(self, rows) -> int:
+        """Append rows to the live segment (sealing it first if it has
+        outgrown the budget); returns the number written.  Never
+        raises on I/O failure — warns once and latches off, like the
+        telemetry sampler."""
+        rows = [r for r in rows if r.get("metric")]
+        if not rows or self._io_failed:
+            return 0
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            self._maybe_seal()
+            with open(self.segment_path, "a") as f:
+                for row in rows:
+                    row.setdefault("v", WAREHOUSE_VERSION)
+                    f.write(json.dumps(row, sort_keys=True) + "\n")
+            self._update_index(rows)
+        except OSError as exc:
+            self._io_failed = True
+            from .events import warn_event
+
+            warn_event("warehouse_io_failed",
+                       f"warehouse disabled: could not write "
+                       f"{self.segment_path!r}: {exc}",
+                       path=self.segment_path)
+            return 0
+        return len(rows)
+
+    def _maybe_seal(self) -> None:
+        """Seal the live segment once it exceeds the byte budget —
+        the ``ts-<host>.jsonl`` ``.1`` scheme: at most one sealed
+        generation is retained, so disk stays bounded at roughly
+        ``2 * max_segment_bytes``."""
+        try:
+            if os.path.getsize(self.segment_path) \
+                    >= self.max_segment_bytes:
+                os.replace(self.segment_path, self.segment_path + ".1")
+        except OSError:
+            pass  # no live segment yet
+
+    # -- index -------------------------------------------------------------
+
+    def _update_index(self, new_rows) -> None:
+        index = self._load_index()
+        runs = index.setdefault("runs", {})
+        for row in new_rows:
+            ent = runs.setdefault(row.get("run", ""), {
+                "rows": 0, "ts_min": row["ts"], "ts_max": row["ts"],
+                "sources": []})
+            ent["rows"] += 1
+            ent["ts_min"] = min(ent["ts_min"], row["ts"])
+            ent["ts_max"] = max(ent["ts_max"], row["ts"])
+            if row.get("source") and row["source"] not in ent["sources"]:
+                ent["sources"] = sorted(
+                    set(ent["sources"]) | {row["source"]})
+        index["rows_total"] = index.get("rows_total", 0) + len(new_rows)
+        tmp = self.index_path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(index, f, sort_keys=True)
+        os.replace(tmp, self.index_path)
+
+    def _load_index(self) -> dict:
+        try:
+            with open(self.index_path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict):
+                return doc
+        except (OSError, ValueError):
+            pass
+        return {"v": WAREHOUSE_VERSION, "runs": {}, "rows_total": 0}
+
+    def index(self) -> dict:
+        """The per-run index (rebuilt from segments if missing)."""
+        doc = self._load_index()
+        if not doc.get("runs") and any(
+                os.path.exists(p) for p in self._generations()):
+            return self.reindex()
+        return doc
+
+    def reindex(self) -> dict:
+        """Rebuild ``index.json`` from the segment files."""
+        try:
+            os.remove(self.index_path)
+        except OSError:
+            pass
+        rows = self.rows()
+        if rows:
+            try:
+                self._update_index(rows)
+            except OSError:
+                pass
+        return self._load_index()
+
+    # -- reading -----------------------------------------------------------
+
+    def rows(self, *, run: str | None = None, stage: str | None = None,
+             host: str | None = None, metric: str | None = None,
+             source: str | None = None,
+             since: float | None = None) -> list[dict]:
+        """All matching rows from sealed + live segments, merged in
+        timestamp order (cross-host clock skew tolerated: ordering is
+        by the rows' own ``ts``, with a deterministic tiebreak).
+
+        Torn/corrupt lines are skipped silently; rows stamped with a
+        *newer* schema version are skipped and counted, and one
+        ``warehouse_schema_skew`` warn_event carries the count."""
+        out: list[dict] = []
+        torn = skew = 0
+        for path in self._generations():
+            try:
+                with open(path) as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            for line in raw.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    continue
+                if not isinstance(row, dict) or "ts" not in row:
+                    torn += 1
+                    continue
+                if int(row.get("v", 0)) > WAREHOUSE_VERSION:
+                    skew += 1
+                    continue
+                out.append(row)
+        self.last_skipped = {"torn": torn, "skew": skew}
+        if skew:
+            from .events import warn_event
+
+            warn_event("warehouse_schema_skew",
+                       f"skipped {skew} warehouse row(s) newer than "
+                       f"schema v{WAREHOUSE_VERSION} (reader too old)",
+                       skipped=skew, reader_version=WAREHOUSE_VERSION)
+        if run is not None:
+            out = [r for r in out if r.get("run") == run]
+        if stage is not None:
+            out = [r for r in out if r.get("stage") == stage]
+        if host is not None:
+            out = [r for r in out if r.get("host") == host]
+        if source is not None:
+            out = [r for r in out if r.get("source") == source]
+        if metric is not None:
+            out = [r for r in out
+                   if str(r.get("metric", "")).startswith(metric)]
+        if since is not None:
+            out = [r for r in out if r.get("ts", 0.0) >= since]
+        out.sort(key=lambda r: (r.get("ts", 0.0), r.get("host", ""),
+                                r.get("source", ""), r.get("metric", "")))
+        return out
+
+    def top(self, n: int = 10, **filters) -> list[dict]:
+        """The ``n`` largest-valued rows matching ``filters``."""
+        rows = self.rows(**filters)
+        rows.sort(key=lambda r: -r.get("value", 0.0))
+        return rows[:max(0, int(n))]
+
+    def tail(self, n: int = 10, **filters) -> list[dict]:
+        """The ``n`` most recent rows matching ``filters``."""
+        rows = self.rows(**filters)
+        return rows[-max(0, int(n)):]
+
+    # -- ingest: run reports ----------------------------------------------
+
+    def ingest_run_report(self, report: dict, *, run: str = "",
+                          host: str = "") -> int:
+        """Flatten one ``run_report.json`` (schema v2) into rows:
+        stage timers, the span table, jit-compile figures, roofline
+        utilization and the candidate summary."""
+        rows = run_report_rows(report, run=run, host=host,
+                               clock=self._clock)
+        return self.append_rows(rows)
+
+    # -- ingest: history ledger -------------------------------------------
+
+    def ingest_history(self, records) -> int:
+        """Flatten bench/serve/... ledger records into rows (one run
+        id per record, from its ISO timestamp)."""
+        rows: list[dict] = []
+        for rec in records:
+            rows.extend(history_rows(rec, clock=self._clock))
+        return self.append_rows(rows)
+
+    # -- ingest: telemetry shards -----------------------------------------
+
+    def ingest_telemetry(self, ts_dir: str, *, hosts=None,
+                         since: float | None = None,
+                         run: str = "fleet") -> int:
+        """Flatten per-host telemetry samples (counter deltas, timer
+        device seconds, gauges) into rows."""
+        from .telemetry import read_samples
+
+        rows: list[dict] = []
+        for sample in read_samples(ts_dir, hosts=hosts, since=since):
+            rows.extend(telemetry_rows(sample, run=run))
+        return self.append_rows(rows)
+
+    # -- ingest: timelines -------------------------------------------------
+
+    def ingest_timeline(self, path_or_workdir: str, *,
+                        run: str = "") -> int:
+        """Flatten per-job timeline marks into rows (one per mark,
+        stage = phase)."""
+        from .timeline import read_timeline
+
+        rows: list[dict] = []
+        for mark in read_timeline(path_or_workdir):
+            ts = mark.get("ts")
+            if ts is None:
+                continue
+            rows.append(make_row(
+                ts=float(ts), run=run or str(mark.get("job", "")),
+                source="timeline", stage=str(mark.get("phase", "")),
+                host=str(mark.get("host", "")),
+                metric="timeline.mark", value=1.0,
+                data={k: v for k, v in mark.items()
+                      if k in ("attempt", "job")}))
+        return self.append_rows(rows)
+
+
+# --------------------------------------------------------------------------
+# stream flatteners (pure: dict in, rows out)
+# --------------------------------------------------------------------------
+
+def run_report_rows(report: dict, *, run: str = "", host: str = "",
+                    clock=time.time) -> list[dict]:
+    """Rows for one run report (see :class:`Warehouse`)."""
+    ts = _iso_to_epoch(report.get("generated_utc"))
+    if ts is None:
+        ts = clock()
+    run = run or str(report.get("generated_utc", "run"))
+    device = report.get("device", {}) or {}
+    kinds = [d.get("kind", "") for d in device.get("devices", [])]
+    device_kind = kinds[0] if kinds else str(device.get("backend", ""))
+    geom = geometry_fingerprint(
+        (report.get("perf", {}) or {}).get("geometry"))
+    common = dict(ts=ts, run=run, host=host, geometry=geom,
+                  device_kind=device_kind)
+    rows: list[dict] = []
+    for name, t in (report.get("timers", {}) or {}).items():
+        rows.append(make_row(source="report", metric=f"timer.{name}",
+                             value=float(t), **common))
+    for stage, rec in (report.get("stage_timers", {}) or {}).items():
+        for field in ("host_s", "device_s", "count"):
+            if field in rec:
+                rows.append(make_row(
+                    source="report", stage=stage,
+                    metric=f"stage.{field}", value=float(rec[field]),
+                    **common))
+    for name, rec in (report.get("spans", {}) or {}).items():
+        for field in ("device_s", "total_s", "self_s", "count"):
+            if field in rec:
+                rows.append(make_row(
+                    source="span", stage=name,
+                    metric=f"span.{field}", value=float(rec[field]),
+                    **common))
+    jit = report.get("jit", {}) or {}
+    for field in ("backend_compiles", "compile_s"):
+        if field in jit:
+            rows.append(make_row(source="report",
+                                 metric=f"jit.{field}",
+                                 value=float(jit[field]), **common))
+    perf = report.get("perf", {}) or {}
+    for stage, rec in (perf.get("stages", {}) or {}).items():
+        for field in ("utilization", "intensity_flops_per_byte",
+                      "device_s"):
+            if rec.get(field) is not None:
+                rows.append(make_row(
+                    source="roofline", stage=stage,
+                    metric=f"roofline.{field}",
+                    value=float(rec[field]), **common))
+    cands = report.get("candidates", {}) or {}
+    if "count" in cands:
+        rows.append(make_row(source="report", metric="candidates.count",
+                             value=float(cands["count"]), **common))
+    return rows
+
+
+def history_rows(rec: dict, *, clock=time.time) -> list[dict]:
+    """Rows for one history-ledger record."""
+    ts = _iso_to_epoch(rec.get("ts"))
+    if ts is None:
+        ts = clock()
+    kind = str(rec.get("kind", "record"))
+    run = f"{kind}@{rec.get('ts', int(ts))}"
+    device_kind = str((rec.get("device", {}) or {}).get("kind", ""))
+    cfg = rec.get("config", {}) or {}
+    geom = geometry_fingerprint(cfg.get("geometry", cfg))
+    host = str(cfg.get("worker", ""))
+    common = dict(ts=ts, run=run, host=host, geometry=geom,
+                  device_kind=device_kind)
+    rows: list[dict] = []
+    for name, value in (rec.get("metrics", {}) or {}).items():
+        if isinstance(value, (int, float)):
+            rows.append(make_row(source="history",
+                                 metric=f"metric.{name}",
+                                 value=float(value), **common))
+    for stage, dev_s in (rec.get("stage_device_s", {}) or {}).items():
+        rows.append(make_row(source="history", stage=stage,
+                             metric="stage.device_s",
+                             value=float(dev_s), **common))
+    for stage, util in (rec.get("utilization", {}) or {}).items():
+        rows.append(make_row(source="history", stage=stage,
+                             metric="roofline.utilization",
+                             value=float(util), **common))
+    for name, count in (rec.get("compile_counts", {}) or {}).items():
+        rows.append(make_row(source="history",
+                             metric=f"jit.compiles.{name}",
+                             value=float(count), **common))
+    return rows
+
+
+def telemetry_rows(sample: dict, *, run: str = "fleet") -> list[dict]:
+    """Rows for one telemetry sample (counter deltas, per-stage timer
+    device seconds, gauges)."""
+    ts = float(sample.get("ts", 0.0))
+    host = str(sample.get("host", ""))
+    common = dict(ts=ts, run=run, host=host)
+    rows: list[dict] = []
+    for name, delta in (sample.get("counters", {}) or {}).items():
+        rows.append(make_row(source="telemetry",
+                             metric=f"counter.{name}",
+                             value=float(delta), **common))
+    for stage, rec in (sample.get("timers", {}) or {}).items():
+        for field in ("device_s", "host_s"):
+            if rec.get(field):
+                rows.append(make_row(
+                    source="telemetry", stage=stage,
+                    metric=f"stage.{field}", value=float(rec[field]),
+                    **common))
+    for name, value in (sample.get("gauges", {}) or {}).items():
+        if isinstance(value, (int, float)):
+            rows.append(make_row(source="telemetry",
+                                 metric=f"gauge.{name}",
+                                 value=float(value), **common))
+    return rows
+
+
+# --------------------------------------------------------------------------
+# fleet rollup (``status --watch``'s per-host columns)
+# --------------------------------------------------------------------------
+
+def host_rollup(ts_dir: str, *, window_s: float = 300.0,
+                now: float | None = None) -> dict:
+    """Per-host live rollup straight off the telemetry shards:
+
+    * ``duty`` — device seconds per wall second over the window (the
+      per-host duty cycle);
+    * ``util`` — HBM high-water over budget, when both gauges exist
+      (memory-side utilization; ``None`` on backends without stats);
+    * ``jobs_per_hour`` — the gauge's recent series, sparkline-ready;
+    * ``last_ts`` — the newest sample's timestamp (staleness).
+    """
+    from .telemetry import read_samples
+
+    now = time.time() if now is None else float(now)
+    rollup: dict[str, dict] = {}
+    for sample in read_samples(ts_dir, since=now - window_s):
+        host = str(sample.get("host", ""))
+        ent = rollup.setdefault(host, {
+            "duty": 0.0, "util": None, "jobs_per_hour": [],
+            "last_ts": 0.0, "_device_s": 0.0, "_t0": None})
+        ts = float(sample.get("ts", 0.0))
+        ent["last_ts"] = max(ent["last_ts"], ts)
+        if ent["_t0"] is None:
+            ent["_t0"] = ts
+        for rec in (sample.get("timers", {}) or {}).values():
+            ent["_device_s"] += float(rec.get("device_s", 0.0) or 0.0)
+        gauges = sample.get("gauges", {}) or {}
+        jph = gauges.get("scheduler.jobs_per_hour")
+        if jph is not None:
+            ent["jobs_per_hour"].append(float(jph))
+        high = gauges.get("hbm.high_water_bytes")
+        budget = gauges.get("hbm.budget_bytes")
+        if high and budget:
+            ent["util"] = float(high) / float(budget)
+    for ent in rollup.values():
+        span = max(ent["last_ts"] - (ent["_t0"] or ent["last_ts"]),
+                   1e-9)
+        ent["duty"] = min(ent.pop("_device_s") / span, 1.0)
+        ent.pop("_t0", None)
+    return rollup

@@ -535,9 +535,30 @@ def cmd_admission(spool, args) -> int:
     return 0
 
 
-def _print_fleet_table(report: dict) -> None:
+def _print_fleet_table(report: dict, rollup: dict | None = None
+                       ) -> None:
+    """The per-host table.  ``rollup`` (ISSUE 16, from
+    ``obs.warehouse.host_rollup``) adds live telemetry columns: duty
+    cycle, HBM utilization and a jobs/hr sparkline straight off the
+    ``ts-<host>.jsonl`` shards."""
     cols = ("host", "claimed", "ok", "fail", "jobs/h", "reaped",
             "shard")
+    if rollup is not None:
+        cols += ("duty", "util", "jobs/h trend")
+
+    def telemetry_cols(label: str) -> tuple:
+        if rollup is None:
+            return ()
+        ent = rollup.get(label)
+        if not ent:
+            return ("-", "-", "")
+        from ..obs.warehouse import sparkline
+
+        util = (f"{ent['util'] * 100:.0f}%"
+                if ent.get("util") is not None else "-")
+        return (f"{ent['duty'] * 100:.0f}%", util,
+                sparkline(ent.get("jobs_per_hour", [])))
+
     rows = []
     for label, doc in sorted(report["hosts"].items()):
         s = doc.get("summary", {})
@@ -545,10 +566,11 @@ def _print_fleet_table(report: dict) -> None:
         rows.append((label, s.get("claimed", 0), s.get("succeeded", 0),
                      s.get("failed", 0), s.get("jobs_per_hour", 0.0),
                      sched.get("lease_reaped", 0),
-                     doc.get("shard", "")))
+                     doc.get("shard", "")) + telemetry_cols(label))
     t = report["totals"]
     rows.append(("TOTAL", t["claimed"], t["succeeded"], t["failed"],
-                 t["jobs_per_hour"], t["lease_reaped"], ""))
+                 t["jobs_per_hour"], t["lease_reaped"], "")
+                + (("", "", "") if rollup is not None else ()))
     widths = [max(len(str(c)), *(len(str(r[i])) for r in rows))
               for i, c in enumerate(cols)]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
@@ -574,13 +596,16 @@ def _watch_status(spool, args, sleeper=None, clock=None) -> int:
     """``status --watch``: re-render the fleet table + health findings
     every ``--interval`` seconds.  ``sleeper``/``clock`` are
     injectable so tests run N iterations without wall-clock waits."""
+    from ..obs.warehouse import host_rollup
     from .fleet import fleet_report
+    from .health import default_ts_dir
     from .queue import DEFAULT_LEASE_TTL_S
     from .retry import pause
 
     clock = clock or time.time
     ttl = (args.lease_ttl if args.lease_ttl is not None
            else DEFAULT_LEASE_TTL_S)
+    ts_dir = default_ts_dir(spool)
     done = 0
     try:
         while True:
@@ -588,10 +613,11 @@ def _watch_status(spool, args, sleeper=None, clock=None) -> int:
                 print("\x1b[2J\x1b[H", end="")
             now = clock()
             report = fleet_report(spool, ttl)
+            rollup = host_rollup(ts_dir, now=now)
             stamp = time.strftime("%H:%M:%S", time.localtime(now))
             print(f"{stamp}  spool {spool.root}  "
                   f"(refresh {args.interval:g}s, ctrl-c to stop)")
-            _print_fleet_table(report)
+            _print_fleet_table(report, rollup=rollup)
             print("queue: " + "  ".join(
                 f"{k}={v}" for k, v in report["queue"].items()))
             health = report.get("health")

@@ -11,7 +11,7 @@ shape follows the repo's analysis engine (``analysis/rules.py``):
   once by :func:`build_context`: the merged time-series (all samples
   + the recent evaluation window), latest sample per host, queue
   depths, running-job lease holders, and the bench-history ledger's
-  ``kind:"serve"`` records;
+  ``kind:"serve"`` (plus ``"anomaly"`` — ISSUE 16) records;
 * each rule is a small **pure function** ``rule(ctx) ->
   [HealthFinding]`` registered via the :func:`health_rule` decorator —
   adding a rule is writing one function (see CONTRIBUTING.md);
@@ -181,7 +181,8 @@ def build_context(spool: JobSpool, *, ts_dir: str | None = None,
         queue=spool.counts(),
         running=running,
         ledger=load_history(ledger_path or default_ledger_path(),
-                            kinds=("serve", "loadgen", "sensitivity")),
+                            kinds=("serve", "loadgen", "sensitivity",
+                                   "anomaly")),
         window_s=float(window_s),
         stale_after=float(stale_after),
         slo=targets,
@@ -644,6 +645,47 @@ def rule_batch_mix(ctx: HealthContext) -> list[HealthFinding]:
     return [HealthFinding(
         "batch_mix", OK,
         f"dominant bucket {dominant} vs batch {batch}", data=data)]
+
+
+#: recent anomaly records meaning "the fleet is drifting" vs "on fire"
+ANOMALY_CRIT_COUNT = 3
+
+
+@health_rule
+def rule_anomaly(ctx: HealthContext) -> list[HealthFinding]:
+    """Typed ``kind:"anomaly"`` ledger records (ISSUE 16): the
+    baseline plane (``obs/baseline.py``) appends one per statistical
+    departure — a stage outside its median/MAD band, a fleet-presence
+    drop.  A *recent* anomaly (ts inside the window) is a warning the
+    supervisor can observe exactly like duty-cycle collapse; several,
+    or one already rated ``crit``, is critical.  Historical anomalies
+    age out as ``now`` moves on — the finding clears after recovery
+    without anyone deleting records."""
+    from ..obs.warehouse import _iso_to_epoch
+
+    anomalies = [r for r in ctx.ledger if r.get("kind") == "anomaly"]
+    recent = []
+    for rec in anomalies:
+        ts = _iso_to_epoch(rec.get("ts"))
+        if ts is not None and ts >= ctx.now - ctx.window_s:
+            recent.append(rec)
+    data = {"recent": len(recent), "total": len(anomalies)}
+    if recent:
+        keys = sorted({
+            f"{r.get('key', {}).get('stage', '?')}"
+            f"@{r.get('key', {}).get('host', '') or 'fleet'}"
+            for r in recent})
+        data["keys"] = keys
+        crit = (len(recent) >= ANOMALY_CRIT_COUNT
+                or any(r.get("severity") == "crit" for r in recent))
+        return [HealthFinding(
+            "anomaly", CRIT if crit else WARN,
+            f"{len(recent)} baseline anomaly record(s) in the window "
+            f"({', '.join(keys[:4])})", data=data)]
+    return [HealthFinding(
+        "anomaly", OK,
+        f"no baseline anomalies in the window "
+        f"({len(anomalies)} historical)", data=data)]
 
 
 # -- SLO summary -----------------------------------------------------------

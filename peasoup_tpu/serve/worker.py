@@ -58,6 +58,12 @@ from .retry import (
 )
 from .store import CandidateStore
 
+#: per-job ``events.jsonl`` byte budget (ISSUE 16): a retry-looping
+#: or event-heavy job rotates its log to ``events.jsonl.1`` instead
+#: of growing without bound — the fleet's disk footprint stays
+#: proportional to job count, not event volume
+EVENT_LOG_MAX_BYTES = 512 * 1024
+
 
 class ObservationPrefetcher:
     """Multi-slot background filterbank reader (double buffering at
@@ -429,7 +435,8 @@ class SurveyWorker:
                 cfg = self._job_config(job)
                 configure_event_log(
                     os.path.join(self.spool.work_dir(job.job_id),
-                                 "events.jsonl"))
+                                 "events.jsonl"),
+                    max_log_bytes=EVENT_LOG_MAX_BYTES)
                 fil = (self._prefetcher.take(job.input)
                        if self.prefetch else None)
                 if fil is not None:
@@ -545,7 +552,8 @@ class SurveyWorker:
         cfg = self._job_config(job)
         configure_event_log(
             os.path.join(self.spool.work_dir(job.job_id),
-                         "events.jsonl"))
+                         "events.jsonl"),
+            max_log_bytes=EVENT_LOG_MAX_BYTES)
         fil = self._prefetcher.take(job.input) if self.prefetch else None
         staged = self._prefetcher.last_staged if self.prefetch else None
         if fil is None:

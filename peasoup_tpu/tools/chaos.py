@@ -33,6 +33,13 @@ drains, so it cannot sit in a health-gated drain loop.
 ``--smoke`` appends one ``kind:"chaos"`` ledger record whose headline
 ``chaos_recovery_s`` (fault injection -> health exit 0) is what
 ``bench.py --chaos`` prints and ``tools/perf_report.py`` trends.
+
+Flight-recorder tie-in (ISSUE 16): the smoke additionally asserts
+that ``obs.baseline.fleet_presence_anomalies`` *detects* the SIGKILL
+purely from the telemetry shards — typed ``kind:"anomaly"`` records
+in the fault window, clean bins again once capacity respawns — and
+appends those records to the ledger, where ``serve health``'s
+``anomaly`` rule reads them.
 """
 
 from __future__ import annotations
@@ -170,6 +177,10 @@ def run_smoke(workdir: str, *, budget_s: float = DEFAULT_BUDGET_S,
               control: bool = True) -> tuple[int, dict]:
     """Run the seeded chaos plan; returns (exit_code, report)."""
     from peasoup_tpu.errors import AdmissionError
+    from peasoup_tpu.obs.baseline import (
+        fleet_presence_anomalies,
+        write_anomalies,
+    )
     from peasoup_tpu.obs.history import (
         append_history,
         load_history,
@@ -289,12 +300,14 @@ def run_smoke(workdir: str, *, budget_s: float = DEFAULT_BUDGET_S,
               f"at t+{t_fault - t0:.1f}s")
 
         # recovery: all jobs terminal AND health exit 0, inside budget
-        done_ids: set = set()
+        t_terminal = None
         while time.time() < deadline:
             counts = spool.counts()
             terminal = counts["done"] + counts["failed"]
             if terminal >= len(all_jobs) \
                     and counts["running"] == counts["pending"] == 0:
+                if t_terminal is None:
+                    t_terminal = time.time()
                 if _health_exit(spool_dir, history, env) == 0:
                     recovery_s = time.time() - t_fault
                     break
@@ -365,6 +378,36 @@ def run_smoke(workdir: str, *, budget_s: float = DEFAULT_BUDGET_S,
         _check(kinds.count("supervise_action") == len(sup_recs),
                "one typed supervise_action event per ledger record",
                failures)
+
+        # the flight recorder must SEE the fault (ISSUE 16): the
+        # killed worker's telemetry shard goes silent, so the
+        # distinct-hosts-sampling-per-second count drops below its
+        # own leave-one-out baseline during the kill window; once
+        # scale_up respawns capacity the bins are clean again.  The
+        # scan ends at t_terminal (drain complete) — past that the
+        # supervisor may legitimately retire idle workers, which is
+        # drawdown, not a fault.
+        anoms: list[dict] = []
+        during: list[dict] = []
+        tail: list[dict] = []
+        if t_fault is not None and t_terminal is not None:
+            anoms = fleet_presence_anomalies(
+                os.path.join(spool_dir, "fleet"),
+                t_start=max(t0, t_fault - 10.0), t_end=t_terminal)
+            during = [a for a in anoms
+                      if t_fault - 1.0 <= a["ts"] <= t_fault + 20.0]
+            tail = [a for a in anoms
+                    if a["ts"] > t_terminal - 3.0]
+        _check(bool(during),
+               f"presence anomaly emitted during the fault window "
+               f"({len(during)}/{len(anoms)} anomalies in window)",
+               failures)
+        _check(t_terminal is not None and not tail,
+               "presence anomalies cleared after recovery (last 3s "
+               "of bins clean)", failures)
+        if anoms:
+            write_anomalies(anoms, history)
+        report["presence_anomalies"] = len(anoms)
     finally:
         _stop_proc(sup_proc)
         out = sup_proc.stdout.read() if sup_proc.stdout else ""
@@ -425,7 +468,9 @@ def run_smoke(workdir: str, *, budget_s: float = DEFAULT_BUDGET_S,
              "jobs_total": report["jobs_total"],
              "jobs_done": report["jobs_done"],
              "jobs_failed": report["jobs_failed"],
-             "admission_rejected": rejected},
+             "admission_rejected": rejected,
+             "presence_anomalies": report.get(
+                 "presence_anomalies", 0)},
             config={"seed": int(seed), "budget_s": float(budget_s),
                     "plan": plan})
         append_history(rec, history)

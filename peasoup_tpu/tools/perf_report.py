@@ -3,16 +3,21 @@
 Reads ``benchmarks/history.jsonl`` (``obs/history.py`` — appended by
 ``bench.py`` and the ``benchmarks/`` harnesses) plus the legacy
 committed ``BENCH_r0*.json`` artifacts, prints per-metric trend tables
-with sparklines, and implements a noise-aware regression gate:
+with sparklines, and implements a baseline-aware regression gate
+(ISSUE 16, statistics from ``obs/baseline.py``):
 
     head  = median of the newest ``--head`` records' gate metric
     base  = median of the ``--window`` records immediately before them
-    FAIL when head / base > --threshold   (lower-is-better metrics)
+    band  = max(z · 1.4826 · MAD(window), (threshold-1) · base)
+    FAIL when head > base + band          (lower-is-better metrics)
 
 Medians on both sides reject single-capture jitter (the remote-TPU
 tunnel adds 50-100 ms of per-fetch noise and occasional multi-second
-stalls); the threshold defaults to 1.4x so noise-level wobble never
-trips while a genuine 3x slowdown always does.
+stalls).  A noisy history widens its own acceptance band via the
+robust z-score; a quiet history (MAD ≈ 0) falls back to the absolute
+floor — the old 1.4x fixed ratio — so noise-level wobble never trips
+while a genuine 3x slowdown always does, deterministically given the
+checked-in ledger.
 
 Usage::
 
@@ -402,12 +407,23 @@ def stage_table(records: list[dict]) -> str:
 
 def regression_gate(records: list[dict], metric: str = GATE_METRIC,
                     head: int = 1, window: int = 8,
-                    threshold: float = 1.4) -> tuple[int, str]:
+                    threshold: float = 1.4,
+                    z: float = 4.0) -> tuple[int, str]:
     """(exit_code, message).  0 = clean or not enough history; 1 =
-    regression (head median exceeds the trailing-window median by more
-    than ``threshold`` x).  Metrics in ``HIGHER_IS_BETTER_METRICS``
-    invert the ratio, so a duty-cycle COLLAPSE trips the same
-    threshold a wall-clock blow-up does."""
+    regression.  Baseline-aware (ISSUE 16): the head median is judged
+    against the trailing window's statistical band
+
+        median ± max(z · 1.4826 · MAD, (threshold-1) · median)
+
+    so a *noisy* history widens its own acceptance band (a 4-sigma
+    robust z-score must be exceeded) while a *quiet* history keeps
+    the old fixed-ratio floor exactly (MAD ≈ 0 collapses the band to
+    ``threshold × median``).  Deterministic given the ledger — same
+    history in, same verdict out.  Metrics in
+    ``HIGHER_IS_BETTER_METRICS`` flip the band, so a duty-cycle
+    COLLAPSE trips the same gate a wall-clock blow-up does."""
+    from ..obs.baseline import baseline_band
+
     vals = metric_series(records).get(metric, [])
     if len(vals) < 2:
         return 0, (f"gate: only {len(vals)} `{metric}` record(s) — "
@@ -419,23 +435,25 @@ def regression_gate(records: list[dict], metric: str = GATE_METRIC,
     if not base_vals:
         base_vals = vals[:-head]
     head_med = _median(head_vals)
-    base_med = _median(base_vals)
+    floor_frac = max(float(threshold) - 1.0, 0.0)
+    base_med, band = baseline_band(base_vals, z=z,
+                                   floor_frac=floor_frac)
     if base_med <= 0:
         return 0, f"gate: non-positive baseline for `{metric}` (pass)"
-    if metric in HIGHER_IS_BETTER_METRICS:
-        if head_med <= 0:
-            return 1, (f"REGRESSION gate: {metric} collapsed to "
-                       f"{head_med:.4g} (higher is better)")
-        ratio = base_med / head_med
-    else:
-        ratio = head_med / base_med
+    higher_better = metric in HIGHER_IS_BETTER_METRICS
+    if higher_better and head_med <= 0:
+        return 1, (f"REGRESSION gate: {metric} collapsed to "
+                   f"{head_med:.4g} (higher is better)")
+    limit = base_med - band if higher_better else base_med + band
+    tripped = (head_med < limit) if higher_better \
+        else (head_med > limit)
     desc = (f"gate: {metric} head median {head_med:.4g} "
-            f"(n={len(head_vals)}) vs trailing median {base_med:.4g} "
-            f"(n={len(base_vals)}) -> {ratio:.2f}x "
-            f"(threshold {threshold:.2f}x"
+            f"(n={len(head_vals)}) vs baseline {base_med:.4g} "
+            f"± {band:.4g} (n={len(base_vals)}, z={z:g}, "
+            f"floor {threshold:.2f}x"
             + (", inverted: higher is better)"
-               if metric in HIGHER_IS_BETTER_METRICS else ")"))
-    if ratio > threshold:
+               if higher_better else ")"))
+    if tripped:
         return 1, "REGRESSION " + desc
     return 0, "OK " + desc
 

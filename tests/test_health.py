@@ -29,6 +29,7 @@ from peasoup_tpu.serve.health import (
     RULES,
     WARN,
     format_findings,
+    rule_anomaly,
     rule_device_duty_cycle,
     rule_hbm_watermark,
     rule_lease_reap_burst,
@@ -409,6 +410,58 @@ def test_crashing_rule_degrades_to_warn_finding():
 def test_finding_is_json_serialisable():
     f = HealthFinding("r", WARN, "m", host="h", data={"n": 1})
     assert json.loads(json.dumps(f.to_obj()))["host"] == "h"
+
+
+# --------------------------------------------------------------------------
+# rule: anomaly (the flight recorder's baseline plane, ISSUE 16)
+# --------------------------------------------------------------------------
+
+def _anomaly(ts, *, stage="peaks", host="", severity="warn"):
+    return {"v": 1, "kind": "anomaly", "ts": ts,
+            "key": {"stage": stage, "geometry": "abc123",
+                    "device_kind": "cpu", "host": host},
+            "metric": "stage.device_s", "value": 0.1, "median": 0.05,
+            "mad": 0.001, "band": 0.02, "severity": severity}
+
+
+def test_anomaly_ok_without_records():
+    (f,) = rule_anomaly(_ctx())
+    assert f.severity == OK
+    assert f.data == {"recent": 0, "total": 0}
+
+
+def test_anomaly_recent_record_warns_with_key():
+    (f,) = rule_anomaly(_ctx(ledger=[_anomaly(NOW - 10.0)]))
+    assert f.severity == WARN
+    assert f.data["keys"] == ["peaks@fleet"]
+
+
+def test_anomaly_crit_on_count_or_severity():
+    burst = [_anomaly(NOW - 5.0 - i) for i in range(3)]
+    (f,) = rule_anomaly(_ctx(ledger=burst))
+    assert f.severity == CRIT
+    (f,) = rule_anomaly(
+        _ctx(ledger=[_anomaly(NOW - 5.0, severity="crit")]))
+    assert f.severity == CRIT
+
+
+def test_anomaly_ages_out_of_the_window():
+    """Old anomaly records clear on their own — the emitted-then-
+    cleared lifecycle the chaos harness asserts end to end."""
+    (f,) = rule_anomaly(_ctx(ledger=[_anomaly(NOW - 301.0)]))
+    assert f.severity == OK
+    assert f.data == {"recent": 0, "total": 1}
+
+
+def test_build_context_surfaces_anomaly_records(tmp_path):
+    """The ledger loader keeps ``kind:"anomaly"`` records so the rule
+    sees what ``obs.baseline.write_anomalies`` appended."""
+    ledger = str(tmp_path / "h.jsonl")
+    append_history(_anomaly(NOW - 1.0), ledger)
+    spool = JobSpool(str(tmp_path / "jobs"))
+    ctx = build_context(spool, ledger_path=ledger, now=NOW)
+    (f,) = rule_anomaly(ctx)
+    assert f.severity == WARN
 
 
 # --------------------------------------------------------------------------

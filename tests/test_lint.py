@@ -337,6 +337,63 @@ def test_psl008_pragma_escape(tmp_path):
     assert suppressed == 1
 
 
+def test_psl009_uncatalogued_metric_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        from ..obs.metrics import REGISTRY as METRICS
+
+        def f():
+            METRICS.inc("totally.bogus_counter")
+            METRICS.gauge("also.bogus_gauge", 1.0)
+    """, relpath="peasoup_tpu/serve/fixture.py")
+    assert [v.rule for v in vs] == ["PSL009", "PSL009"]
+    assert "catalog" in vs[0].message
+
+
+def test_psl009_cataloged_and_dynamic_clean(tmp_path):
+    """Catalogued literals, documented dynamic-prefix literals and
+    f-string names (the prefix is the contract) all pass; so do
+    ``.inc`` calls on receivers that are not a metrics registry."""
+    vs, _ = _lint_snippet(tmp_path, """
+        from ..obs.metrics import REGISTRY as METRICS
+
+        def f(self, reg, kind):
+            METRICS.inc("scheduler.claimed")
+            METRICS.gauge("hbm.budget_bytes", 2.0)
+            reg.inc("supervisor.action.scale_up")
+            self._registry.inc(f"events.{kind}")
+            counter.inc("not.a.metric.registry")
+    """, relpath="peasoup_tpu/serve/fixture.py")
+    assert vs == []
+
+
+def test_psl009_registry_receiver_spellings_flagged(tmp_path):
+    """The rule audits every registry spelling the tree uses:
+    ``self._registry``, a ``reg`` local, a ``*registry`` attribute."""
+    vs, _ = _lint_snippet(tmp_path, """
+        def f(self, reg):
+            self._registry.inc("bogus.one")
+            reg.gauge("bogus.two", 0.0)
+    """, relpath="peasoup_tpu/obs/fixture.py")
+    assert [v.rule for v in vs] == ["PSL009", "PSL009"]
+
+
+def test_psl009_catalog_module_is_exempt(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        METRICS.inc("names.defined.here.are.the.catalog")
+    """, relpath="peasoup_tpu/obs/catalog.py")
+    assert vs == []
+
+
+def test_psl009_every_catalog_name_has_description():
+    """The catalog itself stays honest: every entry carries a
+    non-empty description and every dynamic prefix ends with a
+    separator (it is a family, not a name)."""
+    from peasoup_tpu.obs.catalog import CATALOG, DYNAMIC_PREFIXES
+
+    assert all(desc.strip() for desc in CATALOG.values())
+    assert all(p.endswith((".", "_")) for p in DYNAMIC_PREFIXES)
+
+
 # --------------------------------------------------------------------------
 # suppressions
 # --------------------------------------------------------------------------

@@ -1,0 +1,592 @@
+"""Flight-recorder tier-1 tests (ISSUE 16): the unified warehouse
+(robustness: torn lines, schema skew, clock skew, segment sealing),
+the statistical baseline plane (determinism from the checked-in
+fixture ledger, the one-anomaly acceptance case), the run-to-run
+structural diff (reproducing the checked-in ``trace_summary_r6.md``
+mechanically) and the ``obs`` CLI verb family."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from peasoup_tpu.obs.baseline import (
+    baseline_band,
+    baseline_table,
+    detect_point,
+    fleet_presence_anomalies,
+    history_anomalies,
+    robust_stats,
+    write_anomalies,
+)
+from peasoup_tpu.obs.diff import (
+    diff_bench_records,
+    diff_reports,
+    load_report,
+    render_markdown,
+)
+from peasoup_tpu.obs.history import load_history
+from peasoup_tpu.obs.warehouse import (
+    Warehouse,
+    geometry_fingerprint,
+    host_rollup,
+    make_row,
+    row_key,
+    sparkline,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..",
+                        "benchmarks", "fixtures")
+REPORT_R5 = os.path.join(FIXTURES, "run_report_r5.json")
+REPORT_R6 = os.path.join(FIXTURES, "run_report_r6.json")
+HISTORY_FIXTURE = os.path.join(FIXTURES, "history_fixture.jsonl")
+TRACE_SUMMARY_R6 = os.path.join(FIXTURES, "..", "trace_summary_r6.md")
+
+
+def _rows(n, *, host="h0", t0=1000.0):
+    return [make_row(ts=t0 + i, run="r1", source="report",
+                     metric=f"timer.t{i}", value=float(i), host=host)
+            for i in range(n)]
+
+
+# --------------------------------------------------------------------------
+# warehouse store: append/read round-trip, filters, index
+# --------------------------------------------------------------------------
+
+def test_roundtrip_and_filters(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    rows = [
+        make_row(ts=1.0, run="a", source="report", stage="peaks",
+                 metric="stage.device_s", value=0.5, host="h0"),
+        make_row(ts=2.0, run="a", source="span", stage="sort",
+                 metric="span.device_s", value=0.7, host="h0"),
+        make_row(ts=3.0, run="b", source="report", stage="peaks",
+                 metric="stage.device_s", value=0.6, host="h1"),
+    ]
+    assert wh.append_rows(rows) == 3
+    assert len(wh.rows()) == 3
+    assert [r["run"] for r in wh.rows(run="a")] == ["a", "a"]
+    assert [r["value"] for r in wh.rows(stage="peaks")] == [0.5, 0.6]
+    assert [r["value"] for r in wh.rows(host="h1")] == [0.6]
+    # metric filter is a prefix match (one family, many fields)
+    assert len(wh.rows(metric="stage.")) == 2
+    assert [r["value"] for r in wh.rows(source="span")] == [0.7]
+    assert [r["value"] for r in wh.rows(since=2.5)] == [0.6]
+    idx = wh.index()
+    assert idx["rows_total"] == 3
+    assert idx["runs"]["a"]["rows"] == 2
+    assert idx["runs"]["a"]["sources"] == ["report", "span"]
+
+
+def test_top_and_tail(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.append_rows(_rows(5))
+    assert [r["value"] for r in wh.top(2)] == [4.0, 3.0]
+    assert [r["value"] for r in wh.tail(2)] == [3.0, 4.0]
+
+
+def test_row_key_excludes_run():
+    row = make_row(ts=1.0, run="r9", source="report", stage="peaks",
+                   metric="m", value=1.0, geometry="g", host="h",
+                   device_kind="cpu")
+    assert row_key(row) == ("peaks", "g", "cpu", "h")
+
+
+# --------------------------------------------------------------------------
+# robustness: torn lines, schema skew, clock skew, sealing
+# --------------------------------------------------------------------------
+
+def test_torn_lines_skipped_silently(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.append_rows(_rows(3))
+    with open(wh.segment_path, "a") as f:
+        f.write('{"v": 1, "ts": 99, "torn truncat')
+        f.write("\nnot json at all\n")
+        f.write('["a", "list", "not", "a", "row"]\n')
+    assert len(wh.rows()) == 3
+    assert wh.last_skipped["torn"] == 3
+    assert wh.last_skipped["skew"] == 0
+
+
+def test_newer_schema_rows_skipped_with_counted_warning(tmp_path):
+    """v+1 rows (a newer writer sharing the store) are skipped, the
+    count is tracked, and exactly one typed warn_event fires."""
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.append_rows(_rows(2))
+    future = make_row(ts=5000.0, run="r1", source="report",
+                      metric="timer.future", value=1.0)
+    future["v"] = 2
+    with open(wh.segment_path, "a") as f:
+        f.write(json.dumps(future) + "\n")
+        f.write(json.dumps(dict(future, metric="timer.future2"))
+                + "\n")
+    with pytest.warns(UserWarning, match="schema v1"):
+        rows = wh.rows()
+    assert len(rows) == 2
+    assert wh.last_skipped == {"torn": 0, "skew": 2}
+
+
+def test_cross_host_clock_skew_merges_by_row_ts(tmp_path):
+    """A host with a skewed (earlier) clock appends *later* — reads
+    still interleave by the rows' own timestamps, with a
+    deterministic (ts, host, source, metric) tiebreak."""
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.append_rows(_rows(3, host="h-ahead", t0=2000.0))
+    wh.append_rows(_rows(3, host="h-behind", t0=1000.0))
+    out = wh.rows()
+    assert [r["host"] for r in out] == ["h-behind"] * 3 + ["h-ahead"] * 3
+    assert [r["ts"] for r in out] == sorted(r["ts"] for r in out)
+    # same-ts rows tiebreak deterministically
+    wh2 = Warehouse(str(tmp_path / "wh2"))
+    a = make_row(ts=1.0, run="r", source="report", metric="m",
+                 value=1.0, host="zz")
+    b = make_row(ts=1.0, run="r", source="report", metric="m",
+                 value=2.0, host="aa")
+    wh2.append_rows([a, b])
+    assert [r["host"] for r in wh2.rows()] == ["aa", "zz"]
+
+
+def test_segment_seals_past_budget_and_reads_span_generations(tmp_path):
+    """Past the byte budget the live segment rotates to ``.1`` (the
+    telemetry-shard scheme): reads span both generations, and at most
+    one sealed generation is retained so disk stays bounded."""
+    wh = Warehouse(str(tmp_path / "wh"), max_segment_bytes=600)
+    for i in range(12):
+        wh.append_rows([make_row(ts=float(i), run="r", source="report",
+                                 metric=f"timer.t{i}", value=1.0)])
+    assert os.path.exists(wh.segment_path + ".1")
+    assert not os.path.exists(wh.segment_path + ".2")
+    # reads span the sealed + live generations, newest row included
+    rows = wh.rows()
+    assert rows[-1]["metric"] == "timer.t11"
+    assert len(rows) > len(open(wh.segment_path).readlines())
+    # keep writing: the oldest generation is eventually dropped, but
+    # the live + one sealed segment keep the store bounded
+    for i in range(12, 40):
+        wh.append_rows([make_row(ts=float(i), run="r", source="report",
+                                 metric=f"timer.t{i}", value=1.0)])
+    sizes = [os.path.getsize(p) for p in
+             (wh.segment_path, wh.segment_path + ".1")
+             if os.path.exists(p)]
+    assert sum(sizes) < 4 * 600
+    assert wh.rows()[-1]["metric"] == "timer.t39"
+
+
+def test_io_failure_latches_with_typed_event(tmp_path):
+    """An unwritable root warns once (typed) and latches off — the
+    warehouse must never kill the run that feeds it."""
+    path = tmp_path / "not-a-dir"
+    path.write_text("a file where the warehouse dir should be")
+    wh = Warehouse(str(path))
+    with pytest.warns(UserWarning, match="warehouse disabled"):
+        assert wh.append_rows(_rows(1)) == 0
+    # latched: no second warning, still refusing quietly
+    assert wh.append_rows(_rows(1)) == 0
+
+
+def test_reindex_rebuilds_from_segments(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    wh.append_rows(_rows(4))
+    os.remove(wh.index_path)
+    idx = wh.index()
+    assert idx["rows_total"] == 4
+    assert idx["runs"]["r1"]["rows"] == 4
+
+
+# --------------------------------------------------------------------------
+# ingest flatteners
+# --------------------------------------------------------------------------
+
+def test_ingest_run_report_flattens_all_streams(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    report = load_report(REPORT_R5)
+    assert wh.ingest_run_report(report, run="r5") > 0
+    spans = wh.rows(source="span", metric="span.device_s")
+    by_stage = {r["stage"]: r["value"] for r in spans}
+    assert by_stage["sort"] == pytest.approx(0.0642)
+    assert by_stage["jit_shard_fn"] == pytest.approx(0.0999)
+    # every row carries the geometry fingerprint + device kind key
+    assert all(r["geometry"] and r["device_kind"] for r in spans)
+    util = wh.rows(source="roofline", stage="peaks",
+                   metric="roofline.utilization")
+    assert [r["value"] for r in util] == [pytest.approx(0.31)]
+    assert wh.rows(metric="jit.backend_compiles")[0]["value"] == 41
+    assert wh.rows(metric="candidates.count")[0]["value"] == 42
+
+
+def test_ingest_history_and_geometry_fingerprint(tmp_path):
+    wh = Warehouse(str(tmp_path / "wh"))
+    records = load_history(HISTORY_FIXTURE, kinds=("bench",))
+    assert wh.ingest_history(records) > 0
+    stage_rows = wh.rows(metric="stage.device_s", stage="peaks")
+    assert len(stage_rows) == len(records)
+    fps = {r["geometry"] for r in stage_rows}
+    assert fps == {geometry_fingerprint(
+        records[0]["config"]["geometry"])}
+    # distinct geometry -> distinct fingerprint (the attribution key)
+    other = dict(records[0]["config"]["geometry"], n_dm_trials=999)
+    assert geometry_fingerprint(other) not in fps
+
+
+def test_ingest_telemetry_shards(tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    os.makedirs(ts_dir)
+    sample = {"v": 1, "ts": 100.0, "host": "h0", "pid": 1, "seq": 0,
+              "interval_s": 5.0,
+              "counters": {"scheduler.claimed": 2},
+              "timers": {"peaks": {"device_s": 0.25}},
+              "gauges": {"scheduler.jobs_per_hour": 120.0}}
+    with open(os.path.join(ts_dir, "ts-h0.jsonl"), "w") as f:
+        f.write(json.dumps(sample) + "\n")
+    wh = Warehouse(str(tmp_path / "wh"))
+    assert wh.ingest_telemetry(ts_dir) == 3
+    assert wh.rows(metric="counter.scheduler.claimed")[0]["value"] == 2
+    assert wh.rows(metric="stage.device_s")[0]["stage"] == "peaks"
+    assert wh.rows(metric="gauge.")[0]["value"] == 120.0
+
+
+# --------------------------------------------------------------------------
+# baseline plane: robust stats, determinism, the acceptance case
+# --------------------------------------------------------------------------
+
+def test_robust_stats_and_band():
+    med, mad = robust_stats([1.0, 1.1, 0.9, 1.0, 5.0])
+    assert med == 1.0
+    assert mad == pytest.approx(0.1)  # the outlier does not poison it
+    med, half = baseline_band([1.0] * 6, z=4.0, floor_frac=0.4)
+    assert (med, half) == (1.0, pytest.approx(0.4))  # MAD=0 -> floor
+
+
+def test_detect_point_directions():
+    window = [1.0, 1.01, 0.99, 1.0, 1.02, 0.98]
+    key = {"stage": "peaks", "geometry": "g", "device_kind": "cpu",
+           "host": ""}
+    anom = detect_point(2.0, window, ts=9.0, key=key,
+                        metric="stage.device_s", z=4.0,
+                        floor_frac=0.4)
+    assert anom["kind"] == "anomaly"
+    assert anom["direction"] == "high"
+    assert anom["ts"] == 9.0  # the offending point's ts, not "now"
+    assert detect_point(1.05, window, ts=9.0, key=key,
+                        metric="stage.device_s", z=4.0,
+                        floor_frac=0.4) is None
+    # higher_is_better inverts the offending direction
+    assert detect_point(2.0, window, ts=9.0, key=key, metric="m",
+                        z=4.0, floor_frac=0.4,
+                        higher_is_better=True) is None
+    low = detect_point(0.2, window, ts=9.0, key=key, metric="m",
+                       z=4.0, floor_frac=0.4, higher_is_better=True)
+    assert low["direction"] == "low"
+
+
+def test_fixture_history_is_clean_and_deterministic():
+    """The checked-in ledger yields no anomalies, and two independent
+    evaluations are byte-identical — the gate's verdict is a pure
+    function of checked-in history."""
+    records = load_history(HISTORY_FIXTURE, kinds=("bench",))
+    assert len(records) == 8
+    first = history_anomalies(records)
+    second = history_anomalies(
+        load_history(HISTORY_FIXTURE, kinds=("bench",)))
+    assert first == []
+    assert json.dumps(first, sort_keys=True) == \
+        json.dumps(second, sort_keys=True)
+    table = baseline_table(records)
+    assert [r["stage"] for r in table] == \
+        ["dedisperse", "fold", "harmonics", "peaks", "spectrum"]
+    assert json.dumps(table, sort_keys=True) == json.dumps(
+        baseline_table(load_history(HISTORY_FIXTURE,
+                                    kinds=("bench",))),
+        sort_keys=True)
+
+
+def _slowed_history(factor=2.0, stage="peaks"):
+    records = load_history(HISTORY_FIXTURE, kinds=("bench",))
+    head = copy.deepcopy(records[-1])
+    head["stage_device_s"][stage] *= factor
+    head["metrics"]["peaks_device_s"] = head["stage_device_s"][stage]
+    return records[:-1] + [head]
+
+
+def test_synthetic_slowdown_yields_exactly_one_attributed_anomaly():
+    """The ISSUE 16 acceptance case: double ONE stage's device time in
+    the newest round — exactly one anomaly, attributed to that
+    (stage, geometry, device-kind) key, severity crit (>2 bands out),
+    while every other stage stays clean."""
+    records = _slowed_history(2.0, "peaks")
+    anomalies = history_anomalies(records)
+    assert len(anomalies) == 1
+    (anom,) = anomalies
+    assert anom["kind"] == "anomaly"
+    assert anom["key"]["stage"] == "peaks"
+    assert anom["key"]["geometry"] == geometry_fingerprint(
+        records[0]["config"]["geometry"])
+    assert anom["key"]["device_kind"] == "cpu"
+    assert anom["metric"] == "stage_device_s"
+    assert anom["severity"] == "crit"
+    assert anom["value"] > anom["median"] + anom["band"]
+    assert anom["ts"] == records[-1]["ts"]
+
+
+def test_slowdown_trips_gate_but_fixture_history_passes():
+    """The baseline-aware perf gate on the same evidence: unmodified
+    checked-in history passes; the 2x head trips it."""
+    from peasoup_tpu.tools.perf_report import regression_gate
+
+    clean = load_history(HISTORY_FIXTURE, kinds=("bench",))
+    code, msg = regression_gate(clean, metric="peaks_device_s")
+    assert code == 0 and "OK gate" in msg
+    code, msg = regression_gate(_slowed_history(2.0, "peaks"),
+                                metric="peaks_device_s")
+    assert code == 1 and "REGRESSION" in msg
+
+
+def test_write_anomalies_round_trips_through_ledger(tmp_path):
+    ledger = str(tmp_path / "h.jsonl")
+    anomalies = history_anomalies(_slowed_history(2.0, "peaks"))
+    assert write_anomalies(anomalies, ledger) == 1
+    (rec,) = load_history(ledger, kinds=("anomaly",))
+    assert rec == anomalies[0]  # verbatim: ts preserved, no restamp
+
+
+def test_fleet_presence_anomalies_emitted_then_cleared(tmp_path):
+    """The chaos harness's signal, offline: two hosts sample steadily,
+    one goes silent mid-window (SIGKILL), capacity returns — the
+    silent bins are flagged, the recovered tail is clean."""
+    ts_dir = str(tmp_path / "fleet")
+    os.makedirs(ts_dir)
+    t0 = 1000.0
+    for host in ("h0", "h1"):
+        with open(os.path.join(ts_dir, f"ts-{host}.jsonl"), "w") as f:
+            for i in range(40):
+                ts = t0 + i * 0.5
+                if host == "h1" and 10.0 <= ts - t0 < 14.0:
+                    continue  # the kill window: h1's shard is silent
+                f.write(json.dumps(
+                    {"v": 1, "ts": ts, "host": host, "pid": 1,
+                     "seq": i, "interval_s": 0.5, "counters": {},
+                     "timers": {}, "gauges": {}}) + "\n")
+    anomalies = fleet_presence_anomalies(ts_dir, t_start=t0,
+                                         t_end=t0 + 20.0)
+    assert anomalies, "kill window must be flagged"
+    assert all(10.0 <= a["ts"] - t0 < 14.0 for a in anomalies)
+    assert all(a["key"]["stage"] == "presence"
+               and a["key"]["host"] == "fleet" for a in anomalies)
+    assert all(a["direction"] == "low" for a in anomalies)
+    # the recovered tail (both hosts sampling again) is clean — the
+    # emitted-then-cleared lifecycle the chaos harness asserts live
+
+
+# --------------------------------------------------------------------------
+# structural diff: the checked-in trace summary is reproducible
+# --------------------------------------------------------------------------
+
+def test_diff_reproduces_checked_in_trace_summary():
+    """`obs diff` over the two checked-in fixture reports REGENERATES
+    benchmarks/trace_summary_r6.md byte-for-byte — run-to-run
+    attribution is mechanical, not hand-written prose."""
+    diff = diff_reports(load_report(REPORT_R5), load_report(REPORT_R6),
+                        label_a="benchmarks/fixtures/run_report_r5"
+                                ".json",
+                        label_b="benchmarks/fixtures/run_report_r6"
+                                ".json")
+    with open(TRACE_SUMMARY_R6) as f:
+        assert render_markdown(diff) == f.read()
+
+
+def test_diff_headline_figures():
+    diff = diff_reports(load_report(REPORT_R5), load_report(REPORT_R6))
+    assert diff["e2e_s"]["a"] == pytest.approx(0.370)
+    assert diff["e2e_s"]["b"] == pytest.approx(0.317)
+    assert diff["compiles"]["delta"] == -4
+    assert diff["geometry"]["same"] is True
+    spans = diff["spans"]
+    assert spans["sort"]["delta"] == pytest.approx(-0.0642)
+    assert spans["sort"]["count_b"] == 0
+    assert spans["jit_shard_fn"]["delta"] == pytest.approx(-0.0581)
+    assert spans["peaks_compact"]["new"] is True
+    # movers are ranked by |delta|: the sort elimination leads
+    text = render_markdown(diff)
+    first_mover = [ln for ln in text.splitlines()
+                   if ln.startswith("|") and "sort" in ln][0]
+    assert "-64.2" in first_mover
+    assert "0.370 s -> 0.317 s" in text
+    assert "41 -> 37 (-4)" in text
+
+
+def test_diff_bench_records_same_shape():
+    a, b = load_history(HISTORY_FIXTURE, kinds=("bench",))[-2:]
+    b = copy.deepcopy(b)
+    b["stage_device_s"]["peaks"] *= 2
+    diff = diff_bench_records(a, b, label_a="r1", label_b="r2")
+    assert diff["labels"] == ["r1", "r2"]
+    assert diff["stages"]["peaks"]["ratio"] == pytest.approx(2.0,
+                                                             rel=0.1)
+    assert "peaks" in render_markdown(diff)
+
+
+# --------------------------------------------------------------------------
+# host rollup + sparkline (status --watch columns)
+# --------------------------------------------------------------------------
+
+def test_host_rollup_duty_util_and_trend(tmp_path):
+    ts_dir = str(tmp_path / "fleet")
+    os.makedirs(ts_dir)
+    with open(os.path.join(ts_dir, "ts-h0.jsonl"), "w") as f:
+        for i in range(5):
+            f.write(json.dumps(
+                {"v": 1, "ts": 100.0 + i, "host": "h0", "pid": 1,
+                 "seq": i, "interval_s": 1.0, "counters": {},
+                 "timers": {"peaks": {"device_s": 0.5}},
+                 "gauges": {"scheduler.jobs_per_hour": 60.0 + i,
+                            "hbm.budget_bytes": 100.0,
+                            "hbm.high_water_bytes": 25.0}}) + "\n")
+    rollup = host_rollup(ts_dir, now=105.0)
+    ent = rollup["h0"]
+    assert ent["duty"] == pytest.approx(2.5 / 4.0)
+    assert ent["util"] == pytest.approx(0.25)
+    assert ent["jobs_per_hour"] == [60.0, 61.0, 62.0, 63.0, 64.0]
+    assert ent["last_ts"] == 104.0
+    assert len(sparkline(ent["jobs_per_hour"])) == 5
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([1.0]) == "▁"
+    line = sparkline([0, 1, 2, 3, 4, 5, 6, 7])
+    assert line[0] == "▁" and line[-1] == "█"
+    assert len(sparkline(list(range(100)), width=24)) == 24
+
+
+def test_status_watch_renders_rollup_columns(tmp_path, capsys):
+    """``status --watch`` joins the fleet table with the warehouse
+    rollup: duty/util/jobs-h-trend columns appear per host."""
+    import time as _time
+
+    from peasoup_tpu.serve import FleetMembership, FleetWorker, JobSpool
+    from peasoup_tpu.serve.cli import build_parser, cmd_status
+
+    spool_dir = str(tmp_path / "jobs")
+    spool = JobSpool(spool_dir)
+    w = FleetWorker(spool, FleetMembership.fake(0, 1, "host-0"))
+    w.write_host_status({"claimed": 1, "succeeded": 1, "failed": 0})
+    ts_dir = os.path.join(spool_dir, "fleet")
+    now = _time.time()
+    with open(os.path.join(ts_dir, "ts-host-0.jsonl"), "w") as f:
+        for i in range(3):
+            f.write(json.dumps(
+                {"v": 1, "ts": now - 3 + i, "host": "host-0",
+                 "pid": 1, "seq": i, "interval_s": 1.0,
+                 "counters": {}, "timers": {},
+                 "gauges": {"scheduler.jobs_per_hour": 10.0 * i}})
+                + "\n")
+    args = build_parser().parse_args(
+        ["--spool", spool_dir, "status", "--watch",
+         "--interval", "0.01", "--iterations", "1"])
+    rc = cmd_status(spool, args, sleeper=lambda s: None,
+                    clock=lambda: now)
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "duty" in out and "util" in out and "jobs/h trend" in out
+    (line,) = [ln for ln in out.splitlines()
+               if ln.startswith("host-0")]
+    assert "▁" in line  # the sparkline rendered
+
+
+# --------------------------------------------------------------------------
+# the obs CLI verb family
+# --------------------------------------------------------------------------
+
+def _obs(argv):
+    from peasoup_tpu.cli import main
+
+    return main(["obs"] + argv)
+
+
+def test_cli_ingest_query_top_tail(tmp_path, capsys):
+    wh_dir = str(tmp_path / "wh")
+    rc = _obs(["ingest", "--dir", wh_dir, "--report", REPORT_R5,
+               "--report", REPORT_R6, "--ledger", HISTORY_FIXTURE])
+    assert rc == 0
+    assert "ingested" in capsys.readouterr().out
+    rc = _obs(["query", "--dir", wh_dir, "--metric", "span.device_s",
+               "--stage", "sort", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    # only r5 has a sort span (r6 eliminated it entirely)
+    assert [r["value"] for r in doc["rows"]] == [0.0642]
+    rc = _obs(["top", "--dir", wh_dir, "-n", "1",
+               "--metric", "span.device_s", "--json"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["rows"][0]["stage"] == "jit_shard_fn"
+    rc = _obs(["tail", "--dir", wh_dir, "-n", "3"])
+    assert rc == 0
+    assert "(3 row(s))" in capsys.readouterr().out
+
+
+def test_cli_diff_writes_markdown(tmp_path, capsys):
+    out = str(tmp_path / "summary.md")
+    rc = _obs(["diff", REPORT_R5, REPORT_R6, "--out", out])
+    assert rc == 0
+    with open(out) as f:
+        text = f.read()
+    assert "0.370 s -> 0.317 s" in text
+    assert "| 64.2 | 0.0 | -64.2 | 0.00x | 885->0 | sort |" in text
+    rc = _obs(["diff", REPORT_R5])
+    assert rc == 2  # one path is unusable input
+
+
+def test_cli_baseline_exit_codes(tmp_path, capsys):
+    rc = _obs(["baseline", "--ledger", HISTORY_FIXTURE])
+    assert rc == 0
+    assert "ANOMALY" not in capsys.readouterr().out
+    # a doctored copy with a 2x head must exit 1 and name the stage
+    doctored = str(tmp_path / "h.jsonl")
+    records = _slowed_history(2.0, "peaks")
+    with open(doctored, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    rc = _obs(["baseline", "--ledger", doctored, "--write-ledger"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ANOMALY peaks" in out
+    assert load_history(doctored, kinds=("anomaly",))
+
+
+# --------------------------------------------------------------------------
+# events.jsonl rotation (satellite: bounded per-job event logs)
+# --------------------------------------------------------------------------
+
+def test_event_log_rotates_past_byte_budget(tmp_path):
+    from peasoup_tpu.obs.events import EventLog
+
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, max_log_bytes=400, flood_limit=10_000)
+    for i in range(50):
+        log.emit("spin", f"event {i}")
+    log.close()
+    assert os.path.exists(path + ".1")
+    assert os.path.getsize(path) < 400
+    # both generations hold only intact JSON lines
+    kept = 0
+    for gen in (path + ".1", path):
+        with open(gen) as f:
+            for line in f:
+                assert json.loads(line)["kind"] == "spin"
+                kept += 1
+    assert 0 < kept < 50  # bounded: older generations were dropped
+
+
+def test_event_log_rotation_disabled_with_zero_budget(tmp_path):
+    from peasoup_tpu.obs.events import EventLog
+
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, max_log_bytes=0, flood_limit=10_000)
+    for i in range(50):
+        log.emit("spin", f"event {i}")
+    log.close()
+    assert not os.path.exists(path + ".1")
+    with open(path) as f:
+        assert sum(1 for _ in f) == 50
