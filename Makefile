@@ -14,8 +14,9 @@ JERK_SMOKE_DIR ?= /tmp/peasoup-jerk-smoke
 SENSITIVITY_SMOKE_DIR ?= /tmp/peasoup-sensitivity-smoke
 CHAOS_SMOKE_DIR ?= /tmp/peasoup-chaos-smoke
 OBS_SMOKE_DIR ?= /tmp/peasoup-obs-smoke
+ANALYSIS_SMOKE_DIR ?= /tmp/peasoup-analysis-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke analysis-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -178,3 +179,12 @@ obs-smoke:
 	    --dir $(OBS_SMOKE_DIR)/warehouse -n 5 --metric span.device_s
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs query \
 	    --dir $(OBS_SMOKE_DIR)/warehouse --stage peaks --limit 10
+
+# concurrency & contracts prover smoke test (ISSUE 17): writes a
+# deliberately broken fixture tree and asserts each of PSL010-PSL013
+# fires on it (nonzero exit naming the rule), `--rules` subsetting
+# works, and the real tree stays clean under the same four rules — a
+# detector that cannot detect is worse than none
+analysis-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.analysis_smoke \
+	    --dir $(ANALYSIS_SMOKE_DIR)

@@ -10,7 +10,14 @@ test):
   ``warnings.warn`` bypassing telemetry (PSL001), host syncs inside
   jitted programs (PSL002), device float64 leaks under ``ops/``
   (PSL003), Python branching on traced values (PSL004) and untyped
-  ``ValueError``/``RuntimeError`` raises in the drivers (PSL005);
+  ``ValueError``/``RuntimeError`` raises in the drivers (PSL005) —
+  plus, since ISSUE 17, the concurrency-and-contracts prover
+  (:mod:`.concurrency`, :mod:`.contracts`): Eraser-style lock
+  discipline over every thread entry point (PSL010), lock-order
+  cycle detection across modules (PSL011, the engine's first
+  whole-program rule), atomic-write discipline for serve/obs
+  artifacts (PSL012) and artifact-stream schema contracts against
+  ``obs/streams.py`` (PSL013);
 * a jaxpr-level checker (:mod:`.jaxpr_check`) that traces the five
   registered pipeline programs (dedisperse, spectrum, harmonics,
   peaks, fold) at representative shapes and asserts no f64
@@ -32,6 +39,14 @@ from .engine import (  # noqa: F401
     run_rules,
 )
 from .rules import ALL_RULES, rules_by_id  # noqa: F401
+from .concurrency import (  # noqa: F401
+    LockDisciplineRule,
+    LockOrderRule,
+)
+from .contracts import (  # noqa: F401
+    AtomicWriteRule,
+    StreamContractRule,
+)
 from .jaxpr_check import (  # noqa: F401
     JaxprFinding,
     ProgramSpec,

@@ -1,0 +1,650 @@
+"""Concurrency analyses: lock discipline (PSL010), lock order (PSL011).
+
+The serve and obs planes run ~10 thread entry points (lease
+heartbeats, telemetry samplers, observation prefetchers, timeout
+workers) against state also touched by the main thread.  These two
+rules are the lint engine's Eraser-flavoured lockset pass over that
+surface — static, conservative, and tuned to this repo's sanctioned
+patterns (CONTRIBUTING.md "Adding a thread entry point"):
+
+**PSL010 — lock discipline.**  Per class that *creates* threads
+(``threading.Thread(target=...)`` or an ``Event.wait``-loop daemon
+method), compute the set of methods reachable from each thread entry
+(intra-class ``self.m()`` fixpoint) and the ``self._x`` attributes
+each side reads/writes.  An attribute written on one side and
+accessed on the other must share a lock across *all* those accesses.
+The lockset of an access is its lexical ``with self._lock:`` nesting
+**plus the method's entry lockset**: a private (``_``-prefixed)
+method inherits the intersection of locks held at every intra-class
+call site — so ``TelemetrySampler._append``, lexically lock-free but
+only ever called under ``sample_now``'s ``with self._lock:``, is
+correctly seen as guarded.  Recognized-safe and therefore exempt:
+``threading.Event`` attributes (its wait/set handshake is the
+synchronization), ``queue.Queue``/``deque`` handoffs, lock objects
+themselves, ``threading.Thread`` handles, and attributes whose only
+out-of-thread write is in ``__init__`` (the read-only-after-
+``start()`` pattern — construction happens-before the thread).
+Classes that never create a thread (``EventLog``, ``Tracer``,
+``DispatchPipeline``) are skipped entirely: their "caller holds the
+lock" helpers are single-threaded contracts, not data races.
+
+**PSL011 — lock order.**  A whole-program rule (the engine hands it
+every file at once): every ``threading.Lock``/``RLock`` — module
+global or ``self._x`` instance attribute — becomes a node; acquiring
+``B`` while holding ``A`` (lexically nested ``with``, or a ``with``
+body calling a function that acquires, transitively, across modules
+via import resolution) adds edge ``A -> B``.  A cycle is a potential
+deadlock; the finding prints the offending chain.  Instance locks are
+keyed per *class*, the usual lockset abstraction: two instances of
+one class share a node, so an ``A -> B -> A`` report may be a
+self-deadlock or a cross-instance inversion — either deserves the
+failure.
+
+Both rules are best-effort by construction (dynamic dispatch,
+``getattr``, cross-class aliasing are out of reach), so they are
+tuned to report only what they can witness in the AST — every finding
+carries the witnessing chain or access pair.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import SourceFile
+from .rules import Rule, _dotted
+
+#: constructors classifying a ``self._x = ...`` attribute
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock"}
+_EVENT_CTORS = {"threading.Event", "Event"}
+_QUEUE_CTORS = {"queue.Queue", "queue.SimpleQueue", "Queue",
+                "SimpleQueue", "collections.deque", "deque"}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+#: method calls that mutate a container in place — a write for
+#: lock-discipline purposes
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popitem",
+             "clear", "setdefault", "remove", "discard", "insert",
+             "appendleft", "popleft", "sort"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``'_x'`` for ``self._x`` attribute nodes, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _ctor_of(call: ast.AST) -> str:
+    return _dotted(call.func) if isinstance(call, ast.Call) else ""
+
+
+class _Access:
+    """One attribute access: read or write, with its lockset."""
+
+    __slots__ = ("attr", "write", "locks", "node", "method")
+
+    def __init__(self, attr, write, locks, node, method):
+        self.attr = attr
+        self.write = write
+        self.locks = locks
+        self.node = node
+        self.method = method
+
+
+class _ClassModel:
+    """Everything PSL010 needs about one class."""
+
+    def __init__(self, cdef: ast.ClassDef, module_locks: set[str]):
+        self.cdef = cdef
+        self.module_locks = module_locks
+        self.methods: dict[str, ast.AST] = {}
+        for item in cdef.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        self.lock_attrs: set[str] = set()
+        self.exempt_attrs: set[str] = set()
+        self._classify_attrs()
+        #: thread entries: method names + nested thread-target defs
+        self.entry_methods: set[str] = set()
+        self.entry_funcs: list[tuple[str, ast.AST]] = []
+        self._find_entries()
+        #: per-method accesses and intra-class call sites
+        self.accesses: dict[str, list[_Access]] = {}
+        self.calls: dict[str, list[tuple[str, frozenset]]] = {}
+        self._thread_target_defs = {id(n) for _, n in self.entry_funcs}
+        for name, node in self.methods.items():
+            acc: list[_Access] = []
+            sites: list[tuple[str, frozenset]] = []
+            self._walk(node, frozenset(), name, acc, sites, skip_def=node)
+            self.accesses[name] = acc
+            self.calls[name] = sites
+
+    # -- attribute classification ------------------------------------------
+
+    def _classify_attrs(self) -> None:
+        for node in ast.walk(self.cdef):
+            if not isinstance(node, ast.Assign):
+                continue
+            ctor = _ctor_of(node.value)
+            if not ctor:
+                continue
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                if ctor in _LOCK_CTORS:
+                    self.lock_attrs.add(attr)
+                    self.exempt_attrs.add(attr)
+                elif ctor in _EVENT_CTORS | _QUEUE_CTORS | _THREAD_CTORS:
+                    self.exempt_attrs.add(attr)
+
+    # -- thread-entry discovery --------------------------------------------
+
+    def _find_entries(self) -> None:
+        for mname, mnode in self.methods.items():
+            nested = {
+                n.name: n for n in ast.walk(mnode)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not mnode
+            }
+            for node in ast.walk(mnode):
+                if (isinstance(node, ast.Call)
+                        and _ctor_of(node) in _THREAD_CTORS):
+                    for kw in node.keywords:
+                        if kw.arg != "target":
+                            continue
+                        attr = _self_attr(kw.value)
+                        if attr is not None and attr in self.methods:
+                            self.entry_methods.add(attr)
+                        elif (isinstance(kw.value, ast.Name)
+                                and kw.value.id in nested):
+                            self.entry_funcs.append(
+                                (f"{mname}.<{kw.value.id}>",
+                                 nested[kw.value.id]))
+            # Event.wait-loop daemon: while ... self._ev.wait(...)
+            for node in ast.walk(mnode):
+                if not isinstance(node, ast.While):
+                    continue
+                for sub in ast.walk(node.test):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "wait"
+                            and _self_attr(sub.func.value) is not None):
+                        self.entry_methods.add(mname)
+
+    @property
+    def is_threaded(self) -> bool:
+        return bool(self.entry_methods or self.entry_funcs)
+
+    # -- access/lockset walker ---------------------------------------------
+
+    def _lock_name(self, expr: ast.AST) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.lock_attrs:
+            return attr
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"::{expr.id}"
+        return None
+
+    def _walk(self, node, held, method, acc, sites, skip_def=None):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not skip_def:
+                # nested def: runs later, outside this lock scope; a
+                # nested thread target is walked as its own entry
+                if id(node) in self._thread_target_defs:
+                    return
+                held = frozenset()
+        elif isinstance(node, ast.Lambda):
+            held = frozenset()
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                name = self._lock_name(item.context_expr)
+                if name is not None:
+                    inner.add(name)
+                self._walk(item.context_expr, held, method, acc, sites)
+            inner = frozenset(inner)
+            for stmt in node.body:
+                self._walk(stmt, inner, method, acc, sites)
+            return
+        elif isinstance(node, ast.Call):
+            attr = _self_attr(node.func)
+            if attr is not None and attr in self.methods:
+                sites.append((attr, held))
+            if isinstance(node.func, ast.Attribute):
+                owner = _self_attr(node.func.value)
+                if (owner is not None and node.func.attr in _MUTATORS
+                        and self._tracked(owner)):
+                    acc.append(_Access(owner, True, held, node, method))
+        elif (isinstance(node, (ast.Subscript,))
+                and isinstance(node.ctx, (ast.Store, ast.Del))):
+            owner = _self_attr(node.value)
+            if owner is not None and self._tracked(owner):
+                acc.append(_Access(owner, True, held, node, method))
+        elif isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None and self._tracked(attr):
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                acc.append(_Access(attr, write, held, node, method))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, method, acc, sites)
+
+    def _tracked(self, attr: str) -> bool:
+        return (attr not in self.exempt_attrs
+                and attr not in self.methods)
+
+    # -- reachability + entry locksets -------------------------------------
+
+    def thread_reachable(self) -> set[str]:
+        seen = set(self.entry_methods)
+        frontier = list(seen)
+        while frontier:
+            m = frontier.pop()
+            for callee, _held in self.calls.get(m, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+        return seen
+
+    def entry_locksets(self) -> dict[str, frozenset]:
+        """Per-method lockset guaranteed held on entry.  Public
+        methods, thread entries, and methods with no intra-class call
+        site get the empty set (callers are unconstrained); private
+        methods get the intersection over every call site of (locks
+        lexically held there + the caller's own entry lockset),
+        iterated to a fixpoint."""
+        callsites: dict[str, list[tuple[str, frozenset]]] = {}
+        for caller, sites in self.calls.items():
+            for callee, held in sites:
+                callsites.setdefault(callee, []).append((caller, held))
+        all_locks = frozenset(self.lock_attrs)
+        constrained = {
+            name for name in self.methods
+            if name.startswith("_") and not name.startswith("__")
+            and name not in self.entry_methods and callsites.get(name)
+        }
+        entry: dict[str, frozenset] = {
+            name: (all_locks if name in constrained else frozenset())
+            for name in self.methods
+        }
+        for _ in range(len(self.methods) + 1):
+            changed = False
+            for name in constrained:
+                new: frozenset | None = None
+                for caller, held in callsites[name]:
+                    site = held | entry.get(caller, frozenset())
+                    new = site if new is None else (new & site)
+                new = new if new is not None else frozenset()
+                if new != entry[name]:
+                    entry[name] = new
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+
+class LockDisciplineRule(Rule):
+    """Attributes shared between a thread target's reach and the main
+    side must have a common lock over every conflicting access (see
+    module docstring for the full lattice of exemptions)."""
+
+    id = "PSL010"
+    title = "shared attribute lacks a common lock"
+
+    def run(self, sf: SourceFile):
+        module_locks = {
+            tgt.id
+            for node in sf.tree.body if isinstance(node, ast.Assign)
+            if _ctor_of(node.value) in _LOCK_CTORS
+            for tgt in node.targets if isinstance(tgt, ast.Name)
+        }
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(sf, node, module_locks)
+
+    def _check_class(self, sf, cdef, module_locks):
+        model = _ClassModel(cdef, module_locks)
+        if not model.is_threaded:
+            return
+        entry = model.entry_locksets()
+        reach = model.thread_reachable()
+        thread_acc: dict[str, list[_Access]] = {}
+        main_acc: dict[str, list[_Access]] = {}
+
+        def add(table, access, extra):
+            a = _Access(access.attr, access.write,
+                        access.locks | extra, access.node, access.method)
+            table.setdefault(a.attr, []).append(a)
+
+        for name, accs in model.accesses.items():
+            extra = entry.get(name, frozenset())
+            on_thread = name in reach
+            # a public thread-reachable non-entry method is also
+            # externally callable -> both sides (sample_now pattern)
+            on_main = (not on_thread) or (
+                not name.startswith("_")
+                and name not in model.entry_methods)
+            if name == "__init__":
+                on_main = False  # happens-before thread start
+            for a in accs:
+                if on_thread:
+                    add(thread_acc, a, extra)
+                if on_main:
+                    add(main_acc, a, extra)
+        for _fname, fnode in model.entry_funcs:
+            accs: list[_Access] = []
+            sites: list = []
+            model._walk(fnode, frozenset(), _fname, accs, sites,
+                        skip_def=fnode)
+            for a in accs:
+                add(thread_acc, a, frozenset())
+
+        for attr in sorted(set(thread_acc) | set(main_acc)):
+            t_side = thread_acc.get(attr, [])
+            m_side = main_acc.get(attr, [])
+            if not t_side or not m_side:
+                continue
+            if not (any(a.write for a in t_side)
+                    or any(a.write for a in m_side)):
+                continue  # read-only on both sides
+            common = None
+            for a in t_side + m_side:
+                common = (a.locks if common is None
+                          else common & a.locks)
+            if common:
+                continue
+            bad = next((a for a in t_side + m_side
+                        if a.write and not a.locks),
+                       next(a for a in t_side + m_side if a.write))
+            t_where = sorted({a.method for a in t_side})
+            m_where = sorted({a.method for a in m_side})
+            yield sf.violation(
+                self.id, bad.node,
+                f"class {cdef.name}: self.{attr} is written without a "
+                f"common lock — thread side {t_where} vs main side "
+                f"{m_where}; guard every access with the same "
+                f"'with self._lock:', hand off via queue/Event, or "
+                f"make it read-only after start()")
+
+
+# --------------------------------------------------------------------------
+# PSL011 — lock-order cycles
+# --------------------------------------------------------------------------
+
+def _module_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace("/", ".")
+    for prefix in ("peasoup_tpu.",):
+        if name.startswith(prefix):
+            name = name[len(prefix):]
+    return name
+
+
+class _ModuleFacts:
+    """Per-file lock/function/import inventory for PSL011."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.mod = _module_name(sf.relpath)
+        #: lock node id -> True; module locks are "mod:NAME",
+        #: instance locks "mod:Class.ATTR"
+        self.locks: set[str] = set()
+        #: function qualname ("f" or "C.m") -> node
+        self.funcs: dict[str, ast.AST] = {}
+        #: class name -> {lock attr names}
+        self.class_locks: dict[str, set[str]] = {}
+        #: module-global name -> class name (X = C() singletons)
+        self.instance_of: dict[str, str] = {}
+        #: imported name -> ("func"|"module", target module name, attr)
+        self.imports: dict[str, tuple[str, str]] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        tree = self.sf.tree
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                ctor = _ctor_of(node.value)
+                for tgt in node.targets:
+                    if not isinstance(tgt, ast.Name):
+                        continue
+                    if ctor in _LOCK_CTORS:
+                        self.locks.add(f"{self.mod}:{tgt.id}")
+                    elif ctor:
+                        self.instance_of[tgt.id] = ctor.split(".")[-1]
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                attrs: set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and _ctor_of(sub.value) in _LOCK_CTORS:
+                        for tgt in sub.targets:
+                            a = _self_attr(tgt)
+                            if a is not None:
+                                attrs.add(a)
+                if attrs:
+                    self.class_locks[node.name] = attrs
+                    for a in attrs:
+                        self.locks.add(f"{self.mod}:{node.name}.{a}")
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.funcs[f"{node.name}.{item.name}"] = item
+            elif isinstance(node, ast.ImportFrom) and node.level >= 0:
+                target = self._resolve_from(node)
+                if target is None:
+                    continue
+                for alias in node.names:
+                    key = alias.asname or alias.name
+                    if node.module is None:
+                        # ``from . import mod`` binds a module name
+                        sub = (f"{target}.{alias.name}" if target
+                               else alias.name)
+                        self.imports[key] = (sub, "")
+                    else:
+                        self.imports[key] = (target, alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("peasoup_tpu."):
+                        mod = alias.name[len("peasoup_tpu."):]
+                        self.imports[alias.asname
+                                     or alias.name.split(".")[-1]] = \
+                            (mod, "")
+
+    def _resolve_from(self, node: ast.ImportFrom) -> str | None:
+        """Target module name (package-relative) of a from-import."""
+        if node.level == 0:
+            if node.module and node.module.startswith("peasoup_tpu"):
+                rest = node.module[len("peasoup_tpu"):].lstrip(".")
+                return rest or ""
+            return None
+        parts = self.mod.split(".")
+        base = parts[:len(parts) - node.level]
+        if node.module:
+            base.append(node.module)
+        return ".".join(base)
+
+
+class LockOrderRule(Rule):
+    """Global lock-acquisition order must be acyclic; a cycle in the
+    acquired-while-holding graph is a potential deadlock."""
+
+    id = "PSL011"
+    title = "lock-order cycle (potential deadlock)"
+    whole_program = True
+
+    def run(self, sf):  # pragma: no cover - engine uses run_program
+        return iter(())
+
+    def run_program(self, sfs):
+        facts = {f.mod: f for f in (_ModuleFacts(sf) for sf in sfs)}
+        # per-function direct acquisitions / edges / call sites
+        direct_acq: dict[tuple, set[str]] = {}
+        edges: dict[str, dict[str, tuple]] = {}
+        calls: dict[tuple, list[tuple]] = {}
+
+        for mf in facts.values():
+            for qual, node in mf.funcs.items():
+                key = (mf.mod, qual)
+                acq: set[str] = set()
+                sites: list[tuple] = []
+                self._walk(mf, facts, qual, node, frozenset(), acq,
+                           sites, edges)
+                direct_acq[key] = acq
+                calls[key] = sites
+
+        # transitive acquires per function (fixpoint)
+        trans = {k: set(v) for k, v in direct_acq.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, sites in calls.items():
+                for callee, _held, _node, _mf in sites:
+                    extra = trans.get(callee)
+                    if extra and not extra <= trans[key]:
+                        trans[key] |= extra
+                        changed = True
+        # cross-call edges: held locks at a call site order-before
+        # everything the callee (transitively) acquires
+        for key, sites in calls.items():
+            for callee, held, node, mf in sites:
+                for a in held:
+                    for b in trans.get(callee, ()):
+                        if a != b:
+                            edges.setdefault(a, {}).setdefault(
+                                b, (mf.sf, node))
+
+        yield from self._find_cycles(edges)
+
+    # -- traversal ----------------------------------------------------------
+
+    def _lock_id(self, mf, facts, qual, expr) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and "." in qual:
+            cls = qual.split(".")[0]
+            if attr in mf.class_locks.get(cls, ()):
+                return f"{mf.mod}:{cls}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if f"{mf.mod}:{expr.id}" in mf.locks:
+                return f"{mf.mod}:{expr.id}"
+            imp = mf.imports.get(expr.id)
+            if imp:
+                tmod, tname = imp
+                tf = facts.get(tmod)
+                if tf and f"{tmod}:{tname}" in tf.locks:
+                    return f"{tmod}:{tname}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            # _SINGLETON._lock / mod.GLOBAL_LOCK
+            if isinstance(expr.value, ast.Name):
+                owner = expr.value.id
+                cls = mf.instance_of.get(owner)
+                if cls and expr.attr in mf.class_locks.get(cls, ()):
+                    return f"{mf.mod}:{cls}.{expr.attr}"
+                imp = mf.imports.get(owner)
+                if imp and not imp[1]:  # owner names a module
+                    tf = facts.get(imp[0])
+                    if tf and f"{imp[0]}:{expr.attr}" in tf.locks:
+                        return f"{imp[0]}:{expr.attr}"
+        return None
+
+    def _resolve_call(self, mf, facts, qual, func) -> tuple | None:
+        """(module, qualname) of a statically resolvable callee."""
+        attr = _self_attr(func)
+        if attr is not None and "." in qual:
+            cls = qual.split(".")[0]
+            if f"{cls}.{attr}" in mf.funcs:
+                return (mf.mod, f"{cls}.{attr}")
+            return None
+        if isinstance(func, ast.Name):
+            if func.id in mf.funcs:
+                return (mf.mod, func.id)
+            imp = mf.imports.get(func.id)
+            if imp:
+                tmod, tname = imp
+                tf = facts.get(tmod)
+                if tf and tname in tf.funcs:
+                    return (tmod, tname)
+            return None
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            owner = func.value.id
+            cls = mf.instance_of.get(owner)
+            if cls and f"{cls}.{func.attr}" in mf.funcs:
+                return (mf.mod, f"{cls}.{func.attr}")
+            imp = mf.imports.get(owner)
+            if imp and not imp[1]:
+                tf = facts.get(imp[0])
+                if tf and func.attr in tf.funcs:
+                    return (imp[0], func.attr)
+        return None
+
+    def _walk(self, mf, facts, qual, node, held, acq, sites, edges,
+              root=None):
+        if root is None:
+            root = node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not root:
+            # nested def: runs later (often on another thread); its
+            # acquisitions are not ordered under the enclosing locks
+            # and must not count as this function's acquires
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in node.items:
+                lock = self._lock_id(mf, facts, qual, item.context_expr)
+                if lock is not None:
+                    acq.add(lock)
+                    for h in held:
+                        if h != lock:
+                            edges.setdefault(h, {}).setdefault(
+                                lock, (mf.sf, node))
+                    inner.add(lock)
+            inner = frozenset(inner)
+            for stmt in node.body:
+                self._walk(mf, facts, qual, stmt, inner, acq, sites,
+                           edges, root)
+            return
+        if isinstance(node, ast.Call):
+            callee = self._resolve_call(mf, facts, qual, node.func)
+            if callee is not None:
+                sites.append((callee, held, node, mf))
+        for child in ast.iter_child_nodes(node):
+            self._walk(mf, facts, qual, child, held, acq, sites,
+                       edges, root)
+
+    # -- cycle detection -----------------------------------------------------
+
+    def _find_cycles(self, edges):
+        seen_cycles: set[frozenset] = set()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in edges}
+
+        def dfs(n, stack):
+            color[n] = GREY
+            stack.append(n)
+            for m, witness in sorted(edges.get(n, {}).items()):
+                if color.get(m, WHITE) == GREY:
+                    cycle = stack[stack.index(m):] + [m]
+                    key = frozenset(cycle)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        yield cycle, witness
+                elif color.get(m, WHITE) == WHITE and m in edges:
+                    yield from dfs(m, stack)
+            stack.pop()
+            color[n] = BLACK
+
+        for n in sorted(edges):
+            if color.get(n, WHITE) == WHITE:
+                for cycle, (sf, node) in dfs(n, []):
+                    chain = " -> ".join(cycle)
+                    yield sf.violation(
+                        self.id, node,
+                        f"lock-order cycle: {chain}; every code path "
+                        f"must acquire these locks in one global "
+                        f"order (or drop the nesting)")

@@ -1,0 +1,213 @@
+"""Artifact contracts: atomic writes (PSL012), stream schemas (PSL013).
+
+**PSL012 — atomic-write discipline.**  OBSERVABILITY.md's first
+shared design rule is rename atomicity: a killed writer leaves the
+old artifact or the new one, never a torn half-write.  The sanctioned
+spelling is :mod:`peasoup_tpu.utils.atomicio` (tmp + ``os.replace``,
+opt-in fsync), which lives *outside* the scanned packages — so inside
+``serve/`` and ``obs/`` any truncating text ``open(path, "w")`` is a
+violation, the same single-sanctioned-site scheme PSL008 applies to
+``time.sleep``.  Append-mode JSONL streams (``"a"``: torn *tails* are
+tolerated by every reader) and binary payload streaming (``"wb"``:
+the injection harness's ``.fil`` writer) are exempt; this rule is
+about truncate-in-place races on spool records, leases, reports,
+sidecars and indexes.
+
+**PSL013 — stream contracts.**  :mod:`peasoup_tpu.obs.streams`
+declares each artifact stream's schema (version, required/optional
+keys) and its binding sites.  In a declared *writer* function, every
+dict literal carrying the stream's version key is a record: a string
+key outside the declaration is flagged (missing keys are not — many
+record keys are conditional by design).  ``var["k"] = ...`` stores on
+the declared record variable are held to the same contract.  In a
+declared *reader*, every ``var["k"]`` / ``var.get("k")`` on the
+declared variable must name a declared key — a key no writer can
+produce reads as dead code or a typo (this rule found
+``ingest_timeline`` polling a ``"ts"`` key timeline marks never
+carry).  Module version constants bound in the catalog must equal
+the declared version when written as an int literal; constants
+*sourced from the catalog* are non-literal and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import SourceFile
+from .rules import Rule, _in_pkg
+
+#: truncating text modes; "wb"/"ab"/"a"/"x" stay legal
+_TRUNCATING = {"w", "wt", "tw", "w+", "+w", "wt+", "w+t"}
+
+
+class AtomicWriteRule(Rule):
+    """Truncating ``open(path, "w")`` in the serve/obs planes must go
+    through ``peasoup_tpu.utils.atomicio`` (tmp + ``os.replace``)."""
+
+    id = "PSL012"
+    title = "raw truncating write (use utils.atomicio)"
+
+    def applies(self, relpath: str) -> bool:
+        return _in_pkg(relpath, "serve", "obs")
+
+    def run(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                continue
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if not (isinstance(mode, ast.Constant)
+                    and isinstance(mode.value, str)):
+                continue
+            if mode.value not in _TRUNCATING:
+                continue
+            yield sf.violation(
+                self.id, node,
+                f"open(..., {mode.value!r}) truncates in place; write "
+                f"through peasoup_tpu.utils.atomicio "
+                f"(atomic_write_text/json: tmp + os.replace, opt-in "
+                f"fsync) so a killed writer never leaves a torn file")
+
+
+# --------------------------------------------------------------------------
+# PSL013 — stream schema contracts
+# --------------------------------------------------------------------------
+
+def _qualname(sf: SourceFile, node: ast.AST) -> str:
+    """``Class.method`` / ``func`` for a def node (one class level —
+    matching the catalog's binding convention)."""
+    parents = sf.parents()
+    names = [node.name]
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            names.append(cur.name)
+        elif isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names))
+
+
+class StreamContractRule(Rule):
+    """Writer dict-literal keys, reader subscript/.get keys and
+    version constants must agree with ``obs/streams.py``."""
+
+    id = "PSL013"
+    title = "artifact-stream key/version outside the declared contract"
+
+    def run(self, sf: SourceFile):
+        # late import, PSL009-style: rules must not drag obs into
+        # every engine import
+        from ..obs.streams import (STREAMS, reader_bindings,
+                                   stream_keys, version_bindings,
+                                   writer_bindings)
+
+        writers = {q: b for (rel, q), b in writer_bindings().items()
+                   if rel == sf.relpath}
+        readers = {q: b for (rel, q), b in reader_bindings().items()
+                   if rel == sf.relpath}
+        versions = {c: b for (rel, c), b in version_bindings().items()
+                    if rel == sf.relpath}
+        if not (writers or readers or versions):
+            return
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and versions:
+                yield from self._check_version(sf, node, versions)
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = _qualname(sf, node)
+            if qual in writers:
+                stream, varname = writers[qual]
+                allowed = stream_keys(stream) | {
+                    STREAMS[stream]["version_key"]}
+                yield from self._check_writer(
+                    sf, node, stream, varname, allowed,
+                    STREAMS[stream]["version_key"])
+            for stream, varname in readers.get(qual, ()):
+                allowed = stream_keys(stream) | {
+                    STREAMS[stream]["version_key"]}
+                yield from self._check_reader(
+                    sf, node, stream, varname, allowed)
+
+    # -- checks --------------------------------------------------------------
+
+    def _check_version(self, sf, node, versions):
+        for tgt in node.targets:
+            if not isinstance(tgt, ast.Name) or tgt.id not in versions:
+                continue
+            stream, want = versions[tgt.id]
+            if (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                    and node.value.value != want):
+                yield sf.violation(
+                    self.id, node,
+                    f"{tgt.id} = {node.value.value} but stream "
+                    f"{stream!r} declares version {want} in "
+                    f"obs/streams.py — bump both together")
+
+    def _check_writer(self, sf, fnode, stream, varname, allowed,
+                      version_key):
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Dict):
+                keys = [k for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)]
+                if not any(k.value == version_key for k in keys):
+                    continue  # not a record literal of this stream
+                for k in keys:
+                    if k.value not in allowed:
+                        yield sf.violation(
+                            self.id, k,
+                            f"writer emits undeclared key "
+                            f"{k.value!r} for stream {stream!r}; "
+                            f"declare it in obs/streams.py (readers "
+                            f"and the warehouse flatteners key off "
+                            f"the contract)")
+            elif (varname is not None
+                    and isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Store)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == varname
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and node.slice.value not in allowed):
+                yield sf.violation(
+                    self.id, node,
+                    f"writer stores undeclared key "
+                    f"{node.slice.value!r} on stream {stream!r} "
+                    f"record; declare it in obs/streams.py")
+
+    def _check_reader(self, sf, fnode, stream, varname, allowed):
+        for node in ast.walk(fnode):
+            key = None
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == varname
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                key = node.slice.value
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == varname
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                key = node.args[0].value
+            if key is not None and key not in allowed:
+                yield sf.violation(
+                    self.id, node,
+                    f"reader asks for key {key!r} which no "
+                    f"stream-{stream!r} writer can produce (see "
+                    f"obs/streams.py) — dead code or a typo")

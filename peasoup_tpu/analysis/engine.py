@@ -89,6 +89,7 @@ class SourceFile:
     lines: list[str] = field(default_factory=list)
     line_disables: dict[int, set[str]] = field(default_factory=dict)
     file_disables: set[str] = field(default_factory=set)
+    _parents: dict | None = field(default=None, repr=False)
 
     @classmethod
     def load(cls, path: str, relpath: str) -> "SourceFile":
@@ -109,6 +110,19 @@ class SourceFile:
             else:
                 sf.line_disables.setdefault(lineno, set()).update(ids)
         return sf
+
+    def parents(self) -> dict:
+        """Child -> parent map over the whole tree, built once per
+        file and shared by every rule that needs enclosing-scope
+        context (the 13-rule run must parse AND walk each file once,
+        not once per rule)."""
+        if self._parents is None:
+            parents: dict = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    parents[child] = node
+            self._parents = parents
+        return self._parents
 
     def snippet_at(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -135,6 +149,25 @@ class SourceFile:
             rule=rule_id, path=self.relpath, line=line, message=message,
             snippet=self.snippet_at(line),
         )
+
+
+#: per-process parse cache: abs path -> (mtime_ns, size, SourceFile).
+#: Repeated ``run_rules`` calls (the CLI after a test run, per-file
+#: gates in tests, ``bench.py --lint``) reuse the parsed tree as long
+#: as the file on disk is unchanged; a stat is the only cost.
+_SF_CACHE: dict[str, tuple[int, int, "SourceFile"]] = {}
+
+
+def load_source_file(path: str, relpath: str) -> "SourceFile":
+    """Cached :meth:`SourceFile.load` keyed by (mtime_ns, size)."""
+    st = os.stat(path)
+    hit = _SF_CACHE.get(path)
+    if (hit is not None and hit[0] == st.st_mtime_ns
+            and hit[1] == st.st_size and hit[2].relpath == relpath):
+        return hit[2]
+    sf = SourceFile.load(path, relpath)
+    _SF_CACHE[path] = (st.st_mtime_ns, st.st_size, sf)
+    return sf
 
 
 def package_root() -> str:
@@ -183,7 +216,7 @@ def iter_source_files(paths: list[str] | None = None,
             seen.add(fp)
             rel = os.path.relpath(fp, root).replace(os.sep, "/")
             try:
-                yield SourceFile.load(fp, rel)
+                yield load_source_file(fp, rel)
             except (SyntaxError, UnicodeDecodeError, OSError) as exc:
                 yield (fp, exc)
 
@@ -193,16 +226,30 @@ def run_rules(rules, paths: list[str] | None = None,
     """Apply ``rules`` to the sources; returns
     ``(violations, suppressed, errors)`` where ``suppressed`` counts
     pragma-silenced findings and ``errors`` is a list of
-    ``(path, message)`` for unparseable files."""
+    ``(path, message)`` for unparseable files.
+
+    Rules come in two shapes: per-file rules (``run(sf)``, the PR 2
+    protocol) see one file at a time; rules with a truthy
+    ``whole_program`` attribute implement ``run_program(sfs)`` instead
+    and see every (applicable) file at once — the lock-order analysis
+    (PSL011) needs the cross-module acquisition graph.  Both yield
+    :class:`Violation` and go through the same pragma filter.
+    """
     violations: list[Violation] = []
     suppressed = 0
     errors: list[tuple[str, str]] = []
+    sources: list[SourceFile] = []
     for sf in iter_source_files(paths, root=root):
         if isinstance(sf, tuple):
             path, exc = sf
             errors.append((path, f"{type(exc).__name__}: {exc}"))
             continue
-        for rule in rules:
+        sources.append(sf)
+    per_file = [r for r in rules
+                if not getattr(r, "whole_program", False)]
+    program = [r for r in rules if getattr(r, "whole_program", False)]
+    for sf in sources:
+        for rule in per_file:
             if not rule.applies(sf.relpath):
                 continue
             for v in rule.run(sf):
@@ -213,6 +260,17 @@ def run_rules(rules, paths: list[str] | None = None,
                 # it mattered) — a trailing pragma on the same line is
                 # the common case either way
                 if sf.is_suppressed(v.rule, v.line, end):
+                    suppressed += 1
+                else:
+                    violations.append(v)
+    if program:
+        by_rel = {sf.relpath: sf for sf in sources}
+        for rule in program:
+            scoped = [sf for sf in sources if rule.applies(sf.relpath)]
+            for v in rule.run_program(scoped):
+                sf = by_rel.get(v.path)
+                if sf is not None and sf.is_suppressed(
+                        v.rule, v.line, v.line):
                     suppressed += 1
                 else:
                     violations.append(v)

@@ -21,6 +21,18 @@ PSL008   bare ``time.sleep`` outside ``serve/retry.py`` (scheduler
 PSL009   literal ``METRICS.inc``/``METRICS.gauge`` name missing from
          ``obs/catalog.py`` (every metric name is a queryable
          contract — an uncatalogued name is a dangling wire)
+PSL010   attribute shared between a thread target's reach and the
+         main thread without a common ``with self._lock:`` guard
+         (Eraser-style lockset check; Event/queue/read-only-after-
+         ``start()`` handoffs recognized — see ``concurrency.py``)
+PSL011   cycle in the global lock-acquisition order graph (potential
+         deadlock; the finding prints the offending chain)
+PSL012   truncating ``open(path, "w")`` under ``serve/``/``obs/``
+         instead of the sanctioned ``utils.atomicio`` tmp +
+         ``os.replace`` helpers (see ``contracts.py``)
+PSL013   artifact-stream record key or schema version outside the
+         declared contract in ``obs/streams.py`` (undeclared writer
+         key, impossible reader key, drifted version constant)
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -769,6 +781,11 @@ class MetricsCatalogRule(Rule):
                 )
 
 
+# imported at the tail so concurrency/contracts can subclass Rule
+# (defined above) without a cycle at module-init time
+from .concurrency import LockDisciplineRule, LockOrderRule  # noqa: E402
+from .contracts import AtomicWriteRule, StreamContractRule  # noqa: E402
+
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
     NoHostSyncInJitRule(),
@@ -779,6 +796,10 @@ ALL_RULES: tuple[Rule, ...] = (
     CostModelAuthorityRule(),
     NoBareSleepRule(),
     MetricsCatalogRule(),
+    LockDisciplineRule(),
+    LockOrderRule(),
+    AtomicWriteRule(),
+    StreamContractRule(),
 )
 
 

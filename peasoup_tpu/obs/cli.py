@@ -141,12 +141,9 @@ def cmd_diff(args) -> int:
     else:
         text = render_markdown(diff)
         if args.out:
-            import os
+            from ..utils.atomicio import atomic_write_text
 
-            tmp = args.out + f".tmp{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.write(text)
-            os.replace(tmp, args.out)
+            atomic_write_text(args.out, text)
             print(f"wrote {args.out}")
         else:
             print(text, end="")

@@ -259,11 +259,7 @@ def render_markdown(diff: dict, *, title: str | None = None) -> str:
 def write_trace_summary(path: str, diff: dict, *,
                         title: str | None = None) -> str:
     """Write the rendered markdown atomically; returns the path."""
-    import os
+    from ..utils.atomicio import atomic_write_text
 
-    text = render_markdown(diff, title=title)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(text)
-    os.replace(tmp, path)
+    atomic_write_text(path, render_markdown(diff, title=title))
     return path

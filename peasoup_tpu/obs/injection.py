@@ -218,9 +218,10 @@ def synthesize(path: str, *, period: float | None = None,
 
 
 def save_manifest(manifest: dict, path: str) -> str:
-    with open(path, "w") as f:
-        json.dump(manifest, f, indent=1, sort_keys=True)
-        f.write("\n")
+    from ..utils.atomicio import atomic_write_json
+
+    atomic_write_json(path, manifest, indent=1, sort_keys=True,
+                      trailing_newline=True)
     return path
 
 

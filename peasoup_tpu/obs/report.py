@@ -43,6 +43,8 @@ import json
 import os
 import time
 
+from ..utils.atomicio import atomic_write_json
+
 REPORT_VERSION = 2
 
 
@@ -188,11 +190,8 @@ def write_run_report(path: str, result=None, registry=None, events=None,
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(report, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write_json(path, report, indent=1, sort_keys=True,
+                          trailing_newline=True)
     except OSError as exc:
         import warnings
 

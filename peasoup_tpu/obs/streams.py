@@ -1,0 +1,203 @@
+"""The artifact-stream contract catalog (ISSUE 17, PSL013).
+
+PR 16's :mod:`.catalog` declared every *metric name*; this module
+does the same for the *artifact streams* — the schema-versioned
+record shapes that cross process (and PR) boundaries on disk:
+``events.jsonl``, telemetry shards, per-job timelines, the history
+ledger, warehouse rows and ``run_report.json``.  Each entry declares
+the stream's schema version, its required and optional record keys,
+and the **binding sites**: which functions write records (their
+emitted dict literals are statically checked by lint rule PSL013),
+which functions read them (every ``rec["k"]`` / ``rec.get("k")`` on
+the declared variable must name a declared key — a reader key no
+writer can produce is dead code or a typo), and which module constant
+mirrors the version (a drifted literal is a lint failure; constants
+*sourced from this catalog*, like ``WAREHOUSE_VERSION``, are exempt
+because they cannot drift).
+
+Like :mod:`.catalog` this module is pure data — it imports nothing,
+so the analysis package (and tests) can read it without dragging in
+jax.  Streams whose records admit caller-chosen extension keys
+(timeline ``**attrs``, history ``extra`` merges) list the known ones
+as optional; merges via ``dict.update`` are by design outside the
+static checker's reach, but every *literal* key is in contract.
+
+Adding a stream, or a key to one: extend the entry here first, then
+the writer — PSL013 fails the build when the code and the declaration
+disagree, in either direction.  See CONTRIBUTING.md.
+"""
+
+from __future__ import annotations
+
+#: stream name -> contract.  Binding sites are repo-relative posix
+#: paths plus the function qualname (``Class.method`` or ``func``);
+#: writer/reader tuples carry the record variable name checked inside
+#: that function (``None`` = check dict literals only).
+STREAMS: dict[str, dict] = {
+    "events": {
+        "version": 1,
+        "version_key": "v",
+        "version_const": ("peasoup_tpu/obs/events.py", "SCHEMA_VERSION"),
+        "required": ("v", "ts", "kind", "message"),
+        "optional": ("data",),
+        "writers": (
+            ("peasoup_tpu/obs/events.py", "EventLog.emit", "rec"),
+            ("peasoup_tpu/obs/events.py", "EventLog._flood_summary", None),
+        ),
+        "readers": (),
+        "doc": "typed warn/info event lines (events.jsonl)",
+    },
+    "telemetry": {
+        "version": 1,
+        "version_key": "v",
+        "version_const": ("peasoup_tpu/obs/telemetry.py",
+                          "TS_SCHEMA_VERSION"),
+        "required": ("v", "ts", "host", "pid", "seq", "interval_s",
+                     "counters", "timers", "gauges", "overhead_s"),
+        # extras() merge keys are caller-chosen; the known ones:
+        "optional": ("extras_error", "queue", "claimed", "jobs_done"),
+        "writers": (
+            ("peasoup_tpu/obs/telemetry.py",
+             "TelemetrySampler.sample_now", "rec"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/telemetry.py", "read_samples", "r"),
+            ("peasoup_tpu/obs/telemetry.py", "latest_by_host", "rec"),
+            ("peasoup_tpu/obs/warehouse.py", "telemetry_rows", "sample"),
+        ),
+        "doc": "per-host fleet/ts-<host>.jsonl sampler shards",
+    },
+    "timeline": {
+        "version": 1,
+        "version_key": "v",
+        "version_const": ("peasoup_tpu/obs/timeline.py",
+                          "TIMELINE_VERSION"),
+        "required": ("v", "phase", "t_wall", "t_mono", "host", "pid",
+                     "attempt"),
+        # **attrs keys stamped by the spool/worker/recorder call sites:
+        "optional": ("priority", "tenant", "worker", "leader",
+                     "resumes", "from_state", "dead_host", "span",
+                     "device_s", "compile"),
+        "writers": (
+            ("peasoup_tpu/obs/timeline.py", "mark", "rec"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/timeline.py", "read_timeline", "rec"),
+            ("peasoup_tpu/obs/warehouse.py",
+             "Warehouse.ingest_timeline", "mark"),
+        ),
+        "doc": "per-job lifecycle marks (timeline.jsonl)",
+    },
+    "history": {
+        "version": 1,
+        "version_key": "v",
+        "version_const": ("peasoup_tpu/obs/history.py",
+                          "HISTORY_VERSION"),
+        "required": ("v", "ts", "kind"),
+        "optional": (
+            # make_history_record sections
+            "git", "device", "metrics", "timers", "stage_device_s",
+            "utilization", "compile_counts", "parity", "config",
+            "mesh_shape",
+            # anomaly records (obs/baseline.py) ride the same ledger
+            "key", "metric", "value", "median", "mad", "band",
+            "z_score", "direction", "severity",
+        ),
+        "writers": (
+            ("peasoup_tpu/obs/history.py", "make_history_record",
+             "rec"),
+            ("peasoup_tpu/obs/baseline.py", "make_anomaly", None),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/warehouse.py", "history_rows", "rec"),
+        ),
+        "doc": "benchmarks/history.jsonl ledger (bench/serve/anomaly "
+               "records)",
+    },
+    "warehouse": {
+        "version": 1,
+        "version_key": "v",
+        # WAREHOUSE_VERSION is *sourced from* this entry (no literal
+        # to drift), so no version_const binding
+        "version_const": None,
+        "required": ("v", "ts", "run", "source", "stage", "geometry",
+                     "device_kind", "host", "metric", "value"),
+        "optional": ("data",),
+        "writers": (
+            ("peasoup_tpu/obs/warehouse.py", "make_row", "row"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/warehouse.py", "Warehouse.rows", "row"),
+            ("peasoup_tpu/obs/warehouse.py", "Warehouse.rows", "r"),
+            ("peasoup_tpu/obs/warehouse.py", "Warehouse.top", "r"),
+            ("peasoup_tpu/obs/warehouse.py", "Warehouse.tail", "r"),
+            ("peasoup_tpu/obs/warehouse.py", "row_key", "row"),
+        ),
+        "doc": "flattened warehouse/segment.jsonl rows",
+    },
+    "run_report": {
+        "version": 2,
+        "version_key": "schema_version",
+        "version_const": ("peasoup_tpu/obs/report.py",
+                          "REPORT_VERSION"),
+        "required": ("schema_version", "version", "generated_utc",
+                     "timers", "stage_timers", "counters", "gauges",
+                     "spans", "events", "jit", "device"),
+        # conditional sections + bench's `extra` merge keys
+        "optional": ("perf", "candidates", "config", "n_dm_trials",
+                     "n_accel_trials_dm0", "parity", "vs_baseline"),
+        "writers": (
+            ("peasoup_tpu/obs/report.py", "build_run_report",
+             "report"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/warehouse.py", "run_report_rows",
+             "report"),
+        ),
+        "doc": "per-run run_report.json (schema v2)",
+    },
+}
+
+
+def stream_version(name: str) -> int:
+    """The declared schema version of ``name`` (the single source of
+    truth — ``obs/warehouse.py`` imports its version from here)."""
+    return int(STREAMS[name]["version"])
+
+
+def stream_keys(name: str) -> frozenset[str]:
+    """All keys a record of stream ``name`` may carry."""
+    ent = STREAMS[name]
+    return frozenset(ent["required"]) | frozenset(ent["optional"])
+
+
+def writer_bindings() -> dict[tuple[str, str], tuple[str, str | None]]:
+    """(relpath, qualname) -> (stream, record varname) for every
+    declared writer site."""
+    out: dict[tuple[str, str], tuple[str, str | None]] = {}
+    for stream, ent in STREAMS.items():
+        for relpath, qualname, varname in ent["writers"]:
+            out[(relpath, qualname)] = (stream, varname)
+    return out
+
+
+def reader_bindings() -> dict[tuple[str, str], list[tuple[str, str]]]:
+    """(relpath, qualname) -> [(stream, varname), ...] for every
+    declared reader site."""
+    out: dict[tuple[str, str], list[tuple[str, str]]] = {}
+    for stream, ent in STREAMS.items():
+        for relpath, qualname, varname in ent["readers"]:
+            out.setdefault((relpath, qualname), []).append(
+                (stream, varname))
+    return out
+
+
+def version_bindings() -> dict[tuple[str, str], tuple[str, int]]:
+    """(relpath, constname) -> (stream, version) for every stream
+    whose version is mirrored in a module constant."""
+    out: dict[tuple[str, str], tuple[str, int]] = {}
+    for stream, ent in STREAMS.items():
+        const = ent.get("version_const")
+        if const:
+            out[tuple(const)] = (stream, int(ent["version"]))
+    return out

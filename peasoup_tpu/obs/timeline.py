@@ -55,6 +55,7 @@ import os
 import threading
 import time
 
+from ..utils.atomicio import atomic_write_json
 from .metrics import REGISTRY as METRICS
 
 #: mark-line schema version
@@ -402,11 +403,7 @@ def write_trace_json(path: str, doc: dict) -> str:
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(out, f)
-        f.write("\n")
-    os.replace(tmp, path)
+    atomic_write_json(path, out, trailing_newline=True)
     return path
 
 

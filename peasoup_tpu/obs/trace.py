@@ -46,6 +46,7 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from ..utils.atomicio import atomic_write_json
 from .metrics import REGISTRY
 
 #: hard cap on retained spans per process — a runaway per-element
@@ -454,11 +455,7 @@ def write_merged_trace(path: str, tracer: Tracer | None = None,
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write_json(path, doc, trailing_newline=True)
     except OSError as exc:
         import warnings
 

@@ -45,9 +45,14 @@ import json
 import os
 import time
 
+from ..utils.atomicio import atomic_write_json
+from .streams import stream_version
+
 #: schema version stamped on every row; readers skip (and count)
-#: rows from the future
-WAREHOUSE_VERSION = 1
+#: rows from the future (sourced from the stream catalog so the two
+#: can never drift — PSL013 checks literal version constants against
+#: the catalog, and a catalog-sourced constant is exempt by design)
+WAREHOUSE_VERSION = stream_version("warehouse")
 
 #: seal (rotate) the live segment past this size — same default scale
 #: as the telemetry shards
@@ -215,10 +220,7 @@ class Warehouse:
                 ent["sources"] = sorted(
                     set(ent["sources"]) | {row["source"]})
         index["rows_total"] = index.get("rows_total", 0) + len(new_rows)
-        tmp = self.index_path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(index, f, sort_keys=True)
-        os.replace(tmp, self.index_path)
+        atomic_write_json(self.index_path, index, sort_keys=True)
 
     def _load_index(self) -> dict:
         try:
@@ -370,16 +372,19 @@ class Warehouse:
 
         rows: list[dict] = []
         for mark in read_timeline(path_or_workdir):
-            ts = mark.get("ts")
+            # marks carry "t_wall" (see obs/streams.py); this used to
+            # read "ts"/"job" — keys no mark writer ever produces — so
+            # timeline ingestion silently dropped every row (PSL013)
+            ts = mark.get("t_wall")
             if ts is None:
                 continue
             rows.append(make_row(
-                ts=float(ts), run=run or str(mark.get("job", "")),
+                ts=float(ts), run=run,
                 source="timeline", stage=str(mark.get("phase", "")),
                 host=str(mark.get("host", "")),
                 metric="timeline.mark", value=1.0,
                 data={k: v for k, v in mark.items()
-                      if k in ("attempt", "job")}))
+                      if k in ("attempt",)}))
         return self.append_rows(rows)
 
 

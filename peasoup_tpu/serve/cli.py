@@ -67,6 +67,8 @@ import os
 import sys
 import time
 
+from ..utils.atomicio import atomic_write_json
+
 
 def _parse_override(text: str) -> tuple[str, object]:
     """``key=value`` with the value coerced like the main CLI would:
@@ -734,12 +736,10 @@ def cmd_coincidence(spool, args) -> int:
     if args.json_path:
         import json
 
-        tmp = args.json_path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"v": 1, "freq_tol": args.freq_tol,
-                       "min_sources": args.min_sources,
-                       "groups": groups}, f, sort_keys=True)
-        os.replace(tmp, args.json_path)
+        atomic_write_json(args.json_path,
+                          {"v": 1, "freq_tol": args.freq_tol,
+                           "min_sources": args.min_sources,
+                           "groups": groups}, sort_keys=True)
         print(f"wrote {args.json_path}")
     return 0
 
@@ -762,10 +762,7 @@ def cmd_timeline(spool, args) -> int:
         doc["state"] = state[0]
     print(timeline.render_waterfall(doc, width=args.width))
     if args.json_path:
-        tmp = args.json_path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, sort_keys=True)
-        os.replace(tmp, args.json_path)
+        atomic_write_json(args.json_path, doc, sort_keys=True)
         print(f"wrote {args.json_path}")
     if args.trace_path:
         print(f"wrote {timeline.write_trace_json(args.trace_path, doc)}")

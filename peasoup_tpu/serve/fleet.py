@@ -46,6 +46,7 @@ from dataclasses import dataclass
 
 from ..errors import ConfigError
 from ..obs.metrics import REGISTRY as METRICS
+from ..utils.atomicio import atomic_write_json
 from .queue import DEFAULT_LEASE_TTL_S, JobRecord, JobSpool
 from .retry import abandoned_count
 from .store import ShardedCandidateStore, safe_label
@@ -254,10 +255,7 @@ class FleetWorker(SurveyWorker):
         d = os.path.join(self.spool.root, FLEET_DIR)
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"{self.membership.label}.json")
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, sort_keys=True)
-        os.replace(tmp, path)
+        atomic_write_json(path, doc, sort_keys=True)
         return path
 
 
@@ -360,8 +358,5 @@ def write_fleet_report(spool: JobSpool, report: dict | None = None,
     """Serialise :func:`fleet_report` next to the spool (atomic)."""
     report = report if report is not None else fleet_report(spool)
     path = path or os.path.join(spool.root, REPORT_BASENAME)
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(report, f, sort_keys=True, indent=1)
-    os.replace(tmp, path)
+    atomic_write_json(path, report, sort_keys=True, indent=1)
     return path

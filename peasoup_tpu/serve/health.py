@@ -45,6 +45,7 @@ import time
 from dataclasses import asdict, dataclass, field
 
 from ..obs.history import default_ledger_path, load_history
+from ..utils.atomicio import atomic_write_json
 from ..obs.telemetry import (
     DEFAULT_INTERVAL_S,
     latest_by_host,
@@ -843,8 +844,5 @@ def format_findings(report: dict) -> str:
 
 def write_health_report(report: dict, path: str) -> str:
     """Serialise a health report atomically (``--json PATH``)."""
-    tmp = path + f".tmp{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(report, f, sort_keys=True, indent=1)
-    os.replace(tmp, path)
+    atomic_write_json(path, report, sort_keys=True, indent=1)
     return path

@@ -76,6 +76,7 @@ from ..errors import AdmissionError, ConfigError
 from ..obs import timeline
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
+from ..utils.atomicio import atomic_write_json, atomic_write_text
 
 #: spool subdirectories, in lifecycle order
 STATES = ("pending", "running", "done", "failed")
@@ -225,11 +226,8 @@ class AdmissionPolicy:
 
     def save(self, root: str) -> str:
         path = os.path.join(root, ADMISSION_BASENAME)
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(self.to_obj(), f, sort_keys=True, indent=1)
-            f.write("\n")
-        os.replace(tmp, path)
+        atomic_write_json(path, self.to_obj(), sort_keys=True,
+                          indent=1, trailing_newline=True)
         return path
 
 
@@ -316,13 +314,8 @@ class JobSpool:
             os.close(fd)
 
     def _write(self, path: str, rec: JobRecord) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(rec.to_json() + "\n")
-            if self.durable:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, path)
+        atomic_write_text(path, rec.to_json() + "\n",
+                          fsync=self.durable)
 
     def _read(self, path: str) -> JobRecord | None:
         try:
@@ -577,18 +570,16 @@ class JobSpool:
         rewrite).  Written on claim and then every ~TTL/3 by the
         owner's heartbeat thread (serve/fleet.py LeaseHeartbeat), so a
         fresh lease means the owning host is demonstrably alive."""
-        path = self._lease_path(rec.job_id)
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({
-                "v": 1,
-                "job_id": rec.job_id,
-                "worker": rec.worker,
-                "host": rec.host,
-                "attempt": rec.attempts,
-                "utc": round(time.time(), 3),
-            }, f)
-        os.replace(tmp, path)
+        # deliberately never fsynced: rename atomicity alone is the
+        # lease contract, and this runs every ~TTL/3 per running job
+        atomic_write_json(self._lease_path(rec.job_id), {
+            "v": 1,
+            "job_id": rec.job_id,
+            "worker": rec.worker,
+            "host": rec.host,
+            "attempt": rec.attempts,
+            "utc": round(time.time(), 3),
+        })
 
     def lease_info(self, job_id: str) -> dict | None:
         """The job's lease record, or None (missing/corrupt — a torn

@@ -54,6 +54,7 @@ from ..obs.events import get_event_log
 from ..obs.history import append_history, make_history_record
 from ..obs.metrics import REGISTRY as METRICS
 from ..obs.telemetry import TelemetrySampler, shard_path
+from ..utils.atomicio import atomic_write_json
 from .health import (
     CRIT,
     DEFAULT_STALE_AFTER,
@@ -484,13 +485,9 @@ class Supervisor:
             "actions_total": len(self.actions_taken),
             "last_results": results[-8:],
         }
-        path = self.status_path()
-        tmp = path + f".tmp{os.getpid()}"
         try:
-            with open(tmp, "w") as f:
-                json.dump(doc, f, sort_keys=True)
-                f.write("\n")
-            os.replace(tmp, path)
+            atomic_write_json(self.status_path(), doc, sort_keys=True,
+                              trailing_newline=True)
         except OSError:
             pass  # status is advisory; the loop must not die for it
 

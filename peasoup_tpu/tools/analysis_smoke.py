@@ -1,0 +1,156 @@
+"""Static-analysis smoke test (``make analysis-smoke``).
+
+Proves the concurrency & contracts prover (ISSUE 17) actually *fires*:
+writes a deliberately broken fixture tree — an unguarded shared
+attribute, an AB/BA lock-order cycle, a raw truncating ``open`` under
+``serve/``, and a stream writer smuggling an undeclared key next to a
+drifted schema-version constant — then runs each of PSL010–PSL013 over
+it via ``python -m peasoup_tpu.analysis --rules PSL0xx`` and asserts a
+NONZERO exit naming the rule.  A detector that cannot detect is worse
+than none: the repo-clean gate in tests/test_concurrency_lint.py only
+means the tree is quiet, this smoke means the alarm still works.
+
+Also exercises the ``--rules`` subsetting path both ways: a combined
+``--rules PSL010,PSL011`` run must flag both fixtures, and the same
+four rules over the *real* tree must exit 0 (every real finding was
+fixed or pragma'd, not baselined).
+
+Exit status 0 only if every assertion holds — CI-gateable like the
+other ``*-smoke`` targets.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+#: fixture relpath -> (source, rule expected to fire)
+FIXTURES: dict[str, tuple[str, str]] = {
+    "peasoup_tpu/serve/unguarded.py": ("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self._run,
+                                           daemon=True)
+
+            def _run(self):
+                while True:
+                    self.count += 1
+
+            def snapshot(self):
+                return self.count
+    """, "PSL010"),
+    "peasoup_tpu/serve/deadlock.py": ("""
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:
+                    pass
+    """, "PSL011"),
+    "peasoup_tpu/serve/rawwrite.py": ("""
+        import json
+
+        def save_status(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+    """, "PSL012"),
+    # impersonates a declared PSL013 writer site: drifted version
+    # constant + an undeclared record key
+    "peasoup_tpu/obs/events.py": ("""
+        SCHEMA_VERSION = 99
+
+        class EventLog:
+            def emit(self, kind, message):
+                rec = {"v": SCHEMA_VERSION, "ts": 0.0,
+                       "kind": kind, "message": message,
+                       "smuggled": True}
+                return rec
+    """, "PSL013"),
+}
+
+
+def _check(ok: bool, msg: str) -> None:
+    if not ok:
+        print(f"analysis-smoke FAIL: {msg}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def _run_lint(rules: str, root: str, paths: list[str]) -> tuple[int, str]:
+    proc = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.analysis",
+         "--rules", rules, "--no-jaxpr", "--root", root] + paths,
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default="/tmp/peasoup-analysis-smoke",
+                    help="fixture tree scratch directory")
+    args = ap.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    for rel, (code, _rule) in FIXTURES.items():
+        path = os.path.join(args.dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(code))
+
+    # each broken fixture must trip exactly its rule
+    for rel, (_code, rule) in FIXTURES.items():
+        path = os.path.join(args.dir, rel)
+        rc, out = _run_lint(rule, args.dir, [path])
+        _check(rc == 1, f"{rule} did not fire on {rel} "
+                        f"(exit {rc}):\n{out}")
+        _check(rule in out, f"{rule} verdict does not name the rule:"
+                            f"\n{out}")
+        print(f"analysis-smoke: {rule} fired on {rel}")
+
+    # --rules subsetting: a combined run flags both concurrency
+    # fixtures, and only those rules ran (no PSL012 noise from the
+    # rawwrite fixture sitting in the same tree)
+    rc, out = _run_lint("PSL010,PSL011", args.dir,
+                        [os.path.join(args.dir, "peasoup_tpu")])
+    _check(rc == 1, f"combined --rules run should fail (exit {rc})")
+    _check("PSL010" in out and "PSL011" in out,
+           f"combined run missing a rule:\n{out}")
+    _check("PSL012" not in out,
+           f"--rules subset leaked an unrequested rule:\n{out}")
+    print("analysis-smoke: --rules PSL010,PSL011 subsetting works")
+
+    # the real tree is clean under the same four rules
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.analysis",
+         "--rules", "PSL010,PSL011,PSL012,PSL013", "--no-jaxpr"],
+        capture_output=True, text=True, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    _check(proc.returncode == 0,
+           f"real tree not clean under PSL010-013:\n"
+           f"{proc.stdout}{proc.stderr}")
+    print("analysis-smoke: real tree clean under PSL010-013")
+    print("analysis-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,88 @@
+"""Sanctioned atomic-write helpers (ISSUE 17, PSL012).
+
+Every durable artifact the serve and obs planes publish — spool
+records, leases, admission state, status sidecars, run reports,
+warehouse indexes, trace exports — must land with rename atomicity: a
+killed writer leaves either the old file or the new one on disk,
+never a torn half-write (OBSERVABILITY.md "Shared design rules").
+Before this module each call site hand-rolled the same four lines
+(tmp name, write, optional fsync, ``os.replace``), and lint rule
+PSL012 could only pattern-match the idiom, not enforce it.  Now the
+idiom lives here, **outside** ``serve/`` and ``obs/``, and PSL012
+simply forbids any truncating ``open(path, "w")`` in those packages:
+the only sanctioned spelling is a call into this module — the same
+single-sanctioned-site scheme PSL008 uses for ``time.sleep``.
+
+``fsync`` is opt-in per call because durability and latency trade off
+per stream: the spool's job records fsync when ``PEASOUP_SPOOL_FSYNC``
+says so, while high-frequency lease heartbeats deliberately never do
+(rename atomicity alone is their contract; see serve/queue.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def _replace_via_tmp(path: str, payload: str, *, fsync: bool,
+                     encoding: str) -> None:
+    path = str(path)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding=encoding) as f:
+            f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str, *, fsync: bool = False,
+                      encoding: str = "utf-8") -> None:
+    """Write ``text`` to ``path`` with rename atomicity.
+
+    The payload lands in ``path + ".tmp<pid>"`` first (pid-suffixed so
+    concurrent writers from different processes never clobber each
+    other's tmp) and is renamed over ``path`` in one step.  With
+    ``fsync=True`` the tmp file is flushed to stable storage before
+    the rename — required where the artifact must survive power loss,
+    skipped where rename atomicity alone is the contract.  The tmp
+    file is best-effort removed on failure.
+    """
+    _replace_via_tmp(path, text, fsync=fsync, encoding=encoding)
+
+
+def atomic_write_json(path: str, obj, *, fsync: bool = False,
+                      indent: int | None = None, sort_keys: bool = False,
+                      trailing_newline: bool = False,
+                      default=None) -> None:
+    """:func:`atomic_write_text` for a JSON document."""
+    payload = json.dumps(obj, indent=indent, sort_keys=sort_keys,
+                         default=default)
+    if trailing_newline:
+        payload += "\n"
+    _replace_via_tmp(path, payload, fsync=fsync, encoding="utf-8")
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of the directory holding ``path`` so the
+    rename itself is durable, not just the file contents.  No-op on
+    platforms/filesystems that refuse directory fds."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

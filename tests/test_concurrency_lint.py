@@ -1,0 +1,528 @@
+"""Tier-1 tests for the concurrency & contracts prover (ISSUE 17):
+PSL010 lock discipline, PSL011 lock ordering, PSL012 atomic-write
+discipline and PSL013 stream contracts — plus the engine's parse
+cache and the full-tree wall-clock budget."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from peasoup_tpu.analysis import engine
+from peasoup_tpu.analysis.engine import (
+    Baseline,
+    SourceFile,
+    repo_root,
+    run_rules,
+)
+from peasoup_tpu.analysis.rules import ALL_RULES, rules_by_id
+
+REPO = repo_root()
+NEW_RULES = ("PSL010", "PSL011", "PSL012", "PSL013")
+
+
+def _lint_snippet(tmp_path, code, relpath, rule_ids):
+    """Write ``code`` at ``relpath`` under a fixture tree and run the
+    named rules exactly as the CLI would."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    violations, suppressed, errors = run_rules(
+        rules_by_id(list(rule_ids)), [str(path)], root=str(tmp_path))
+    assert not errors, errors
+    return violations, suppressed
+
+
+# --------------------------------------------------------------------------
+# PSL010 — lock discipline
+# --------------------------------------------------------------------------
+
+UNGUARDED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+
+        def _run(self):
+            while True:
+                self.count += 1
+
+        def snapshot(self):
+            return self.count
+"""
+
+
+def test_psl010_unguarded_shared_attr_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, UNGUARDED,
+                          "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert [v.rule for v in vs] == ["PSL010"]
+    assert "self.count" in vs[0].message
+    assert "common lock" in vs[0].message
+
+
+def test_psl010_guarded_attr_clean(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._run,
+                                           daemon=True)
+
+            def _run(self):
+                while True:
+                    with self._lock:
+                        self.count += 1
+
+            def snapshot(self):
+                with self._lock:
+                    return self.count
+    """, "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert vs == []
+
+
+def test_psl010_queue_handoff_and_event_exempt(tmp_path):
+    """Locks, Events, queues and deques are synchronisation primitives
+    — internally thread-safe, never flagged as shared state."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import collections
+        import queue
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._q = queue.Queue()
+                self._buf = collections.deque(maxlen=8)
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._buf.append(self._q.get())
+
+            def push(self, item):
+                self._q.put(item)
+
+            def close(self):
+                self._stop.set()
+    """, "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert vs == []
+
+
+def test_psl010_init_is_happens_before_start(tmp_path):
+    """Writes in __init__ precede Thread.start() — a thread-side-only
+    attribute initialised in the constructor is not a conflict."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self._n += 1
+    """, "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert vs == []
+
+
+def test_psl010_event_wait_loop_is_a_thread_entry(tmp_path):
+    """The sampler idiom: a daemon loop discovered via its
+    ``while ... self._evt.wait()`` shape, not a Thread(target=)."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import threading
+
+        class Sampler:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._loop)
+                self.seq = 0
+
+            def _loop(self):
+                while not self._stop.wait(1.0):
+                    self.seq += 1
+
+            def latest(self):
+                return self.seq
+    """, "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert [v.rule for v in vs] == ["PSL010"]
+    assert "self.seq" in vs[0].message
+
+
+def test_psl010_pragma_suppresses(tmp_path):
+    code = UNGUARDED.replace(
+        "self.count += 1",
+        "self.count += 1  # psl: disable=PSL010 -- torn reads benign")
+    vs, suppressed = _lint_snippet(
+        tmp_path, code, "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert vs == []
+    assert suppressed == 1
+
+
+# --------------------------------------------------------------------------
+# PSL011 — lock ordering
+# --------------------------------------------------------------------------
+
+AB_BA = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def forward():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def backward():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+"""
+
+
+def test_psl011_ab_ba_cycle_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, AB_BA,
+                          "peasoup_tpu/serve/fixture.py", ["PSL011"])
+    assert [v.rule for v in vs] == ["PSL011"]
+    assert "lock-order cycle" in vs[0].message
+    assert "LOCK_A" in vs[0].message and "LOCK_B" in vs[0].message
+
+
+def test_psl011_consistent_order_clean(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+        def also_forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+    """, "peasoup_tpu/serve/fixture.py", ["PSL011"])
+    assert vs == []
+
+
+def test_psl011_cycle_through_a_call_flagged(tmp_path):
+    """The graph is interprocedural: holding A while calling a
+    function that takes B closes the cycle against a B->A nesting."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def takes_b():
+            with LOCK_B:
+                pass
+
+        def holds_a():
+            with LOCK_A:
+                takes_b()
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:
+                    pass
+    """, "peasoup_tpu/serve/fixture.py", ["PSL011"])
+    assert [v.rule for v in vs] == ["PSL011"]
+    assert "lock-order cycle" in vs[0].message
+
+
+def test_psl011_pragma_suppresses(tmp_path):
+    """A pragma on the witness acquisition (the inner `with` that
+    closes the cycle) silences the finding."""
+    vs, suppressed = _lint_snippet(tmp_path, """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def forward():
+            with LOCK_A:
+                with LOCK_B:
+                    pass
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:  # psl: disable=PSL011 -- startup only
+                    pass
+    """, "peasoup_tpu/serve/fixture.py", ["PSL011"])
+    assert vs == []
+    assert suppressed == 1
+
+
+# --------------------------------------------------------------------------
+# PSL012 — atomic-write discipline
+# --------------------------------------------------------------------------
+
+def test_psl012_raw_truncating_open_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import json
+
+        def save(path, doc):
+            with open(path, "w") as f:
+                json.dump(doc, f)
+    """, "peasoup_tpu/serve/fixture.py", ["PSL012"])
+    assert [v.rule for v in vs] == ["PSL012"]
+    assert "atomic" in vs[0].message
+
+
+def test_psl012_mode_kwarg_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        def save(path):
+            f = open(path, mode="w")
+            f.close()
+    """, "peasoup_tpu/obs/fixture.py", ["PSL012"])
+    assert [v.rule for v in vs] == ["PSL012"]
+
+
+def test_psl012_append_binary_and_reads_exempt(tmp_path):
+    """Appends are crash-extending not crash-truncating; "wb"/"x" and
+    reads are out of scope."""
+    vs, _ = _lint_snippet(tmp_path, """
+        def ok(path):
+            with open(path, "a") as f:
+                f.write("line\\n")
+            with open(path, "wb") as f:
+                f.write(b"blob")
+            with open(path, "x") as f:
+                f.write("new")
+            with open(path) as f:
+                return f.read()
+    """, "peasoup_tpu/serve/fixture.py", ["PSL012"])
+    assert vs == []
+
+
+def test_psl012_scoped_to_serve_and_obs(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        def save(path, text):
+            with open(path, "w") as f:
+                f.write(text)
+    """, "peasoup_tpu/ops/fixture.py", ["PSL012"])
+    assert vs == []
+
+
+def test_psl012_pragma_suppresses(tmp_path):
+    vs, suppressed = _lint_snippet(tmp_path, """
+        def save(path, text):
+            # psl: disable-file=PSL012 -- fixture writer, not an artifact
+            with open(path, "w") as f:
+                f.write(text)
+    """, "peasoup_tpu/serve/fixture.py", ["PSL012"])
+    assert vs == []
+    assert suppressed == 1
+
+
+# --------------------------------------------------------------------------
+# PSL013 — stream contracts
+# --------------------------------------------------------------------------
+
+def test_psl013_undeclared_writer_key_flagged(tmp_path):
+    """A writer dict literal sneaking in a key the catalog does not
+    declare fails the build (fixture impersonates obs/events.py, a
+    declared writer site)."""
+    vs, _ = _lint_snippet(tmp_path, """
+        SCHEMA_VERSION = 1
+
+        class EventLog:
+            def emit(self, kind, message):
+                rec = {"v": SCHEMA_VERSION, "ts": 0.0,
+                       "kind": kind, "message": message,
+                       "smuggled": True}
+                return rec
+    """, "peasoup_tpu/obs/events.py", ["PSL013"])
+    assert [v.rule for v in vs] == ["PSL013"]
+    assert "smuggled" in vs[0].message
+
+
+def test_psl013_declared_writer_keys_clean(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        SCHEMA_VERSION = 1
+
+        class EventLog:
+            def emit(self, kind, message):
+                rec = {"v": SCHEMA_VERSION, "ts": 0.0,
+                       "kind": kind, "message": message,
+                       "data": {}}
+                return rec
+    """, "peasoup_tpu/obs/events.py", ["PSL013"])
+    assert vs == []
+
+
+def test_psl013_impossible_reader_key_flagged(tmp_path):
+    """A reader asking for a key no writer can produce is dead code or
+    a typo — the exact shape of the ingest_timeline bug this PR
+    fixed."""
+    vs, _ = _lint_snippet(tmp_path, """
+        TIMELINE_VERSION = 1
+
+        def read_timeline(path):
+            out = []
+            for rec in []:
+                out.append(rec.get("job"))
+                out.append(rec["phase"])
+            return out
+    """, "peasoup_tpu/obs/timeline.py", ["PSL013"])
+    assert [v.rule for v in vs] == ["PSL013"]
+    assert "job" in vs[0].message
+
+
+def test_psl013_version_drift_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        SCHEMA_VERSION = 99
+    """, "peasoup_tpu/obs/events.py", ["PSL013"])
+    assert [v.rule for v in vs] == ["PSL013"]
+    assert "99" in vs[0].message
+
+
+def test_psl013_catalog_sourced_version_exempt(tmp_path):
+    """A constant sourced from the catalog cannot drift — the
+    WAREHOUSE_VERSION pattern is exempt by construction."""
+    vs, _ = _lint_snippet(tmp_path, """
+        from .streams import stream_version
+
+        SCHEMA_VERSION = stream_version("events")
+    """, "peasoup_tpu/obs/events.py", ["PSL013"])
+    assert vs == []
+
+
+def test_psl013_catalog_matches_live_writers():
+    """Every declared version constant must match what the live module
+    actually exports — the catalog describes reality."""
+    from peasoup_tpu.obs.streams import STREAMS, stream_keys
+
+    from peasoup_tpu.obs.events import SCHEMA_VERSION
+    from peasoup_tpu.obs.history import HISTORY_VERSION
+    from peasoup_tpu.obs.report import REPORT_VERSION
+    from peasoup_tpu.obs.telemetry import TS_SCHEMA_VERSION
+    from peasoup_tpu.obs.timeline import TIMELINE_VERSION
+
+    assert STREAMS["events"]["version"] == SCHEMA_VERSION
+    assert STREAMS["telemetry"]["version"] == TS_SCHEMA_VERSION
+    assert STREAMS["timeline"]["version"] == TIMELINE_VERSION
+    assert STREAMS["history"]["version"] == HISTORY_VERSION
+    assert STREAMS["run_report"]["version"] == REPORT_VERSION
+    # every version_key is itself a declared key
+    for name, ent in STREAMS.items():
+        assert ent["version_key"] in stream_keys(name), name
+
+
+# --------------------------------------------------------------------------
+# baseline round-trip + repo-clean gates
+# --------------------------------------------------------------------------
+
+def test_baseline_roundtrip_for_new_rules(tmp_path):
+    """Grandfather a PSL010 finding, confirm split() covers it, then
+    fix the code and confirm the entry expires."""
+    vs, _ = _lint_snippet(tmp_path, UNGUARDED,
+                          "peasoup_tpu/serve/fixture.py", ["PSL010"])
+    assert len(vs) == 1
+    bl_path = tmp_path / "baseline.json"
+    Baseline.from_violations(vs).save(str(bl_path))
+    bl = Baseline.load(str(bl_path))
+    new, old, expired = bl.split(vs)
+    assert (new, len(old), expired) == ([], 1, [])
+    # fixed code -> no violations -> entry expires
+    new, old, expired = bl.split([])
+    assert new == [] and old == [] and len(expired) == 1
+
+
+def test_repo_clean_under_new_rules():
+    """PSL010-013 hold on the real tree with ZERO grandfathered
+    entries: every real finding was fixed or pragma'd with a reason."""
+    violations, _suppressed, errors = run_rules(
+        rules_by_id(list(NEW_RULES)))
+    assert not errors, errors
+    assert violations == [], "\n".join(v.format() for v in violations)
+    bl = Baseline.load(os.path.join(REPO, "lint_baseline.json"))
+    assert not [e for e in bl.entries if e["rule"] in NEW_RULES], (
+        "new rules must not lean on the baseline")
+
+
+def test_rules_by_id_subsetting():
+    rules = rules_by_id(["PSL010", "PSL011"])
+    assert [r.id for r in rules] == ["PSL010", "PSL011"]
+    assert all(r.id in {r2.id for r2 in ALL_RULES} for r in rules)
+
+
+def test_cli_rules_subset_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "peasoup_tpu.analysis",
+         "--rules", "PSL010,PSL011,PSL012,PSL013", "--no-jaxpr"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --------------------------------------------------------------------------
+# engine: parse cache + wall-clock budget
+# --------------------------------------------------------------------------
+
+def test_engine_parse_cache_parses_each_file_once(tmp_path, monkeypatch):
+    """Two consecutive run_rules() over an unchanged tree must parse
+    zero files the second time (stat-validated cache)."""
+    path = tmp_path / "peasoup_tpu" / "serve" / "fixture.py"
+    path.parent.mkdir(parents=True)
+    path.write_text("X = 1\n")
+    calls = []
+    real_load = SourceFile.load.__func__
+
+    def counting_load(cls, p, rel):
+        calls.append(p)
+        return real_load(cls, p, rel)
+
+    monkeypatch.setattr(SourceFile, "load",
+                        classmethod(counting_load))
+    run_rules(rules_by_id(["PSL010"]), [str(path)], root=str(tmp_path))
+    assert len(calls) == 1
+    run_rules(rules_by_id(["PSL010"]), [str(path)], root=str(tmp_path))
+    assert len(calls) == 1, "unchanged file was re-parsed"
+    # an edit (size change) invalidates the entry
+    path.write_text("X = 1\nY = 2\n")
+    run_rules(rules_by_id(["PSL010"]), [str(path)], root=str(tmp_path))
+    assert len(calls) == 2, "changed file was served stale"
+
+
+def test_engine_cache_is_shared_across_rule_sets(tmp_path, monkeypatch):
+    """The cache keys on the file, not the rule set — a --rules subset
+    run after a full run re-parses nothing."""
+    path = tmp_path / "peasoup_tpu" / "obs" / "fixture.py"
+    path.parent.mkdir(parents=True)
+    path.write_text("Y = 2\n")
+    calls = []
+    real_load = SourceFile.load.__func__
+    monkeypatch.setattr(
+        SourceFile, "load",
+        classmethod(lambda cls, p, rel:
+                    (calls.append(p), real_load(cls, p, rel))[1]))
+    run_rules(ALL_RULES, [str(path)], root=str(tmp_path))
+    run_rules(rules_by_id(["PSL012", "PSL013"]), [str(path)],
+              root=str(tmp_path))
+    assert len(calls) == 1
+
+
+def test_full_tree_lint_wall_clock_budget():
+    """All 13 rules over the whole package must stay interactive.
+    Budget is deliberately generous (~8x the dev-box cold run) so it
+    only trips on an algorithmic regression — the whole-program rules
+    must stay near-linear in repo size, not quadratic."""
+    t0 = time.perf_counter()
+    violations, _, errors = run_rules(ALL_RULES)
+    elapsed = time.perf_counter() - t0
+    assert not errors, errors
+    assert elapsed < 20.0, (
+        f"full-tree lint took {elapsed:.1f}s (budget 20s) — "
+        "did a whole-program pass go superlinear?")
