@@ -15,8 +15,9 @@ SENSITIVITY_SMOKE_DIR ?= /tmp/peasoup-sensitivity-smoke
 CHAOS_SMOKE_DIR ?= /tmp/peasoup-chaos-smoke
 OBS_SMOKE_DIR ?= /tmp/peasoup-obs-smoke
 ANALYSIS_SMOKE_DIR ?= /tmp/peasoup-analysis-smoke
+COLDSTART_SMOKE_DIR ?= /tmp/peasoup-coldstart-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke analysis-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke analysis-smoke coldstart-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -179,6 +180,16 @@ obs-smoke:
 	    --dir $(OBS_SMOKE_DIR)/warehouse -n 5 --metric span.device_s
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.cli obs query \
 	    --dir $(OBS_SMOKE_DIR)/warehouse --stage peaks --limit 10
+
+# cold-start observatory smoke test (ISSUE 18): a cold worker drain
+# must measure cold_to_first_candidate_s and decompose it into
+# read/trace/compile/execute phases that partition the total, the
+# spool compile ledger must attribute every backend compile to a
+# program + geometry fingerprint, and a warm drain of the same
+# geometry in the same process must ledger ZERO new compiles
+coldstart-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.coldstart_smoke \
+	    --dir $(COLDSTART_SMOKE_DIR)
 
 # concurrency & contracts prover smoke test (ISSUE 17): writes a
 # deliberately broken fixture tree and asserts each of PSL010-PSL013

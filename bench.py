@@ -66,6 +66,19 @@ p50/p95/p99 sojourn, and appending the ``kind="loadgen"`` ledger
 record the ``loadgen_saturation`` health rule reads its baseline
 from.
 
+``--coldstart [N]`` (default 2) runs the cold-start observatory
+micro-bench instead (ISSUE 18): N synthetic same-geometry
+observations are drained twice through fresh survey workers — once
+COLD (first compiles of this process) and once WARM (programs
+replayed from the jit cache) — and the wall time from drain start to
+the first finished job is decomposed into read / trace / compile /
+execute phases (the worker's ``cold_to_first_candidate_s`` metric).
+The cold drain's spool-level ``compiles.jsonl`` must attribute its
+compiles to the search geometry and the warm drain must add ZERO new
+compile records before any number is reported; appends the
+``kind="coldstart"`` ledger record the perf gate trends
+``cold_to_first_candidate_s`` from.
+
 ``--chaos [budget_s]`` (default 360) runs the chaos-recovery
 micro-bench instead: the seeded fault plan of ``tools/chaos.py``
 (worker SIGKILL mid-job, one poison input, one over-quota tenant)
@@ -656,6 +669,126 @@ def run_chaos_bench(budget_s: float) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def coldstart_arg(argv: list[str]) -> int | None:
+    """``--coldstart [N]``: run the cold-start observatory bench over
+    N synthetic observations (default 2)."""
+    if "--coldstart" not in argv:
+        return None
+    i = argv.index("--coldstart")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(1, int(argv[i + 1]))
+    return 2
+
+
+def run_coldstart_bench(n: int) -> int:
+    """``bench.py --coldstart N``: cold vs warm worker drains over the
+    same synthetic observations (ISSUE 18).
+
+    The cold drain pays this process's first XLA compiles; the warm
+    drain replays them from the in-process jit cache.  Each drain's
+    ``cold_to_first_candidate_s`` is decomposed by the worker into
+    read / trace / compile / execute phases; the cold spool's compile
+    ledger must attribute its compiles to the search geometry and the
+    warm spool's ledger must stay EMPTY (a warm worker that recompiles
+    has broken program reuse — that is the regression this bench
+    exists to catch) before any number is reported."""
+    import shutil
+    import tempfile
+
+    from peasoup_tpu.obs.compilation import read_compiles
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.serve import JobSpool, SurveyWorker
+    from peasoup_tpu.tools.batch_smoke import _write_synthetic
+
+    work = tempfile.mkdtemp(prefix="peasoup-coldstart-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    try:
+        overrides = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0,
+                     "limit": 10}
+        obs = [
+            _write_synthetic(os.path.join(work, f"obs{i}.fil"), seed=i)
+            for i in range(n)
+        ]
+        modes = {}
+        for label in ("cold", "warm"):
+            REGISTRY.reset()
+            spool = JobSpool(os.path.join(work, f"jobs_{label}"))
+            for path in obs:
+                spool.submit(path, overrides)
+            summary = SurveyWorker(
+                spool, history_path=history, sleeper=lambda s: None,
+            ).drain()
+            if summary["succeeded"] != n:
+                print(json.dumps({
+                    "metric": "cold_to_first_candidate_s",
+                    "value": None,
+                    "error": f"{label} drain succeeded "
+                             f"{summary['succeeded']}/{n}",
+                }))
+                return 1
+            compiles = read_compiles(
+                os.path.join(spool.root, "compiles.jsonl"),
+                kinds=("compile",))
+            modes[label] = {
+                **summary.get("coldstart", {}),
+                "jobs_per_hour": summary["jobs_per_hour"],
+                "compiles": len(compiles),
+                "attributed": sum(1 for r in compiles
+                                  if r.get("program")),
+            }
+        cold, warm = modes["cold"], modes["warm"]
+        problems = []
+        if cold["compiles"] == 0:
+            problems.append("cold drain ledgered zero compiles")
+        elif cold["attributed"] != cold["compiles"]:
+            problems.append(
+                f"{cold['compiles'] - cold['attributed']} cold "
+                f"compile(s) unattributed")
+        if warm["compiles"] != 0:
+            problems.append(
+                f"warm drain ledgered {warm['compiles']} new "
+                f"compile(s) — program reuse broken")
+        out = {
+            "metric": "cold_to_first_candidate_s",
+            "value": cold.get("cold_to_first_candidate_s"),
+            "unit": "s",
+            "warm_to_first_candidate_s": warm.get(
+                "cold_to_first_candidate_s"),
+            "coldstart_overhead_s": round(
+                cold.get("cold_to_first_candidate_s", 0.0)
+                - warm.get("cold_to_first_candidate_s", 0.0), 4),
+            "n_jobs": n,
+            "modes": modes,
+            "parity": ("; ".join(problems) if problems
+                       else "cold compiles attributed, warm drain "
+                            "compile-free"),
+        }
+        print(json.dumps(out))
+        from peasoup_tpu.obs.history import (
+            append_history, make_history_record,
+        )
+
+        append_history(make_history_record(
+            "coldstart",
+            metrics={
+                "cold_to_first_candidate_s": cold.get(
+                    "cold_to_first_candidate_s", 0.0),
+                "coldstart_read_s": cold.get("read_s", 0.0),
+                "coldstart_trace_s": cold.get("trace_s", 0.0),
+                "coldstart_compile_s": cold.get("compile_s", 0.0),
+                "coldstart_execute_s": cold.get("execute_s", 0.0),
+                "warm_to_first_candidate_s": warm.get(
+                    "cold_to_first_candidate_s", 0.0),
+                "coldstart_compiles": cold["compiles"],
+            },
+            parity=out["parity"],
+        ), path=history)
+        return 0 if not problems else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -687,6 +820,9 @@ def main() -> None:
     ch = chaos_arg(sys.argv[1:])
     if ch is not None:
         sys.exit(run_chaos_bench(ch))
+    cs = coldstart_arg(sys.argv[1:])
+    if cs is not None:
+        sys.exit(run_coldstart_bench(cs))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

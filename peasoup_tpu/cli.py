@@ -238,6 +238,16 @@ def main(argv=None) -> int:
     os.makedirs(cfg.outdir, exist_ok=True)
     configure_event_log(
         cfg.events_log or os.path.join(cfg.outdir, "events.jsonl"))
+    # geometry-keyed compile ledger (ISSUE 18): every backend compile
+    # this run pays lands in <outdir>/compiles.jsonl attributed to the
+    # search geometry (`peasoup-tpu obs compiles` reads it back)
+    from .obs.compilation import (
+        configure_compile_ledger,
+        install_compile_ledger,
+    )
+
+    configure_compile_ledger(os.path.join(cfg.outdir, "compiles.jsonl"))
+    install_compile_ledger()
     # per-run span tree: the trace file must describe THIS run, not
     # every run of a long-lived process
     get_tracer().reset()

@@ -219,6 +219,54 @@ def baseline_table(records, *, window: int = 8,
 
 
 # --------------------------------------------------------------------------
+# compile ledger: per-(program, geometry, device kind) duration bands
+# --------------------------------------------------------------------------
+
+#: absolute floor in seconds for a compile-duration band — sub-10 ms
+#: compile jitter on a shared host is noise, not a regression
+COMPILE_FLOOR_S = 0.01
+
+
+def compile_anomalies(records, *, window: int = 8,
+                      z: float = DEFAULT_Z,
+                      floor_frac: float = DEFAULT_FLOOR_FRAC,
+                      floor_abs: float = COMPILE_FLOOR_S,
+                      min_n: int = 3) -> list[dict]:
+    """Judge the NEWEST compile of each (program, geometry, device
+    kind) key against the key's trailing compile durations — a
+    program whose compile suddenly takes far longer than its own
+    baseline (an XLA upgrade, a shape canonicalization regression)
+    yields one anomaly attributed to that key.  ``records`` are
+    compile-ledger records (:func:`.compilation.read_compiles`);
+    pure and deterministic like :func:`history_anomalies`."""
+    groups: dict[tuple, list[dict]] = {}
+    for rec in records:
+        if rec.get("kind") != "compile":
+            continue
+        key = (str(rec.get("program") or ""),
+               str(rec.get("geometry") or ""),
+               str(rec.get("device_kind") or ""))
+        groups.setdefault(key, []).append(rec)
+    anomalies: list[dict] = []
+    for (program, geom, device_kind), recs in groups.items():
+        if len(recs) < int(min_n) + 1:
+            continue
+        head = recs[-1]
+        trail = recs[-1 - int(window):-1]
+        series = [float(r.get("duration_s") or 0.0) for r in trail]
+        anom = detect_point(
+            float(head.get("duration_s") or 0.0), series,
+            ts=head.get("ts"),
+            key={"stage": program, "geometry": geom,
+                 "device_kind": device_kind},
+            metric="compile_duration_s", z=z, floor_frac=floor_frac,
+            floor_abs=floor_abs, min_n=min_n)
+        if anom is not None:
+            anomalies.append(anom)
+    return anomalies
+
+
+# --------------------------------------------------------------------------
 # telemetry shards: fleet-presence anomalies (the chaos window check)
 # --------------------------------------------------------------------------
 

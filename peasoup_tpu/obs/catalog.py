@@ -29,6 +29,12 @@ CATALOG: dict[str, str] = {
     "chunk.dm_chunk": "(gauge) planned DM-chunk height",
     "chunk.peak_capacity": "(gauge) planned per-trial peak capacity",
     "chunk.pipeline_depth": "(gauge) planned upload pipeline depth",
+    # -- cold start / compile cache -----------------------------------------
+    "coldstart.cold_to_first_candidate_s": "(gauge) wall seconds "
+                                           "from drain start to the "
+                                           "first completed job",
+    "compile_cache.enabled": "persistent XLA compile-cache "
+                             "engagements this process",
     # -- device -------------------------------------------------------------
     "device_duty_cycle": "(gauge) device seconds per wall second "
                          "over the last drain window",
@@ -43,6 +49,13 @@ CATALOG: dict[str, str] = {
     "hbm.est_full_bytes": "(gauge) planner's full-problem estimate",
     "hbm.high_water_bytes": "(gauge) max bytes_in_use seen at any "
                             "span close",
+    "hbm.probed_fold_samp_bytes": "(gauge) measured fold bytes per "
+                                  "sample (memory_analysis probe)",
+    "hbm.probed_row_bytes": "(gauge) measured trial bytes per DM row "
+                            "(memory_analysis probe)",
+    "hbm.probed_spectrum_bytes": "(gauge) measured bytes per live "
+                                 "accel spectrum element "
+                                 "(memory_analysis probe)",
     # -- injection / parity (gauges) ---------------------------------------
     "injection.recovered": "(gauge) 1.0 when the parity injection "
                            "was recovered",
@@ -51,6 +64,12 @@ CATALOG: dict[str, str] = {
     "injection.snr_whiten": "(gauge) parity injection whitened SNR",
     # -- jit ----------------------------------------------------------------
     "jit.backend_compiles": "XLA backend_compile events this process",
+    "jit.compiles_attributed": "backend compiles attributed to a "
+                               "(program, geometry) key in the "
+                               "compile ledger",
+    "jit.recompiles_seen_geometry": "backend compiles on an "
+                                    "already-seen (program, "
+                                    "geometry, device) key",
     # -- peaks / runs -------------------------------------------------------
     "peaks.compact_pallas": "pallas threshold-compaction dispatches",
     "runs.fused_fold_dispatches": "batched fold program dispatches",
@@ -59,6 +78,8 @@ CATALOG: dict[str, str] = {
     "runs.mesh_fused": "searches run on the fused mesh path",
     "runs.mesh_fused_batched": "searches run on the batched fused "
                                "path",
+    # -- profiler -----------------------------------------------------------
+    "profile.captures": "sampled jax.profiler captures written",
     # -- scheduler ----------------------------------------------------------
     "scheduler.admission_deferred": "submits deferred by a token "
                                     "bucket",

@@ -11,10 +11,18 @@ the flight recorder:
 * ``obs diff``    — structural diff of two run reports (or the last
   two bench rounds of a ledger), rendered as markdown;
 * ``obs baseline`` — robust per-key baselines over a ledger plus any
-  anomalies the newest round trips.
+  anomalies the newest round trips;
+* ``obs compiles`` — the geometry-keyed compile ledger summarized per
+  (program, geometry fingerprint, device kind), with cache
+  engagements and per-key duration anomalies (ISSUE 18);
+* ``obs memory``  — measured program footprints
+  (``memory_analysis``) joined against the cost model's modelled
+  bytes plus the live device watermark; ``--probe`` compiles the five
+  registered programs now.
 
-Exit codes: 0 ok; 1 when ``baseline`` finds anomalies (gate-shaped);
-2 on unusable inputs.
+Exit codes: 0 ok; 1 when ``baseline``/``compiles``/``memory`` find
+anomalies or an out-of-band closure (gate-shaped); 2 on unusable
+inputs.
 """
 
 from __future__ import annotations
@@ -77,6 +85,9 @@ def cmd_ingest(args) -> int:
         total += wh.ingest_telemetry(args.ts_dir)
     if args.timeline:
         total += wh.ingest_timeline(args.timeline,
+                                    run=args.run or "")
+    if args.compiles:
+        total += wh.ingest_compiles(args.compiles,
                                     run=args.run or "")
     print(f"ingested {total} row(s) into {args.dir}")
     return 0
@@ -193,6 +204,82 @@ def cmd_baseline(args) -> int:
     return 1 if anomalies else 0
 
 
+def cmd_compiles(args) -> int:
+    from .baseline import compile_anomalies
+    from .compilation import read_compiles, summarize_compiles
+
+    records = read_compiles(args.ledger)
+    if not records:
+        print(f"no compile-ledger records in {args.ledger!r}")
+        return 0
+    rows = summarize_compiles(records)
+    anomalies = compile_anomalies(records, window=args.window)
+    if args.json:
+        json.dump({"compiles": rows, "anomalies": anomalies},
+                  sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        print(f"{'program':<22} {'geometry':<13} {'device':<12} "
+              f"{'n':>4} {'recomp':>6} {'total_s':>9} {'max_s':>8}")
+        for row in rows:
+            print(f"{row['program'] or '-':<22} "
+                  f"{row['geometry'] or '-':<13} "
+                  f"{row['device_kind'] or '-':<12} "
+                  f"{row['compiles']:>4} {row['recompiles']:>6} "
+                  f"{row['total_s']:>9.3f} {row['max_s']:>8.3f}")
+        for rec in records:
+            if rec.get("kind") == "cache":
+                state = "engaged" if rec.get("enabled") else "disabled"
+                print(f"cache {state}: {rec.get('dir') or '-'}")
+        for anom in anomalies:
+            key = anom["key"]
+            print(f"ANOMALY {key['stage']} "
+                  f"[{key['device_kind'] or '-'}/"
+                  f"{key['geometry'] or '-'}]: compile "
+                  f"{anom['value']:.3f}s vs median "
+                  f"{anom['median']:.3f}s +/- {anom['band']:.3f}s "
+                  f"({anom['severity']})")
+    return 1 if anomalies else 0
+
+
+def cmd_memory(args) -> int:
+    from .memprof import memory_report
+
+    rep = memory_report(probe=args.probe)
+    progs = rep.get("programs") or []
+    if args.json:
+        json.dump(rep, sys.stdout, indent=1, sort_keys=True)
+        print()
+    else:
+        if progs:
+            print(f"{'program':<12} {'model_B':>12} {'measured_B':>12} "
+                  f"{'ratio':>8}  ok")
+            for row in progs:
+                meas = row.get("measured_bytes")
+                ratio = row.get("ratio")
+                print(f"{row['program']:<12} "
+                      f"{row.get('model_bytes') or 0:>12} "
+                      + (f"{meas:>12}" if meas is not None
+                         else f"{'-':>12}")
+                      + (f" {ratio:>8.3f}" if ratio is not None
+                         else f" {'-':>8}")
+                      + ("  ok" if row.get("ok") else "  OUT-OF-BAND"))
+        else:
+            print("no measured footprints this process "
+                  "(re-run with --probe)")
+        wm = rep.get("watermark")
+        if wm:
+            print(f"watermark: {wm['bytes_in_use']} bytes in use, "
+                  f"{wm['peak_bytes_in_use']} peak")
+        else:
+            print("watermark: backend reports no memory stats")
+        for kind, slope in sorted(
+                (rep.get("probed_coefficients") or {}).items()):
+            print(f"probed {kind}: {slope:.1f} B/unit")
+    bad = [row for row in progs if not row.get("ok")]
+    return 1 if bad else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="peasoup obs",
@@ -224,6 +311,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fleet/ telemetry shard dir to ingest")
     sp.add_argument("--timeline", default=None,
                     help="timeline.jsonl (or its workdir) to ingest")
+    sp.add_argument("--compiles", default=None,
+                    help="compiles.jsonl compile ledger to ingest")
     sp.add_argument("--run", default=None,
                     help="run id to stamp on ingested report rows")
     sp.set_defaults(fn=cmd_ingest)
@@ -267,6 +356,22 @@ def build_parser() -> argparse.ArgumentParser:
                          "kind:\"anomaly\" records")
     sp.add_argument("--json", action="store_true")
     sp.set_defaults(fn=cmd_baseline)
+
+    sp = sub.add_parser("compiles", help="geometry-keyed compile "
+                                         "ledger summary")
+    sp.add_argument("--ledger", default="compiles.jsonl",
+                    help="compiles.jsonl path")
+    sp.add_argument("--window", type=int, default=8)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_compiles)
+
+    sp = sub.add_parser("memory", help="measured HBM footprints vs "
+                                       "the cost model")
+    sp.add_argument("--probe", action="store_true",
+                    help="compile the five registered programs and "
+                         "probe memory_analysis now")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_memory)
     return p
 
 

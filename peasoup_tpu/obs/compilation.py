@@ -1,0 +1,377 @@
+"""Geometry-keyed XLA compile ledger (ISSUE 18).
+
+ROADMAP item 3 names compile time — not search time — as the
+production tail latency, but :func:`~.metrics.install_compile_hook`
+collapses every backend compile into one global ``jit_compile``
+timer.  This module adds the attribution side: a second
+``jax.monitoring`` duration listener that stamps every backend
+compile with the **program** it served (the explicitly-declared
+compile context when a driver set one, else the innermost open trace
+span), the **geometry fingerprint** of that program's shape key, and
+the **device kind**, and persists the result as one JSON line in an
+append-only ``compiles.jsonl`` stream (schema in
+:mod:`.streams`; ingested by :func:`.warehouse.compile_rows`,
+baselined by :func:`.baseline.compile_anomalies`).
+
+With the ledger, three previously-invisible facts become queryable:
+
+* cold vs warm dispatch — the first compile of a (program, geometry,
+  device) key writes ``seen_before: false``, every later compile of
+  the *same* key writes ``seen_before: true`` and increments the
+  ``jit.recompiles_seen_geometry`` counter (the ``compile_storm``
+  health rule's input);
+* which geometry paid which compile — an escalated re-search or a
+  ``scale_up`` worker cold start names its geometry fingerprint;
+* whether the persistent compile cache engaged — ``kind:"cache"``
+  records from :func:`record_cache_event` land in the same stream,
+  as do ``kind:"profile"`` records naming sampled
+  ``jax.profiler`` artifacts.
+
+Like the event log, persistence must never kill a search: the file
+handle opens lazily in append mode, an I/O failure disables the sink
+for the rest of the run with a single plain warning, and the on-disk
+size is bounded by ``.1`` rotation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import warnings
+from contextlib import contextmanager
+
+from .metrics import _BACKEND_COMPILE_EVENT, MetricsRegistry, REGISTRY
+from .events import _json_safe
+from .streams import stream_version
+
+#: sourced from the stream catalog — cannot drift from the contract
+COMPILES_VERSION = stream_version("compiles")
+
+#: rotate the on-disk ledger past this size (one ``.1`` generation,
+#: like events.jsonl and the telemetry shards)
+DEFAULT_MAX_LEDGER_BYTES = 1024 * 1024
+
+
+class CompileLedger:
+    """Append-only JSONL sink for compile/cache/profile records.
+
+    ``path`` may be empty: records are then counted into the metrics
+    registry but not persisted — the no-I/O default for library use.
+    One lock guards the lazily-opened line-buffered handle and the
+    I/O-failure latch (a telemetry write must never raise into the
+    dispatching thread that triggered the compile).
+    """
+
+    def __init__(self, path: str = "", *,
+                 max_ledger_bytes: int = DEFAULT_MAX_LEDGER_BYTES,
+                 clock=time.time):
+        self.path = path or ""
+        self.max_ledger_bytes = int(max_ledger_bytes)
+        self._lock = threading.Lock()
+        self._file = None
+        self._io_failed = False
+        self._clock = clock
+        try:
+            self._host = socket.gethostname()
+        except OSError:
+            self._host = ""
+
+    def _maybe_rotate(self) -> None:
+        """Rotate the live ledger to ``<path>.1`` past the byte budget.
+        Caller holds the lock; errors are swallowed."""
+        if self.max_ledger_bytes <= 0:
+            return
+        try:
+            if os.path.getsize(self.path) < self.max_ledger_bytes:
+                return
+        except OSError:
+            return  # no file yet
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one typed ledger line; returns the record written."""
+        rec = {
+            "v": COMPILES_VERSION,
+            "ts": round(self._clock(), 6),
+            "host": self._host,
+            "pid": os.getpid(),
+            "kind": str(kind),
+        }
+        for key, value in fields.items():
+            rec[key] = _json_safe(value)
+        with self._lock:
+            if self.path and not self._io_failed:
+                try:
+                    self._maybe_rotate()
+                    if self._file is None:
+                        d = os.path.dirname(self.path)
+                        if d:
+                            os.makedirs(d, exist_ok=True)
+                        self._file = open(self.path, "a", buffering=1)
+                    self._file.write(json.dumps(rec) + "\n")
+                except OSError as exc:
+                    self._io_failed = True
+                    warnings.warn(
+                        f"compile ledger {self.path!r} disabled: {exc}")
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                finally:
+                    self._file = None
+
+
+_global_lock = threading.Lock()
+_LEDGER = CompileLedger()
+
+
+def get_compile_ledger() -> CompileLedger:
+    return _LEDGER
+
+
+def configure_compile_ledger(
+        path: str, *,
+        max_ledger_bytes: int = DEFAULT_MAX_LEDGER_BYTES
+) -> CompileLedger:
+    """Point the process-wide compile ledger at ``path`` (e.g. the
+    CLI's ``<outdir>/compiles.jsonl`` or a worker's spool-level
+    ledger).  Replaces the previous sink; already-written records are
+    not rewritten."""
+    global _LEDGER
+    with _global_lock:
+        _LEDGER.close()
+        _LEDGER = CompileLedger(path, max_ledger_bytes=max_ledger_bytes)
+        return _LEDGER
+
+
+# -- compile attribution context --------------------------------------------
+
+# The monitoring listener fires on the thread that dispatched the
+# compile, but carries no payload beyond the duration — attribution
+# comes from (a) the compile context a driver declared around its
+# dispatches and (b) the innermost open trace span on that thread.
+# One lock guards the context and the process seen-set.
+_ctx_lock = threading.Lock()
+_ctx_program = ""
+_ctx_geometry: dict | None = None
+_seen_keys: set = set()
+
+
+def set_compile_context(program: str = "",
+                        geometry: dict | None = None) -> tuple:
+    """Declare which program/geometry subsequent compiles serve.
+
+    Returns the previous ``(program, geometry)`` pair so callers can
+    restore it; :func:`compile_context` is the scoped spelling.
+    ``geometry`` is a small plain dict of shape-determining fields
+    (what :func:`.warehouse.geometry_fingerprint` hashes)."""
+    global _ctx_program, _ctx_geometry
+    with _ctx_lock:
+        prev = (_ctx_program, _ctx_geometry)
+        _ctx_program = str(program or "")
+        _ctx_geometry = dict(geometry) if geometry else None
+        return prev
+
+
+@contextmanager
+def compile_context(program: str = "", geometry: dict | None = None):
+    """Scoped :func:`set_compile_context` (restores on exit)."""
+    prev = set_compile_context(program, geometry)
+    try:
+        yield
+    finally:
+        set_compile_context(prev[0], prev[1])
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return ""
+
+
+def _record_compile(duration_s: float, reg: MetricsRegistry) -> None:
+    """Attribute one backend compile and append its ledger line."""
+    span_name = ""
+    try:
+        from .trace import current_span_name
+
+        span_name = current_span_name() or ""
+    except Exception:
+        pass
+    with _ctx_lock:
+        program = _ctx_program
+        geometry = _ctx_geometry
+    fingerprint = ""
+    if geometry:
+        try:
+            from .warehouse import geometry_fingerprint
+
+            fingerprint = geometry_fingerprint(geometry)
+        except Exception:
+            fingerprint = ""
+    if not program:
+        program = span_name
+    kind = _device_kind()
+    seen = False
+    if program or fingerprint:
+        key = (program, fingerprint, kind)
+        with _ctx_lock:
+            seen = key in _seen_keys
+            _seen_keys.add(key)
+    if program:
+        reg.inc("jit.compiles_attributed")
+    if seen:
+        reg.inc("jit.recompiles_seen_geometry")
+    get_compile_ledger().record(
+        "compile",
+        program=program,
+        geometry=fingerprint,
+        device_kind=kind,
+        duration_s=round(float(duration_s), 6),
+        seen_before=seen,
+        span=span_name,
+    )
+
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def install_compile_ledger(
+        registry: MetricsRegistry | None = None) -> bool:
+    """Attribute every XLA backend compile into the ledger
+    (idempotent; composes with the counting-only
+    :func:`~.metrics.install_compile_hook`).  Returns True if the
+    listener is active."""
+    global _listener_installed
+    reg = registry if registry is not None else REGISTRY
+    with _listener_lock:
+        if _listener_installed:
+            return True
+        try:
+            from jax import monitoring
+
+            def _on_duration(event, duration, **kwargs):
+                if event == _BACKEND_COMPILE_EVENT:
+                    _record_compile(float(duration), reg)
+
+            monitoring.register_event_duration_secs_listener(
+                _on_duration)
+        except Exception:  # pragma: no cover - jax.monitoring absent
+            return False
+        _listener_installed = True
+        return True
+
+
+def reset_seen_geometries() -> None:
+    """Forget the process seen-set (tests; a fresh cold-start probe)."""
+    with _ctx_lock:
+        _seen_keys.clear()
+
+
+# -- cache / profile records -------------------------------------------------
+
+def record_cache_event(enabled: bool, cache_dir: str = "",
+                       registry: MetricsRegistry | None = None) -> dict:
+    """Ledger a persistent-compile-cache engagement (or refusal).
+
+    Called by ``utils.compilecache.enable_compile_cache`` so whether
+    the cache actually engaged — and where — is a queryable fact
+    instead of an invisible return value."""
+    reg = registry if registry is not None else REGISTRY
+    if enabled:
+        reg.inc("compile_cache.enabled")
+    return get_compile_ledger().record(
+        "cache", enabled=bool(enabled), dir=str(cache_dir or ""))
+
+
+def record_profile(path: str,
+                   registry: MetricsRegistry | None = None) -> dict:
+    """Ledger one sampled ``jax.profiler`` capture artifact."""
+    reg = registry if registry is not None else REGISTRY
+    reg.inc("profile.captures")
+    return get_compile_ledger().record("profile", path=str(path))
+
+
+# -- readers ------------------------------------------------------------------
+
+def read_compiles(path: str, kinds=None) -> list[dict]:
+    """Torn-line-tolerant reader for a ``compiles.jsonl`` ledger.
+
+    Skips unparseable lines and records from a future schema version;
+    ``kinds`` filters on the record kind."""
+    out: list[dict] = []
+    try:
+        fh = open(path)
+    except OSError:
+        return out
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if int(rec.get("v", 0) or 0) > COMPILES_VERSION:
+                continue
+            if kinds is not None and rec.get("kind") not in kinds:
+                continue
+            out.append(rec)
+    return out
+
+
+def summarize_compiles(records: list[dict]) -> list[dict]:
+    """Aggregate compile records per (program, geometry, device kind).
+
+    Returns one row per key — compile count, recompile count (lines
+    with ``seen_before``), total/max seconds — sorted by total compile
+    seconds descending, so ``obs compiles`` surfaces the most
+    expensive program first."""
+    agg: dict[tuple, dict] = {}
+    for rec in records:
+        if rec.get("kind") != "compile":
+            continue
+        key = (str(rec.get("program") or ""),
+               str(rec.get("geometry") or ""),
+               str(rec.get("device_kind") or ""))
+        row = agg.setdefault(key, {
+            "program": key[0], "geometry": key[1],
+            "device_kind": key[2], "compiles": 0, "recompiles": 0,
+            "total_s": 0.0, "max_s": 0.0,
+        })
+        row["compiles"] += 1
+        if rec.get("seen_before"):
+            row["recompiles"] += 1
+        dur = float(rec.get("duration_s") or 0.0)
+        row["total_s"] += dur
+        row["max_s"] = max(row["max_s"], dur)
+    rows = sorted(agg.values(),
+                  key=lambda r: r["total_s"], reverse=True)
+    for row in rows:
+        row["total_s"] = round(row["total_s"], 6)
+        row["max_s"] = round(row["max_s"], 6)
+    return rows

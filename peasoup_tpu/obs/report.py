@@ -33,6 +33,14 @@ tolerate additions)::
                      The bracketed keys are OMITTED (never null) when
                      no cost data or stage seconds exist — e.g. a
                      bare-telemetry report with no search run.
+    memory           measured HBM footprints (obs/memprof.py):
+                     {closure_factor, [programs: [{program,
+                     model_bytes, measured, measured_bytes, ratio,
+                     ok}]], [watermark], [probed_coefficients]}.
+                     OMITTED entirely until a memory_analysis probe
+                     ran this process or the backend reports live
+                     memory stats — probing is explicit (obs memory
+                     --probe, bench), never per-job.
     candidates       {count, folded, best_snr, best_folded_snr, ...}
     config           {key search parameters}
 """
@@ -160,6 +168,18 @@ def build_run_report(result=None, registry=None, events=None,
                 run_costs, report["stage_timers"], report["device"],
                 snap["gauges"])
     except Exception:  # pragma: no cover - perf must never kill a run
+        pass
+    try:
+        from .memprof import memory_report
+
+        # probe=False: only what is already known (cached program
+        # footprints + the live watermark) — a per-job report must not
+        # compile five programs; explicit probing is `obs memory
+        # --probe` / bench / tests
+        mem = memory_report(probe=False)
+        if mem.get("programs") or mem.get("watermark"):
+            report["memory"] = mem
+    except Exception:  # pragma: no cover - memprof must never kill a run
         pass
     if result is not None:
         report["timers"] = {

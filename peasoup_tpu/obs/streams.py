@@ -114,6 +114,34 @@ STREAMS: dict[str, dict] = {
         "doc": "benchmarks/history.jsonl ledger (bench/serve/anomaly "
                "records)",
     },
+    "compiles": {
+        "version": 1,
+        "version_key": "v",
+        # COMPILES_VERSION is *sourced from* this entry (no literal
+        # to drift), so no version_const binding
+        "version_const": None,
+        "required": ("v", "ts", "host", "pid", "kind"),
+        # kind:"compile" carries the attribution keys; kind:"cache"
+        # carries enabled/dir; kind:"profile" carries path
+        "optional": ("program", "geometry", "device_kind",
+                     "duration_s", "seen_before", "span", "enabled",
+                     "dir", "path", "data"),
+        "writers": (
+            ("peasoup_tpu/obs/compilation.py", "CompileLedger.record",
+             "rec"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/compilation.py", "read_compiles",
+             "rec"),
+            ("peasoup_tpu/obs/compilation.py", "summarize_compiles",
+             "rec"),
+            ("peasoup_tpu/obs/warehouse.py", "compile_rows", "rec"),
+            ("peasoup_tpu/obs/baseline.py", "compile_anomalies",
+             "rec"),
+            ("peasoup_tpu/obs/cli.py", "cmd_compiles", "rec"),
+        ),
+        "doc": "geometry-keyed XLA compile ledger (compiles.jsonl)",
+    },
     "warehouse": {
         "version": 1,
         "version_key": "v",
@@ -144,8 +172,9 @@ STREAMS: dict[str, dict] = {
                      "timers", "stage_timers", "counters", "gauges",
                      "spans", "events", "jit", "device"),
         # conditional sections + bench's `extra` merge keys
-        "optional": ("perf", "candidates", "config", "n_dm_trials",
-                     "n_accel_trials_dm0", "parity", "vs_baseline"),
+        "optional": ("perf", "memory", "candidates", "config",
+                     "n_dm_trials", "n_accel_trials_dm0", "parity",
+                     "vs_baseline"),
         "writers": (
             ("peasoup_tpu/obs/report.py", "build_run_report",
              "report"),

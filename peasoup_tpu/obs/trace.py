@@ -60,25 +60,12 @@ _COMPILE_COUNTER = "jit.backend_compiles"
 def hbm_watermark() -> dict | None:
     """Max ``bytes_in_use`` / ``peak_bytes_in_use`` over local devices,
     or None when the backend has no memory stats (CPU) — the caller
-    treats None as "unsupported" and stops polling."""
-    try:
-        import jax
+    treats None as "unsupported" and stops polling.  Delegates to
+    :func:`.memprof.hbm_watermark`, the one ``memory_stats`` call site
+    in the tree (ISSUE 18)."""
+    from .memprof import hbm_watermark as _impl
 
-        stats = [d.memory_stats() for d in jax.local_devices()]
-    except Exception:
-        return None
-    out = None
-    for ms in stats:
-        if not ms:
-            continue
-        if out is None:
-            out = {"bytes_in_use": 0, "peak_bytes_in_use": 0}
-        out["bytes_in_use"] = max(
-            out["bytes_in_use"], int(ms.get("bytes_in_use", 0)))
-        out["peak_bytes_in_use"] = max(
-            out["peak_bytes_in_use"],
-            int(ms.get("peak_bytes_in_use", ms.get("bytes_in_use", 0))))
-    return out
+    return _impl()
 
 
 def _process_index() -> int:
@@ -325,6 +312,16 @@ def span_cursor() -> int:
     ``device_duty_cycle`` ledger, ISSUE 11)."""
     with _TRACER._lock:
         return len(_TRACER._records)
+
+
+def current_span_name() -> str:
+    """Name of the innermost span open on THIS thread, or "".
+
+    Compiles run synchronously on the dispatching thread, so the
+    compile ledger (obs/compilation.py) reads this as its attribution
+    fallback when no explicit compile context was declared."""
+    st = _TRACER._thread_state()
+    return st["stack"][-1].name if st["stack"] else ""
 
 
 def device_seconds(since: int = 0) -> float:

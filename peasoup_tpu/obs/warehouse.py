@@ -362,6 +362,20 @@ class Warehouse:
             rows.extend(telemetry_rows(sample, run=run))
         return self.append_rows(rows)
 
+    # -- ingest: compile ledger -------------------------------------------
+
+    def ingest_compiles(self, path: str, *, run: str = "") -> int:
+        """Flatten a ``compiles.jsonl`` ledger (obs/compilation.py)
+        into rows — per-compile durations keyed by (program, geometry
+        fingerprint, device kind), recompile markers, cache
+        engagements and profiler-capture artifact paths."""
+        from .compilation import read_compiles
+
+        rows: list[dict] = []
+        for rec in read_compiles(path):
+            rows.extend(compile_rows(rec, run=run, clock=self._clock))
+        return self.append_rows(rows)
+
     # -- ingest: timelines -------------------------------------------------
 
     def ingest_timeline(self, path_or_workdir: str, *,
@@ -477,6 +491,51 @@ def history_rows(rec: dict, *, clock=time.time) -> list[dict]:
         rows.append(make_row(source="history",
                              metric=f"jit.compiles.{name}",
                              value=float(count), **common))
+    return rows
+
+
+def compile_rows(rec: dict, *, run: str = "",
+                 clock=time.time) -> list[dict]:
+    """Rows for one compile-ledger record.
+
+    ``kind:"compile"`` yields a ``compile.duration_s`` row keyed by
+    (stage=program, geometry fingerprint, device kind) plus a
+    ``compile.recompile`` marker when the key had been seen before;
+    ``kind:"cache"`` / ``kind:"profile"`` yield engagement/artifact
+    rows (the profile row's ``data.path`` registers the capture
+    artifact in the warehouse)."""
+    ts = rec.get("ts")
+    if ts is None:
+        ts = clock()
+    run = run or f"pid:{rec.get('pid', 0)}"
+    host = str(rec.get("host", ""))
+    kind = str(rec.get("kind", ""))
+    rows: list[dict] = []
+    if kind == "compile":
+        common = dict(
+            ts=float(ts), run=run, host=host,
+            stage=str(rec.get("program") or ""),
+            geometry=str(rec.get("geometry") or ""),
+            device_kind=str(rec.get("device_kind") or ""))
+        rows.append(make_row(
+            source="compiles", metric="compile.duration_s",
+            value=float(rec.get("duration_s") or 0.0),
+            data={"span": str(rec.get("span") or "")}, **common))
+        if rec.get("seen_before"):
+            rows.append(make_row(
+                source="compiles", metric="compile.recompile",
+                value=1.0, **common))
+    elif kind == "cache":
+        rows.append(make_row(
+            ts=float(ts), run=run, host=host, source="compiles",
+            metric="compile.cache_enabled",
+            value=1.0 if rec.get("enabled") else 0.0,
+            data={"dir": str(rec.get("dir") or "")}))
+    elif kind == "profile":
+        rows.append(make_row(
+            ts=float(ts), run=run, host=host, source="compiles",
+            metric="profile.capture", value=1.0,
+            data={"path": str(rec.get("path") or "")}))
     return rows
 
 

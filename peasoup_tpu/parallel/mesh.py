@@ -1124,7 +1124,10 @@ class MeshPulsarSearch(PulsarSearch):
     # compiled-program memory_analysis at 2^23 x 1024 chans on v5e
     # (temp = ~0.42 GB per live accel spectrum at accel_block 8->12):
     # ~12 full-length f32 buffers per live spectrum (resample windows,
-    # fft, interbin, harmonic-sum einsum windows).
+    # fft, interbin, harmonic-sum einsum windows).  Since ISSUE 18 this
+    # hand-measured figure is only the FALLBACK: on TPU _plan_chunking
+    # asks obs/memprof.probed_bytes_per("spectrum") for the live
+    # compiler's measured slope first.
     _SPECTRUM_BYTES = 48
 
     def _plan_chunking(self, namax: int) -> dict | None:
@@ -1135,6 +1138,16 @@ class MeshPulsarSearch(PulsarSearch):
         """
         cfg = self.config
         budget = int(cfg.hbm_budget_gb * 1e9)
+        # measured planner coefficients (ISSUE 18): on TPU the
+        # obs/memprof compiled-program probes supply the B/element
+        # slopes this planner previously hardcoded; the literals below
+        # remain the documented fallbacks (the probe returns None off
+        # TPU and on any probe failure, so CPU plans are unchanged)
+        from ..obs.memprof import probed_bytes_per
+
+        spectrum_bytes = int(probed_bytes_per("spectrum")
+                             or self._SPECTRUM_BYTES)
+        row_bytes = int(probed_bytes_per("row") or 8)
         ndm = len(self.dm_list)
         ndm_local = int(np.ceil(ndm / self.ndev))
         dd = self._plan_fused_pallas_dedisp()
@@ -1144,8 +1157,8 @@ class MeshPulsarSearch(PulsarSearch):
             # actually run, not the narrower pre-widening count
             ndm_local = dd["ndm_p"] // self.ndev
         est_full = (
-            self._SPECTRUM_BYTES * ndm_local * namax * self.size
-            + 8 * ndm_local * self.out_nsamps
+            spectrum_bytes * ndm_local * namax * self.size
+            + row_bytes * ndm_local * self.out_nsamps
             + self._data_bytes()
             # the fused program's device unpack materialises a full f32
             # channel-major transient alongside the packed input
@@ -1176,12 +1189,12 @@ class MeshPulsarSearch(PulsarSearch):
             # per-spectrum, not per-row — one row is whitened at a time
             # inside the scan).  Larger chunks matter: dedispersion
             # re-reads the whole filterbank once per chunk
-            per_row = 8 * self.out_nsamps
+            per_row = row_bytes * self.out_nsamps
             dm_chunk = int(max(1, min(32, (avail // 4) // per_row)))
         if cfg.accel_block:
             accel_block = cfg.accel_block
         else:
-            live = (avail * 3 // 4) // (self._SPECTRUM_BYTES * self.size)
+            live = (avail * 3 // 4) // (spectrum_bytes * self.size)
             accel_block = int(max(1, min(namax, live)))
         ndm_local_p = int(np.ceil(ndm_local / dm_chunk)) * dm_chunk
         namax_p = int(np.ceil(namax / accel_block)) * accel_block
@@ -2349,9 +2362,19 @@ class MeshPulsarSearch(PulsarSearch):
     def run(self) -> SearchResult:
         import time
 
+        from ..obs.compilation import set_compile_context
         from ..obs.metrics import install_compile_hook
 
         install_compile_hook()
+        # compile attribution (ISSUE 18): ledger every backend compile
+        # this run triggers against its search geometry
+        set_compile_context(
+            program="mesh.search",
+            geometry={"nchans": int(self.fil.nchans),
+                      "nbits": int(self.fil.header.nbits),
+                      "size": int(self.size),
+                      "out_nsamps": int(self.out_nsamps),
+                      "n_dm": len(self.dm_list)})
         cfg = self.config
         timers: dict[str, float] = {}
         t_total = time.time()

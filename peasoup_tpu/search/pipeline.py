@@ -103,6 +103,16 @@ whiten_trial = jax.jit(
     whiten_core, static_argnames=("bin_width", "b5", "b25", "use_zap")
 )
 
+#: module-level jit of the channel-scan dedispersion for the EAGER
+#: (host-loop) driver.  Calling ``ops.dedisperse.dedisperse`` eagerly
+#: recompiles on every call — its ``lax.scan`` body is a fresh closure
+#: per call, so jax's tracing cache never hits (the compile ledger of
+#: ISSUE 18 surfaced this as one recompile per warm job).  A stable
+#: module-level jit keys the cache on THIS function object + shapes,
+#: so same-geometry jobs replay the compiled program.  The fused mesh
+#: path is unaffected (it traces ``dedisperse`` inside its own jit).
+dedisperse_trials = jax.jit(dedisperse, static_argnums=(2,))
+
 
 def dump_whiten_stages(dump_dir, idx, tim, birdies, widths, bin_width,
                        b5, b25, use_zap) -> None:
@@ -488,7 +498,7 @@ class PulsarSearch:
             trials = dedisperse_subband(
                 data, jnp.asarray(self.delays), plan, self.out_nsamps)
         else:
-            trials = dedisperse(
+            trials = dedisperse_trials(
                 data, jnp.asarray(self.delays), self.out_nsamps, km
             )
         return self._maybe_quantise(trials)
@@ -904,11 +914,21 @@ class PulsarSearch:
                           self._identity_config())
 
     def run(self) -> SearchResult:
+        from ..obs.compilation import set_compile_context
         from ..obs.costmodel import record_run_costs
         from ..obs.metrics import install_compile_hook
         from ..utils import ProgressBar
 
         install_compile_hook()
+        # compile attribution (ISSUE 18): ledger every backend compile
+        # this run triggers against its search geometry
+        set_compile_context(
+            program="pipeline.search",
+            geometry={"nchans": int(self.fil.nchans),
+                      "nbits": int(self.fil.header.nbits),
+                      "size": int(self.size),
+                      "out_nsamps": int(self.out_nsamps),
+                      "n_dm": len(self.dm_list)})
         self._span_cursor0 = span_cursor()
         cfg = self.config
         timers: dict[str, float] = {}
@@ -1553,10 +1573,21 @@ def fold_candidates(
     # scale this still folds every candidate in ONE dispatch — each
     # extra dispatch costs a ~0.11 s host round-trip on the
     # remote-attached TPU.
+    from ..obs.memprof import probed_bytes_per
     from ..ops.harmonics import _on_tpu
 
     n = len(fold_ids)
-    bytes_per_samp = 96 + (2 * nbins + 32 if _on_tpu() else 0)
+    # measured coefficient first (ISSUE 18): the memprof probe returns
+    # the live compiler's marginal B/samp for the fold program (None
+    # off-TPU / on failure -> the hand-measured fallback below).  The
+    # probe measures the 72 B/samp chain without the retained-arena
+    # margin, so the same 96/72 headroom factor is applied on top
+    probed = probed_bytes_per("fold_samp")
+    tpu_extra = 2 * nbins + 32 if _on_tpu() else 0
+    if probed:
+        bytes_per_samp = int(probed * 96.0 / 72.0) + tpu_extra
+    else:
+        bytes_per_samp = 96 + tpu_extra
     if hbm_free_bytes is not None:
         batch = int(max(1, min(
             n, hbm_free_bytes // (bytes_per_samp * nsamps))))

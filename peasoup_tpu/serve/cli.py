@@ -329,6 +329,12 @@ def _add_worker_args(pw) -> None:
                     help="live telemetry sampling cadence in seconds "
                          "(per-host fleet/ts-<host>.jsonl shard; "
                          "0 disables the sampler)")
+    pw.add_argument("--profile-every", type=int, default=0,
+                    help="capture a sampled jax.profiler device trace "
+                         "for every Nth job (artifacts under "
+                         "<spool>/profiles/, registered in the compile "
+                         "ledger; tolerant no-op where the profiler "
+                         "is unavailable; 0 disables)")
 
 
 def cmd_submit(spool, args) -> int:
@@ -375,6 +381,7 @@ def cmd_worker(spool, args) -> int:
         history_path=args.history,
         batch=args.batch,
         telemetry_interval_s=args.telemetry_interval,
+        profile_every=args.profile_every,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -421,6 +428,7 @@ def cmd_fleet_worker(spool, args) -> int:
         history_path=args.history,
         batch=args.batch,
         telemetry_interval_s=args.telemetry_interval,
+        profile_every=args.profile_every,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)

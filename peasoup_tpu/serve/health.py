@@ -648,6 +648,47 @@ def rule_batch_mix(ctx: HealthContext) -> list[HealthFinding]:
         f"dominant bucket {dominant} vs batch {batch}", data=data)]
 
 
+#: windowed recompiles on an ALREADY-SEEN (program, geometry, device)
+#: key before the fleet is re-paying compiles it should replay from
+#: cache: a couple may be legitimate (donor programs evicted, an
+#: escalated re-search), a storm means the program-reuse bucketing or
+#: the persistent compile cache is broken (ISSUE 18)
+COMPILE_STORM_WARN = 3
+COMPILE_STORM_CRIT = 10
+
+
+@health_rule
+def rule_compile_storm(ctx: HealthContext) -> list[HealthFinding]:
+    """Recompile storm (ISSUE 18): the compile ledger's attribution
+    counters ride the telemetry stream, so a fleet re-paying XLA
+    compiles for geometry fingerprints it has ALREADY compiled this
+    process is visible here without reading compiles.jsonl.  A warm
+    worker should replay cached programs — recompiles on a seen key
+    mean the geometry bucketing regressed, the jit cache is thrashing,
+    or the persistent compile cache silently disengaged.  No samples /
+    no counter = ok (unknown is not unhealthy); ``obs compiles``
+    answers WHICH geometry paid."""
+    recompiles = _recent_counter(ctx, "jit.recompiles_seen_geometry")
+    attributed = _recent_counter(ctx, "jit.compiles_attributed")
+    data = {"recompiles_seen_geometry": recompiles,
+            "compiles_attributed": attributed}
+    if recompiles >= COMPILE_STORM_CRIT:
+        return [HealthFinding(
+            "compile_storm", CRIT,
+            f"{recompiles} recompile(s) of already-seen geometry in "
+            f"the window — program reuse is broken; see `obs "
+            f"compiles` for the paying geometry", data=data)]
+    if recompiles >= COMPILE_STORM_WARN:
+        return [HealthFinding(
+            "compile_storm", WARN,
+            f"{recompiles} recompile(s) of already-seen geometry in "
+            f"the window (cache miss or bucketing drift)", data=data)]
+    return [HealthFinding(
+        "compile_storm", OK,
+        f"{recompiles} recompile(s) of seen geometry in the window "
+        f"({attributed} attributed compile(s))", data=data)]
+
+
 #: recent anomaly records meaning "the fleet is drifting" vs "on fire"
 ANOMALY_CRIT_COUNT = 3
 

@@ -36,6 +36,7 @@ rows on the next claim instead of recomputing from scratch.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -178,7 +179,8 @@ class SurveyWorker:
                  max_devices: int | None = None, worker_id: str = "",
                  prefetch: bool = True, run_job_fn=None,
                  history_path: str | None = None, sleeper=None,
-                 batch: int = 1, telemetry_interval_s: float = 5.0):
+                 batch: int = 1, telemetry_interval_s: float = 5.0,
+                 profile_every: int = 0, profile_dir: str | None = None):
         self.spool = spool
         self.store = store if store is not None else CandidateStore(
             os.path.join(spool.root, "candidates.jsonl"))
@@ -203,6 +205,15 @@ class SurveyWorker:
         #: sampler.  The shard lands in the spool's ``fleet/`` dir so
         #: ``health`` / ``status --watch`` see single-host workers too
         self.telemetry_interval_s = float(telemetry_interval_s)
+        #: sampled device profiling (ISSUE 18): capture a jax.profiler
+        #: trace for every Nth job (0 disables).  Tolerant no-op where
+        #: the profiler is unavailable; each capture lands under
+        #: ``profile_dir`` and is registered in the compile ledger
+        #: (kind ``profile``) so the warehouse knows the artifact path
+        self.profile_every = max(0, int(profile_every))
+        self.profile_dir = profile_dir or os.path.join(
+            spool.root, "profiles")
+        self._jobs_started = 0
         #: observation-granularity pipeline depth (ISSUE 11): how many
         #: jobs ahead the prefetcher reads (and device-stages).  Jobs
         #: are still CLAIMED one at a time — lookahead uses peeks, so a
@@ -273,6 +284,17 @@ class SurveyWorker:
         if gkey in self.geometries:
             METRICS.inc("scheduler.plan_reuse")
         self.geometries[gkey] = self.geometries.get(gkey, 0) + 1
+        # compile attribution (ISSUE 18): every backend compile fired
+        # while this search runs is ledgered against the reuse-bucket
+        # geometry — a cold bucket shows its compiles, a warm one shows
+        # recompiles (the compile_storm health rule watches the latter)
+        from ..obs.compilation import set_compile_context
+
+        set_compile_context(
+            program="serve.search",
+            geometry={"nchans": gkey[0], "nbits": gkey[1],
+                      "size": gkey[2], "out_nsamps": gkey[3],
+                      "n_dm": gkey[4]})
         return fil, search
 
     def _stage_observation(self, fil, job: JobRecord):
@@ -696,13 +718,53 @@ class SurveyWorker:
             self.spool.release(job)
             pause(delay, self.sleeper)
 
+    def _maybe_profile(self, job: JobRecord):
+        """Sampled device profiling (ISSUE 18): a ``jax.profiler``
+        trace context for every ``profile_every``-th job started, a
+        no-op context otherwise.  Start/stop failures (no profiler in
+        this jax build, no TensorFlow trace backend, double-start) are
+        swallowed — profiling must never fail a job — and a successful
+        capture is registered in the compile ledger (kind ``profile``)
+        + the ``profile.captures`` counter so the warehouse ingests
+        the artifact path."""
+        self._jobs_started += 1
+        if (self.profile_every <= 0
+                or self._jobs_started % self.profile_every != 0):
+            return contextlib.nullcontext()
+        return self._profile_capture(job)
+
+    @contextlib.contextmanager
+    def _profile_capture(self, job: JobRecord):
+        path = os.path.join(self.profile_dir, f"job-{job.job_id}")
+        try:
+            import jax
+
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+        except Exception:
+            yield  # tolerant no-op where the profiler is unavailable
+            return
+        try:
+            yield
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            try:
+                from ..obs.compilation import record_profile
+
+                record_profile(path)
+            except Exception:
+                pass
+
     def run_one(self, job: JobRecord) -> bool:
         """Run one claimed job through the retry machinery; True on
         success."""
         runner = self.run_job_fn or self._run_job
         resumes0 = int(METRICS.snapshot().get("counters", {}).get(
             "checkpoint.resumes", 0))
-        with self._recorder(job), \
+        with self._recorder(job), self._maybe_profile(job), \
                 span(f"Job-{job.job_id}", metric="job",
                      job_id=job.job_id, input=job.input,
                      attempt=job.attempts, priority=job.priority,
@@ -732,14 +794,30 @@ class SurveyWorker:
         """Claim and run jobs until the queue is empty (or ``wait``
         to poll for more), appending one throughput record to the
         bench history ledger (obs/history.py, kind ``serve``)."""
+        from ..obs.compilation import (
+            configure_compile_ledger,
+            install_compile_ledger,
+        )
         from ..obs.metrics import install_compile_hook
 
         install_compile_hook()
+        # geometry-keyed compile ledger (ISSUE 18): one spool-level
+        # compiles.jsonl attributing every backend compile this drain
+        # pays to the search geometry that triggered it
+        configure_compile_ledger(
+            os.path.join(self.spool.root, "compiles.jsonl"))
+        install_compile_ledger()
         sampler = self._start_telemetry()
         ov0 = timeline.overhead()  # mark-cost ledger origin
         t0 = time.time()
+        timers0 = {
+            name: float(rec.get("host_s", 0.0))
+            for name, rec in
+            METRICS.snapshot().get("timers", {}).items()
+        }  # cold-start phase-decomposition origin
         span_c0 = span_cursor()  # drain-level duty-cycle ledger origin
         claimed = succeeded = 0
+        coldstart: dict | None = None
         try:
             while max_jobs is None or claimed < max_jobs:
                 job = self.spool.claim(self.worker_id,
@@ -762,6 +840,8 @@ class SurveyWorker:
                     succeeded += self._run_batch_jobs([job] + mates)
                 elif self.run_one(job):
                     succeeded += 1
+                if coldstart is None and succeeded > 0:
+                    coldstart = self._coldstart(t0, timers0, span_c0)
             elapsed = time.time() - t0
             jobs_per_hour = (succeeded / (elapsed / 3600.0)
                              if elapsed > 0 else 0.0)
@@ -792,6 +872,8 @@ class SurveyWorker:
             # (run_with_timeout abandons them; serve/retry.py)
             "timeout_abandoned": abandoned_count(),
         }
+        if coldstart is not None:
+            summary["coldstart"] = coldstart
         if sampler is not None:
             summary["telemetry"] = {
                 "samples": sampler.samples_written,
@@ -806,6 +888,39 @@ class SurveyWorker:
         }
         self._append_throughput(summary)
         return summary
+
+    def _coldstart(self, t0: float, timers0: dict,
+                   span_c0: int) -> dict:
+        """Cold-start decomposition (ISSUE 18): wall time from drain
+        start to the FIRST finished job, split into where it went —
+        observation ``read`` (obs_read host seconds), XLA ``compile``
+        (jit_compile host seconds), device ``execute`` (span-attributed
+        device seconds) and ``trace`` (the remainder: jax tracing +
+        host dispatch + claim bookkeeping).  The headline total lands
+        in the ``coldstart.cold_to_first_candidate_s`` gauge (so it
+        rides the telemetry stream) and in the drain summary; bench
+        ``--coldstart`` ledgers it for the perf gate."""
+        snap = METRICS.snapshot()
+        timers = snap.get("timers", {})
+
+        def delta(name: str) -> float:
+            now = float(timers.get(name, {}).get("host_s", 0.0))
+            return max(0.0, now - float(timers0.get(name, 0.0)))
+
+        total = max(0.0, time.time() - t0)
+        read_s = delta("obs_read")
+        compile_s = delta("jit_compile")
+        execute_s = max(0.0, device_seconds(span_c0))
+        trace_s = max(0.0, total - read_s - compile_s - execute_s)
+        METRICS.gauge("coldstart.cold_to_first_candidate_s",
+                      round(total, 6))
+        return {
+            "cold_to_first_candidate_s": round(total, 6),
+            "read_s": round(read_s, 6),
+            "trace_s": round(trace_s, 6),
+            "compile_s": round(compile_s, 6),
+            "execute_s": round(execute_s, 6),
+        }
 
     def _start_telemetry(self):
         """Spin up the per-host telemetry sampler for this drain (None

@@ -1,0 +1,150 @@
+"""Cold-start observatory smoke test (``make coldstart-smoke``).
+
+ISSUE 18's end-to-end check of the cold-start instrumentation, in one
+process so the second drain is genuinely WARM (the jit program caches
+of the first drain are still live):
+
+Phase 1 — cold drain: spool two synthetic same-geometry observations
+into a fresh spool and ``drain()`` a worker.  Assert the drain summary
+carries the ``coldstart`` decomposition, that its read / trace /
+compile / execute phases sum to the ``cold_to_first_candidate_s``
+total (the decomposition is a partition, not a sampling), that the
+``coldstart.cold_to_first_candidate_s`` gauge was recorded, and that
+the spool-level compile ledger (``compiles.jsonl``) attributes every
+backend compile to a named program AND a geometry fingerprint — an
+anonymous compile is exactly the blind spot the ledger exists to
+close.
+
+Phase 2 — warm drain: the same observations through a SECOND spool +
+worker in the same process.  The geometry is identical, so every
+device program must replay from the in-process jit cache: the warm
+spool's compile ledger must hold ZERO compile records.  A warm worker
+that recompiles has broken program reuse (the regression the
+``compile_storm`` health rule pages on).
+
+Exit status 0 only if every assertion holds — CI-gateable like
+``serve-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+
+
+def _check(ok: bool, what: str, failures: list[str]) -> None:
+    print(("PASS " if ok else "FAIL ") + what)
+    if not ok:
+        failures.append(what)
+
+
+def _drain(spool_dir: str, obs: list[str], overrides: dict,
+           history: str | None) -> dict:
+    from peasoup_tpu.serve import JobSpool, SurveyWorker
+
+    spool = JobSpool(spool_dir)
+    for path in obs:
+        spool.submit(path, overrides)
+    worker = SurveyWorker(spool, single_device=True,
+                          history_path=history,
+                          sleeper=lambda s: None)
+    return worker.drain()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="peasoup-tpu-coldstart-smoke",
+        description="Peasoup-TPU - cold-start observatory smoke test",
+    )
+    p.add_argument("--dir", default="/tmp/peasoup-coldstart-smoke",
+                   help="scratch directory (wiped)")
+    p.add_argument("--history", default=None,
+                   help="history ledger to append serve records to "
+                        "(default: the repo benchmarks/history.jsonl)")
+    args = p.parse_args(argv)
+
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir)
+
+    from peasoup_tpu.obs.compilation import (
+        read_compiles, reset_seen_geometries, summarize_compiles,
+    )
+    from peasoup_tpu.obs.metrics import REGISTRY
+    from peasoup_tpu.tools.batch_smoke import _write_synthetic
+
+    REGISTRY.reset()
+    reset_seen_geometries()
+    obs = [
+        _write_synthetic(os.path.join(args.dir, f"obs{i}.fil"), seed=i)
+        for i in range(2)
+    ]
+    overrides = {"dm_end": 20.0, "min_snr": 6.0, "npdmp": 0,
+                 "limit": 10}
+    failures: list[str] = []
+
+    # ---- phase 1: cold drain -----------------------------------------
+    cold_spool = os.path.join(args.dir, "jobs_cold")
+    summary = _drain(cold_spool, obs, overrides, args.history)
+    _check(summary["succeeded"] == 2, "cold drain finished 2/2 jobs",
+           failures)
+
+    cold = summary.get("coldstart") or {}
+    total = float(cold.get("cold_to_first_candidate_s", 0.0))
+    _check(total > 0.0,
+           f"cold_to_first_candidate_s measured ({total:.3f} s)",
+           failures)
+    phases = (float(cold.get("read_s", 0.0))
+              + float(cold.get("trace_s", 0.0))
+              + float(cold.get("compile_s", 0.0))
+              + float(cold.get("execute_s", 0.0)))
+    _check(abs(phases - total) < 0.01,
+           f"read/trace/compile/execute partition the total "
+           f"({phases:.3f} vs {total:.3f} s)", failures)
+    gauge = REGISTRY.snapshot()["gauges"].get(
+        "coldstart.cold_to_first_candidate_s")
+    _check(gauge is not None and float(gauge) == total,
+           "coldstart.cold_to_first_candidate_s gauge recorded",
+           failures)
+
+    cold_recs = read_compiles(
+        os.path.join(cold_spool, "compiles.jsonl"), kinds=("compile",))
+    _check(len(cold_recs) > 0,
+           f"cold drain ledgered {len(cold_recs)} compile(s)",
+           failures)
+    anon = [r for r in cold_recs
+            if not r.get("program") or not r.get("geometry")]
+    _check(not anon,
+           "every ledgered compile names its program and geometry "
+           f"({len(anon)} anonymous)", failures)
+    for row in summarize_compiles(cold_recs)[:5]:
+        print(f"  compile: {row['program']} @{row['geometry']} "
+              f"x{row['compiles']} ({row['total_s']:.3f} s)")
+
+    # ---- phase 2: warm drain (same process, same geometry) -----------
+    REGISTRY.reset()
+    warm_spool = os.path.join(args.dir, "jobs_warm")
+    summary2 = _drain(warm_spool, obs, overrides, args.history)
+    _check(summary2["succeeded"] == 2, "warm drain finished 2/2 jobs",
+           failures)
+    warm = summary2.get("coldstart") or {}
+    _check(float(warm.get("cold_to_first_candidate_s", 0.0)) > 0.0,
+           "warm drain decomposed its first-candidate time too",
+           failures)
+    warm_recs = read_compiles(
+        os.path.join(warm_spool, "compiles.jsonl"), kinds=("compile",))
+    _check(len(warm_recs) == 0,
+           f"warm drain ledgered zero new compiles "
+           f"({len(warm_recs)} found)", failures)
+
+    if failures:
+        print(f"\ncoldstart-smoke: {len(failures)} check(s) FAILED",
+              file=sys.stderr)
+        return 1
+    print("\ncoldstart-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,11 +54,14 @@ GATE_METRIC = "e2e_s"
 #: ``kind:"sensitivity"`` records — ISSUE 14; higher is better, see
 #: below), and the chaos harness's ``chaos_recovery_s`` (from
 #: ``kind:"chaos"`` records — ISSUE 15; fault injection to health
-#: exit-0, lower is better).  A metric with fewer than 2 records
-#: passes vacuously — ledgers predating a metric stay green.
+#: exit-0, lower is better), and the cold-start observatory's
+#: ``cold_to_first_candidate_s`` (from ``kind:"coldstart"`` records —
+#: ISSUE 18; worker start to first finished job, lower is better).  A
+#: metric with fewer than 2 records passes vacuously — ledgers
+#: predating a metric stay green.
 STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
                       "jerk_s_per_ktrial", "recovery_fraction",
-                      "chaos_recovery_s")
+                      "chaos_recovery_s", "cold_to_first_candidate_s")
 
 #: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
 #: device seconds per wall second — a drop means the dispatch pipeline
@@ -384,6 +387,42 @@ def chaos_table(ledger: str | None = None, limit: int = 12) -> str:
     return "\n".join(lines)
 
 
+def coldstart_table(ledger: str | None = None, limit: int = 12) -> str:
+    """Cold-start history (``kind:"coldstart"`` ledger records —
+    ISSUE 18): wall time from worker start to the first finished job,
+    decomposed into read / trace / compile / execute phases, next to
+    the warm-drain figure and the compile count the cold drain paid,
+    so "did dispatch get slower to first science, and which phase ate
+    it" is trendable from the default report view."""
+    records = load_history(ledger or default_ledger_path(),
+                           kinds=("coldstart",))
+    if not records:
+        return ""
+    lines = [f"cold start ({len(records)} record(s); newest last):",
+             f"  {'ts':<20}{'cold_s':>8}{'read':>7}{'trace':>7}"
+             f"{'compile':>8}{'exec':>7}{'warm_s':>8}{'compiles':>9}"]
+    for rec in records[-limit:]:
+        m = rec.get("metrics", {})
+        lines.append(
+            f"  {str(rec.get('ts', ''))[:19]:<20}"
+            f"{float(m.get('cold_to_first_candidate_s', 0.0)):>8.3g}"
+            f"{float(m.get('coldstart_read_s', 0.0)):>7.2g}"
+            f"{float(m.get('coldstart_trace_s', 0.0)):>7.2g}"
+            f"{float(m.get('coldstart_compile_s', 0.0)):>8.2g}"
+            f"{float(m.get('coldstart_execute_s', 0.0)):>7.2g}"
+            f"{float(m.get('warm_to_first_candidate_s', 0.0)):>8.3g}"
+            f"{int(m.get('coldstart_compiles', 0)):>9}")
+    vals = [float(r["metrics"]["cold_to_first_candidate_s"])
+            for r in records
+            if isinstance(r.get("metrics", {}).get(
+                "cold_to_first_candidate_s"), (int, float))]
+    if vals:
+        lines.append(f"  cold-start trend: {sparkline(vals)}  "
+                     f"(median {_median(vals):.4g} s, last "
+                     f"{vals[-1]:.4g} s)")
+    return "\n".join(lines)
+
+
 def stage_table(records: list[dict]) -> str:
     """Trailing per-stage device-time and utilization figures (from the
     newest record that carries them)."""
@@ -532,7 +571,8 @@ def main(argv=None) -> int:
             try:
                 gate_records = records + load_history(
                     args.ledger or default_ledger_path(),
-                    kinds=("jerk", "sensitivity", "chaos"))
+                    kinds=("jerk", "sensitivity", "chaos",
+                           "coldstart"))
             except OSError:
                 pass
         codes, msgs = [], []
@@ -589,6 +629,10 @@ def main(argv=None) -> int:
         if ct:
             print()
             print(ct)
+        cs = coldstart_table(args.ledger)
+        if cs:
+            print()
+            print(cs)
     if gate_msg:
         print()
         print(gate_msg)

@@ -22,7 +22,10 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
 
     ``cache_dir`` defaults to ``$PEASOUP_XLA_CACHE`` or
     ``~/.cache/peasoup_tpu/xla``.  Returns the directory used, or None
-    if the cache could not be enabled.
+    if the cache could not be enabled.  Either way the decision is
+    recorded as a ``kind:"cache"`` compile-ledger record (plus the
+    ``compile_cache.enabled`` counter when it engaged) so cache
+    engagement is a queryable fact, not an invisible return value.
     """
     if cache_dir is None:
         cache_dir = os.environ.get("PEASOUP_XLA_CACHE") or os.path.join(
@@ -36,6 +39,7 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
             # warns about SIGILL on mismatch) and CPU compiles are
             # fast anyway — only accelerator executables are worth
             # persisting
+            _record_cache(False, cache_dir)
             return None
         os.makedirs(cache_dir, exist_ok=True)
 
@@ -45,13 +49,26 @@ def enable_compile_cache(cache_dir: str | None = None) -> str | None:
         # programs whose round-trip latency is the actual cost
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _record_cache(True, cache_dir)
         return cache_dir
     except Exception as exc:  # unwritable dir, unknown config, ...
         from ..obs.events import warn_event
 
+        _record_cache(False, cache_dir)
         warn_event(
             "compile_cache_disabled",
             f"persistent compile cache disabled: {exc}",
             cache_dir=cache_dir,
         )
         return None
+
+
+def _record_cache(enabled: bool, cache_dir: str) -> None:
+    """Ledger whether the cache engaged (and where) — engagement was
+    previously an invisible return value (ISSUE 18)."""
+    try:
+        from ..obs.compilation import record_cache_event
+
+        record_cache_event(enabled, cache_dir)
+    except Exception:
+        pass

@@ -1,0 +1,349 @@
+"""Cold-start observatory tests (ISSUE 18): the geometry-keyed
+compile ledger (record/read round-trip, rotation, live attribution of
+real backend compiles), its warehouse ingest + baseline-band
+round-trip, the measured-HBM closure of every registered pipeline
+program against the cost model, the shared ``memory_stats`` helper's
+CPU no-op, the worker's cold-start decomposition, and the perf
+report's coldstart table."""
+
+import json
+import os
+
+import pytest
+
+from peasoup_tpu.obs.compilation import (
+    COMPILES_VERSION,
+    CompileLedger,
+    compile_context,
+    configure_compile_ledger,
+    install_compile_ledger,
+    read_compiles,
+    record_cache_event,
+    record_profile,
+    reset_seen_geometries,
+    summarize_compiles,
+)
+from peasoup_tpu.obs.metrics import MetricsRegistry, REGISTRY
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+@pytest.fixture()
+def ledger_path(tmp_path):
+    """Point the process-wide ledger at a scratch file for the test,
+    then park it back on disabled so other tests never write here."""
+    path = str(tmp_path / "compiles.jsonl")
+    configure_compile_ledger(path)
+    yield path
+    configure_compile_ledger("")
+
+
+# --------------------------------------------------------------------------
+# ledger round-trip
+# --------------------------------------------------------------------------
+
+def test_ledger_record_read_round_trip(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    led = CompileLedger(path)
+    led.record("compile", program="p", geometry="abc123",
+               device_kind="cpu", duration_s=0.5, seen_before=False,
+               span="Dedisperse")
+    led.record("cache", enabled=True, dir="/tmp/x")
+    led.record("profile", path="/tmp/prof")
+    recs = read_compiles(path)
+    assert [r["kind"] for r in recs] == ["compile", "cache", "profile"]
+    for r in recs:
+        assert r["v"] == COMPILES_VERSION
+        assert r["host"] and r["pid"] > 0 and r["ts"] > 0
+    assert recs[0]["program"] == "p"
+    assert recs[0]["geometry"] == "abc123"
+    assert recs[0]["duration_s"] == 0.5
+    assert recs[1]["enabled"] is True
+    assert recs[2]["path"] == "/tmp/prof"
+    assert [r["kind"] for r in read_compiles(path, kinds=("compile",))] \
+        == ["compile"]
+
+
+def test_read_compiles_skips_torn_and_future(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    good = {"v": COMPILES_VERSION, "ts": 1.0, "host": "h", "pid": 1,
+            "kind": "compile", "duration_s": 0.1}
+    future = dict(good, v=COMPILES_VERSION + 1)
+    with open(path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write(json.dumps(future) + "\n")
+        f.write('{"torn": tr\n')  # crash mid-write
+    assert read_compiles(path) == [good]
+    assert read_compiles(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_ledger_rotates_at_byte_budget(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    led = CompileLedger(path, max_ledger_bytes=512)
+    for i in range(50):
+        led.record("compile", program=f"p{i}", duration_s=0.1)
+    assert os.path.exists(path + ".1")
+    # both generations hold valid records; neither is ever lost whole
+    assert read_compiles(path) and read_compiles(path + ".1")
+
+
+def test_summarize_groups_by_program_geometry():
+    recs = [
+        {"kind": "compile", "program": "a", "geometry": "g1",
+         "device_kind": "cpu", "duration_s": 0.2, "seen_before": False},
+        {"kind": "compile", "program": "a", "geometry": "g1",
+         "device_kind": "cpu", "duration_s": 0.3, "seen_before": True},
+        {"kind": "compile", "program": "b", "geometry": "g2",
+         "device_kind": "cpu", "duration_s": 0.1, "seen_before": False},
+    ]
+    rows = summarize_compiles(recs)
+    assert [r["program"] for r in rows] == ["a", "b"]  # total_s desc
+    assert rows[0]["compiles"] == 2 and rows[0]["recompiles"] == 1
+    assert rows[0]["total_s"] == pytest.approx(0.5)
+    assert rows[0]["max_s"] == pytest.approx(0.3)
+
+
+# --------------------------------------------------------------------------
+# live attribution of real backend compiles
+# --------------------------------------------------------------------------
+
+def test_attribution_names_program_and_geometry(ledger_path):
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    install_compile_ledger()
+    reset_seen_geometries()
+    with compile_context("unit.test", {"size": 131}):
+        jax.jit(lambda x: x * 2.0 + 1.0)(
+            jnp.ones((131,), jnp.float32)).block_until_ready()
+    recs = read_compiles(ledger_path, kinds=("compile",))
+    assert recs, "a fresh jit must ledger at least one backend compile"
+    for r in recs:
+        assert r["program"] == "unit.test"
+        assert r["geometry"] and r["device_kind"]
+        assert r["duration_s"] > 0.0
+    fingerprint = recs[0]["geometry"]
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters.get("jit.compiles_attributed", 0) >= len(recs)
+
+    # a second, DIFFERENT program at the same declared geometry is a
+    # recompile of a seen key: flagged in the record and the counter
+    with compile_context("unit.test", {"size": 131}):
+        jax.jit(lambda x: x - 3.0)(
+            jnp.ones((131,), jnp.float32)).block_until_ready()
+    recs = read_compiles(ledger_path, kinds=("compile",))
+    assert any(r["seen_before"] for r in recs)
+    assert all(r["geometry"] == fingerprint for r in recs)
+    counters = REGISTRY.snapshot()["counters"]
+    assert counters.get("jit.recompiles_seen_geometry", 0) >= 1
+    rows = summarize_compiles(recs)
+    assert rows[0]["program"] == "unit.test"
+    assert rows[0]["recompiles"] >= 1
+
+
+def test_cache_and_profile_events(ledger_path):
+    reg = MetricsRegistry()
+    record_cache_event(True, "/tmp/jax-cache", registry=reg)
+    record_cache_event(False, "", registry=reg)
+    record_profile("/tmp/profiles/job-1", registry=reg)
+    recs = read_compiles(ledger_path)
+    cache = [r for r in recs if r["kind"] == "cache"]
+    assert [r["enabled"] for r in cache] == [True, False]
+    assert cache[0]["dir"] == "/tmp/jax-cache"
+    prof = [r for r in recs if r["kind"] == "profile"]
+    assert prof[0]["path"] == "/tmp/profiles/job-1"
+    counters = reg.snapshot()["counters"]
+    assert counters.get("compile_cache.enabled") == 1
+    assert counters.get("profile.captures") == 1
+
+
+# --------------------------------------------------------------------------
+# warehouse ingest + baseline band round-trip
+# --------------------------------------------------------------------------
+
+def _compile_rec(ts, dur, *, program="mesh.search", geometry="g1",
+                 seen=False):
+    return {"v": COMPILES_VERSION, "ts": ts, "host": "h0", "pid": 7,
+            "kind": "compile", "program": program, "geometry": geometry,
+            "device_kind": "cpu", "duration_s": dur,
+            "seen_before": seen, "span": ""}
+
+
+def test_warehouse_ingest_compiles(tmp_path):
+    from peasoup_tpu.obs.warehouse import Warehouse, compile_rows
+
+    rows = compile_rows(_compile_rec(10.0, 0.4, seen=True), run="r1")
+    assert [r["metric"] for r in rows] == ["compile.duration_s",
+                                           "compile.recompile"]
+    assert rows[0]["stage"] == "mesh.search"
+    assert rows[0]["geometry"] == "g1"
+    assert rows[0]["device_kind"] == "cpu"
+    assert rows[0]["value"] == pytest.approx(0.4)
+    cache_row = compile_rows(
+        {"kind": "cache", "ts": 1.0, "pid": 7, "enabled": True,
+         "dir": "/c"})[0]
+    assert cache_row["metric"] == "compile.cache_enabled"
+    assert cache_row["value"] == 1.0 and cache_row["run"] == "pid:7"
+    prof_row = compile_rows(
+        {"kind": "profile", "ts": 1.0, "pid": 7, "path": "/p"})[0]
+    assert prof_row["metric"] == "profile.capture"
+    assert prof_row["data"]["path"] == "/p"
+
+    path = str(tmp_path / "compiles.jsonl")
+    with open(path, "w") as f:
+        for i in range(3):
+            f.write(json.dumps(_compile_rec(float(i), 0.1 * (i + 1),
+                                            seen=i > 0)) + "\n")
+    wh = Warehouse(str(tmp_path / "wh"))
+    n = wh.ingest_compiles(path, run="r2")
+    assert n == 5  # 3 durations + 2 recompile markers
+    got = wh.rows(metric="compile.duration_s")
+    assert len(got) == 3
+    assert {r["run"] for r in got} == {"r2"}
+    assert {r["geometry"] for r in got} == {"g1"}
+
+
+def test_compile_anomalies_band_round_trip():
+    from peasoup_tpu.obs.baseline import compile_anomalies
+
+    stable = [_compile_rec(float(i), 0.1 + 0.001 * (i % 3))
+              for i in range(9)]
+    assert compile_anomalies(stable) == []
+    spike = stable + [_compile_rec(99.0, 10.0)]
+    anomalies = compile_anomalies(spike)
+    assert len(anomalies) == 1
+    a = anomalies[0]
+    assert a["kind"] == "anomaly"
+    assert a["metric"] == "compile_duration_s"
+    assert a["key"]["stage"] == "mesh.search"
+    assert a["key"]["geometry"] == "g1"
+    assert a["value"] == pytest.approx(10.0)
+    # a different geometry is a different baseline group: three
+    # samples of a NEW fingerprint are its own (short) history, and
+    # with min_n unmet they never borrow g1's band
+    other = stable + [_compile_rec(100.0 + i, 5.0, geometry="g2")
+                      for i in range(2)]
+    assert compile_anomalies(other) == []
+
+
+# --------------------------------------------------------------------------
+# measured HBM footprints vs the cost model
+# --------------------------------------------------------------------------
+
+def test_memory_closure_all_registered_programs():
+    pytest.importorskip("jax")
+    from peasoup_tpu.obs.memprof import (
+        MEMORY_CLOSURE_FACTOR, memory_join, memory_report,
+        program_footprints,
+    )
+
+    rows = memory_join(program_footprints())
+    assert [r["program"] for r in rows] == [
+        "dedisperse", "spectrum", "harmonics", "peaks", "fold"]
+    measured = [r for r in rows if r["measured"] is not None]
+    if not measured:
+        pytest.skip("memory_analysis() unavailable on this backend")
+    for r in measured:
+        assert r["model_bytes"] > 0 and r["measured_bytes"] > 0
+        assert r["ok"], (
+            f"{r['program']}: measured/model ratio {r['ratio']} "
+            f"outside the documented x{MEMORY_CLOSURE_FACTOR} band")
+        assert 1.0 / MEMORY_CLOSURE_FACTOR <= r["ratio"] \
+            <= MEMORY_CLOSURE_FACTOR
+    rep = memory_report(probe=False)  # footprints cached above
+    assert rep["closure_factor"] == MEMORY_CLOSURE_FACTOR
+    assert [r["program"] for r in rep["programs"]] == \
+        [r["program"] for r in rows]
+
+
+def test_memory_section_rides_run_report():
+    pytest.importorskip("jax")
+    from peasoup_tpu.obs.memprof import program_footprints
+    from peasoup_tpu.obs.report import build_run_report
+
+    program_footprints()  # ensure the process cache is warm
+    report = build_run_report()
+    assert "memory" in report
+    assert report["memory"]["programs"]
+
+
+def test_device_memory_stats_helper_cpu_noop():
+    jax = pytest.importorskip("jax")
+    from peasoup_tpu.obs.memprof import (
+        device_memory_stats, hbm_watermark, probed_bytes_per,
+    )
+
+    dev = jax.devices()[0]
+    stats = device_memory_stats(dev)
+    if dev.platform == "cpu":
+        assert stats is None
+        assert hbm_watermark() is None
+        # no probe off-TPU unless forced: capacity planners fall back
+        # to their hand-measured constants without paying a compile
+        assert probed_bytes_per("spectrum") is None
+    else:  # pragma: no cover - accelerator-only
+        assert stats["bytes_in_use"] >= 0
+
+
+def test_probed_bytes_per_forced_slope_and_gauge():
+    pytest.importorskip("jax")
+    from peasoup_tpu.obs.memprof import probed_bytes_per
+
+    slope = probed_bytes_per("row", force=True)
+    assert slope is not None and slope > 0.0
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges.get("hbm.probed_row_bytes") == pytest.approx(slope)
+    with pytest.raises(ValueError):
+        probed_bytes_per("nonsense", force=True)
+
+
+# --------------------------------------------------------------------------
+# worker cold-start decomposition + perf report surfacing
+# --------------------------------------------------------------------------
+
+def test_worker_coldstart_partitions_total(tmp_path):
+    from peasoup_tpu.serve import JobSpool, SurveyWorker
+
+    spool = JobSpool(str(tmp_path / "jobs"))
+    for i in range(2):
+        spool.submit(f"/tmp/obs{i}.fil")
+    worker = SurveyWorker(
+        spool, run_job_fn=lambda job: {"candidates": 0},
+        history_path=str(tmp_path / "h.jsonl"),
+        telemetry_interval_s=0.0, sleeper=lambda s: None)
+    summary = worker.drain()
+    assert summary["succeeded"] == 2
+    cold = summary["coldstart"]
+    total = cold["cold_to_first_candidate_s"]
+    assert total >= 0.0
+    assert (cold["read_s"] + cold["trace_s"] + cold["compile_s"]
+            + cold["execute_s"]) == pytest.approx(total, abs=1e-3)
+    gauges = REGISTRY.snapshot()["gauges"]
+    assert gauges.get("coldstart.cold_to_first_candidate_s") == \
+        pytest.approx(total)
+
+
+def test_coldstart_table_and_gate_metric(tmp_path):
+    from peasoup_tpu.obs.history import append_history, \
+        make_history_record
+    from peasoup_tpu.tools.perf_report import (
+        STAGE_GATE_METRICS, coldstart_table,
+    )
+
+    assert "cold_to_first_candidate_s" in STAGE_GATE_METRICS
+    ledger = str(tmp_path / "history.jsonl")
+    append_history(make_history_record("coldstart", {
+        "cold_to_first_candidate_s": 12.5,
+        "coldstart_read_s": 1.0, "coldstart_trace_s": 2.5,
+        "coldstart_compile_s": 8.0, "coldstart_execute_s": 1.0,
+        "warm_to_first_candidate_s": 1.5, "coldstart_compiles": 7,
+    }), path=ledger)
+    table = coldstart_table(ledger)
+    assert "cold start (1 record(s)" in table
+    assert "12.5" in table and "cold-start trend" in table
+    assert coldstart_table(str(tmp_path / "empty.jsonl")) == ""

@@ -30,6 +30,7 @@ from peasoup_tpu.serve.health import (
     WARN,
     format_findings,
     rule_anomaly,
+    rule_compile_storm,
     rule_device_duty_cycle,
     rule_hbm_watermark,
     rule_lease_reap_burst,
@@ -206,6 +207,55 @@ def test_retry_spike_sums_across_hosts_and_window():
     ]
     (f,) = rule_retry_spike(_ctx(samples))
     assert f.severity == WARN and f.data["retried"] == 3
+
+
+# --------------------------------------------------------------------------
+# rule: compile_storm (ISSUE 18)
+# --------------------------------------------------------------------------
+
+def test_compile_storm_ok_without_samples_or_counters():
+    assert _by_sev(rule_compile_storm(_ctx())) == OK
+    fresh = _ctx([_sample("h0", NOW,
+                          counters={"jit.compiles_attributed": 40})])
+    # cold compiles of NEW geometry are expected work, not a storm
+    (f,) = rule_compile_storm(fresh)
+    assert f.severity == OK
+    assert f.data["compiles_attributed"] == 40
+    assert f.data["recompiles_seen_geometry"] == 0
+
+
+def test_compile_storm_bands():
+    ok = _ctx([_sample("h0", NOW,
+                       counters={"jit.recompiles_seen_geometry": 2})])
+    assert _by_sev(rule_compile_storm(ok)) == OK
+    warn = _ctx([_sample("h0", NOW,
+                         counters={"jit.recompiles_seen_geometry": 3})])
+    (f,) = rule_compile_storm(warn)
+    assert f.severity == WARN
+    crit = _ctx([_sample("h0", NOW,
+                         counters={"jit.recompiles_seen_geometry": 10})])
+    (f,) = rule_compile_storm(crit)
+    assert f.severity == CRIT
+    assert "obs compiles" in f.message
+
+
+def test_compile_storm_sums_hosts_and_ages_out():
+    samples = [
+        _sample("h0", NOW - 10.0,
+                counters={"jit.recompiles_seen_geometry": 2}),
+        _sample("h1", NOW - 5.0,
+                counters={"jit.recompiles_seen_geometry": 1}),
+        # outside the 300s window: a storm that already blew over
+        _sample("h0", NOW - 400.0,
+                counters={"jit.recompiles_seen_geometry": 50}),
+    ]
+    (f,) = rule_compile_storm(_ctx(samples))
+    assert f.severity == WARN
+    assert f.data["recompiles_seen_geometry"] == 3
+
+
+def test_compile_storm_registered_in_rule_set():
+    assert rule_compile_storm in RULES
 
 
 # --------------------------------------------------------------------------

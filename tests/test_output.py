@@ -85,6 +85,16 @@ def test_cli_end_to_end(tutorial_fil, tmp_path):
     assert "tsamp" in ov.section("header_parameters")
     assert "dm_start" in ov.section("search_parameters")
     assert "total" in ov.section("execution_times")
+    # the run must leave a compile ledger, and every backend compile it
+    # ledgered must be attributed to a program + geometry fingerprint
+    # (ISSUE 18 — count may be 0 if this process already compiled the
+    # tutorial geometry, but an anonymous compile is never acceptable)
+    from peasoup_tpu.obs.compilation import read_compiles
+    ledger = os.path.join(outdir, "compiles.jsonl")
+    assert os.path.exists(ledger)
+    for rec in read_compiles(ledger, kinds=("compile",)):
+        assert rec["program"] == "pipeline.search"
+        assert rec["geometry"] and rec["device_kind"]
 
 
 def test_cli_defaults_match_reference():
