@@ -16,8 +16,9 @@ CHAOS_SMOKE_DIR ?= /tmp/peasoup-chaos-smoke
 OBS_SMOKE_DIR ?= /tmp/peasoup-obs-smoke
 ANALYSIS_SMOKE_DIR ?= /tmp/peasoup-analysis-smoke
 COLDSTART_SMOKE_DIR ?= /tmp/peasoup-coldstart-smoke
+LINEAGE_SMOKE_DIR ?= /tmp/peasoup-lineage-smoke
 
-.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke analysis-smoke coldstart-smoke
+.PHONY: lint test bench perf-gate peaks-sweep-smoke trace-smoke serve-smoke fleet-smoke batch-smoke health-smoke pipeline-smoke loadgen-smoke jerk-smoke sensitivity-smoke chaos-smoke obs-smoke analysis-smoke coldstart-smoke lineage-smoke
 
 # covers the whole tree incl. ops/peaks_pallas.py against the
 # committed (near-empty) baseline — new kernels land lint-clean, no
@@ -199,3 +200,15 @@ coldstart-smoke:
 analysis-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.analysis_smoke \
 	    --dir $(ANALYSIS_SMOKE_DIR)
+
+# candidate-provenance smoke test (ISSUE 19): a real drain must leave
+# a lineage ledger whose funnel conserves EXACTLY
+# (decoded == absorbed + cut + emitted), the `why` verb must
+# reconstruct a stored candidate's full decision chain from only its
+# store record, distilled candidates must be bit-identical with
+# lineage on vs --no-lineage, the writer's self-measured overhead
+# must stay <1% of drain wall-clock, and a deliberately widened
+# harmonic tolerance must trip the distill_collapse health rule
+lineage-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m peasoup_tpu.tools.lineage_smoke \
+	    --dir $(LINEAGE_SMOKE_DIR)

@@ -136,6 +136,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable the persistent XLA compilation cache "
                         "(default cache dir: $PEASOUP_XLA_CACHE or "
                         "~/.cache/peasoup_tpu/xla)")
+    p.add_argument("--no_lineage", action="store_true",
+                   help="disable the candidate-provenance ledger "
+                        "(<outdir>/lineage.jsonl records every "
+                        "selection decision for the `why` verb; "
+                        "candidate output is bit-identical either way)")
     p.add_argument("--dump_dir", default="",
                    help="Dump per-DM-trial whitening stages (power "
                         "spectrum, running median, whitened series) as "
@@ -201,7 +206,17 @@ def write_search_output(result, outdir: str) -> dict:
     writer.add_dm_list(result.dm_list)
     writer.add_acc_list(result.acc_list_dm0)
     writer.add_device_info()
-    writer.add_candidates(result.candidates, byte_mapping)
+    prov = getattr(result, "provenance", None)
+    if prov:
+        writer.add_provenance(prov)
+        from .obs.lineage import candidate_uid
+
+        cand_ids = [candidate_uid(prov.get("run", ""), c)
+                    for c in result.candidates]
+    else:
+        cand_ids = None
+    writer.add_candidates(result.candidates, byte_mapping,
+                          cand_ids=cand_ids)
     writer.add_timing_info(result.timers)
     writer.add_telemetry(report)
     writer.to_file(os.path.join(outdir, "overview.xml"))
@@ -248,6 +263,16 @@ def main(argv=None) -> int:
 
     configure_compile_ledger(os.path.join(cfg.outdir, "compiles.jsonl"))
     install_compile_ledger()
+    # candidate provenance ledger (ISSUE 19): every selection decision
+    # between peak decode and the emitted candidate list, keyed by a
+    # run id = the observation basename (`peasoup-tpu obs why` and the
+    # serve `why` verb reconstruct decision chains from it)
+    from .obs import lineage
+
+    cfg.lineage_run = os.path.basename(cfg.infilename)
+    lineage.configure_lineage(
+        "" if args.no_lineage
+        else os.path.join(cfg.outdir, "lineage.jsonl"))
     # per-run span tree: the trace file must describe THIS run, not
     # every run of a long-lived process
     get_tracer().reset()

@@ -33,6 +33,11 @@ counted, logged and reported per run, so this package provides:
   keyed by (run, stage, geometry fingerprint, device kind, host);
 * :mod:`.baseline` — rolling robust (median/MAD) baselines per
   warehouse key, emitting typed ``kind:"anomaly"`` records;
+* :mod:`.lineage` — the candidate provenance ledger (ISSUE 19):
+  stable content-derived candidate ids, one typed mark per selection
+  decision (``lineage.jsonl``), exact funnel accounting with the
+  conservation invariant ``decoded == absorbed + cut + emitted``,
+  and the decision-chain reconstruction behind the ``why`` verb;
 * :mod:`.diff` — span-tree-aligned structural diff of two runs,
   rendered as the generated ``trace_summary_rN.md``;
 * :mod:`.catalog` — the metrics catalog every literal
@@ -62,8 +67,17 @@ from .warehouse import Warehouse, geometry_fingerprint, host_rollup
 from .baseline import (
     baseline_band,
     baseline_table,
+    funnel_anomalies,
     history_anomalies,
     write_anomalies,
+)
+from .lineage import (
+    candidate_uid,
+    check_conservation,
+    configure_lineage,
+    funnel,
+    read_lineage,
+    why_chain,
 )
 from .diff import diff_bench_records, diff_reports, render_markdown
 from .catalog import CATALOG, DYNAMIC_PREFIXES, is_cataloged
@@ -77,8 +91,10 @@ __all__ = [
     "pipeline_costs", "record_run_costs",
     "append_history", "load_history", "make_history_record",
     "Warehouse", "geometry_fingerprint", "host_rollup",
-    "baseline_band", "baseline_table", "history_anomalies",
-    "write_anomalies",
+    "baseline_band", "baseline_table", "funnel_anomalies",
+    "history_anomalies", "write_anomalies",
+    "candidate_uid", "check_conservation", "configure_lineage",
+    "funnel", "read_lineage", "why_chain",
     "diff_bench_records", "diff_reports", "render_markdown",
     "CATALOG", "DYNAMIC_PREFIXES", "is_cataloged",
 ]

@@ -219,6 +219,59 @@ def baseline_table(records, *, window: int = 8,
 
 
 # --------------------------------------------------------------------------
+# serve ledger: selection-funnel rate bands (ISSUE 19)
+# --------------------------------------------------------------------------
+
+#: absolute floor for a funnel-fraction band — rates are in [0, 1]
+#: and jitter a few points drain-to-drain on small candidate counts
+FUNNEL_FLOOR_FRAC_ABS = 0.05
+
+
+def funnel_anomalies(records, *, window: int = 8,
+                     z: float = DEFAULT_Z,
+                     floor_frac: float = DEFAULT_FLOOR_FRAC,
+                     floor_abs: float = FUNNEL_FLOOR_FRAC_ABS,
+                     min_n: int = 3) -> list[dict]:
+    """Judge the NEWEST drain's selection-funnel rates against the
+    trailing drains' (ISSUE 19).  Serve ledger records carry the
+    lineage ledger's exact accounting (``lineage_pass_frac`` =
+    emitted/decoded, ``lineage_absorbed_frac`` = absorbed/decoded);
+    a pass fraction *below* its baseline band means distillation
+    suddenly eats more of the science (a mistuned tolerance), an
+    absorbed fraction *above* band means the harmonic/DM absorbers
+    collapsed the population.  Funnel-free records (no
+    ``lineage_decoded``) are ignored, so a ``--no-lineage`` fleet
+    never trips this.  Pure and deterministic like
+    :func:`history_anomalies`."""
+    recs = [r for r in records
+            if r.get("kind") == "serve"
+            and float((r.get("metrics", {}) or {})
+                      .get("lineage_decoded", 0) or 0) > 0]
+    if len(recs) < int(min_n) + 1:
+        return []
+    head = recs[-1]
+    trail = recs[-1 - int(window):-1]
+    host = str((head.get("config", {}) or {}).get("worker", ""))
+    anomalies: list[dict] = []
+    for name, higher_is_better in (("lineage_pass_frac", True),
+                                   ("lineage_absorbed_frac", False)):
+        series = [float(r["metrics"][name]) for r in trail
+                  if name in r.get("metrics", {})]
+        value = (head.get("metrics", {}) or {}).get(name)
+        if value is None:
+            continue
+        anom = detect_point(
+            float(value), series, ts=head.get("ts"),
+            key={"stage": "distill", "host": host},
+            metric=name, z=z, floor_frac=floor_frac,
+            floor_abs=floor_abs, min_n=min_n,
+            higher_is_better=higher_is_better)
+        if anom is not None:
+            anomalies.append(anom)
+    return anomalies
+
+
+# --------------------------------------------------------------------------
 # compile ledger: per-(program, geometry, device kind) duration bands
 # --------------------------------------------------------------------------
 

@@ -70,6 +70,11 @@ CATALOG: dict[str, str] = {
     "jit.recompiles_seen_geometry": "backend compiles on an "
                                     "already-seen (program, "
                                     "geometry, device) key",
+    # -- lineage (candidate provenance) -------------------------------------
+    "lineage.mark_errors": "lineage decision marks that failed to "
+                           "write",
+    "lineage.marks": "candidate selection-decision marks written to "
+                     "lineage.jsonl",
     # -- peaks / runs -------------------------------------------------------
     "peaks.compact_pallas": "pallas threshold-compaction dispatches",
     "runs.fused_fold_dispatches": "batched fold program dispatches",

@@ -4,7 +4,8 @@
 the flight recorder:
 
 * ``obs ingest``  — flatten artifacts (run reports, the history
-  ledger, telemetry shards, timelines) into a warehouse directory;
+  ledger, telemetry shards, timelines, compile and lineage ledgers)
+  into a warehouse directory;
 * ``obs query``   — filtered rows (run/stage/host/metric/source);
 * ``obs top``     — largest-valued rows for a metric prefix;
 * ``obs tail``    — most recent rows;
@@ -89,6 +90,9 @@ def cmd_ingest(args) -> int:
     if args.compiles:
         total += wh.ingest_compiles(args.compiles,
                                     run=args.run or "")
+    if args.lineage:
+        total += wh.ingest_lineage(args.lineage,
+                                   run=args.run or None)
     print(f"ingested {total} row(s) into {args.dir}")
     return 0
 
@@ -162,7 +166,11 @@ def cmd_diff(args) -> int:
 
 
 def cmd_baseline(args) -> int:
-    from .baseline import baseline_table, history_anomalies
+    from .baseline import (
+        baseline_table,
+        funnel_anomalies,
+        history_anomalies,
+    )
     from .history import load_history
 
     records = load_history(args.ledger, kinds=("bench",))
@@ -170,6 +178,10 @@ def cmd_baseline(args) -> int:
     anomalies = history_anomalies(records, window=args.window,
                                   z=args.z,
                                   floor_frac=args.floor_frac)
+    # selection-funnel rate bands over the serve drains (ISSUE 19)
+    anomalies += funnel_anomalies(
+        load_history(args.ledger, kinds=("serve",)),
+        window=args.window, z=args.z, floor_frac=args.floor_frac)
     if args.json:
         json.dump({"baselines": table, "anomalies": anomalies},
                   sys.stdout, indent=1, sort_keys=True)
@@ -189,12 +201,13 @@ def cmd_baseline(args) -> int:
                   f"{args.ledger!r}")
         for anom in anomalies:
             key = anom["key"]
+            unit = "s" if anom["metric"] == "stage_device_s" else ""
             print(f"ANOMALY {key['stage']} "
                   f"[{key['device_kind'] or '-'}/"
-                  f"{key['geometry'] or '-'}]: "
-                  f"{anom['value']:.4f}s vs median "
-                  f"{anom['median']:.4f}s +/- {anom['band']:.4f}s "
-                  f"({anom['severity']})")
+                  f"{key['geometry'] or '-'}] {anom['metric']}: "
+                  f"{anom['value']:.4f}{unit} vs median "
+                  f"{anom['median']:.4f}{unit} +/- "
+                  f"{anom['band']:.4f}{unit} ({anom['severity']})")
     if anomalies and args.write_ledger:
         from .baseline import write_anomalies
 
@@ -313,6 +326,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="timeline.jsonl (or its workdir) to ingest")
     sp.add_argument("--compiles", default=None,
                     help="compiles.jsonl compile ledger to ingest")
+    sp.add_argument("--lineage", default=None,
+                    help="lineage.jsonl candidate-provenance ledger "
+                         "to ingest (per-mark counts + per-run "
+                         "funnel rates)")
     sp.add_argument("--run", default=None,
                     help="run id to stamp on ingested report rows")
     sp.set_defaults(fn=cmd_ingest)

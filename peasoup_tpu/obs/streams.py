@@ -163,6 +163,35 @@ STREAMS: dict[str, dict] = {
         ),
         "doc": "flattened warehouse/segment.jsonl rows",
     },
+    "lineage": {
+        "version": 1,
+        "version_key": "v",
+        "version_const": ("peasoup_tpu/obs/lineage.py",
+                          "LINEAGE_VERSION"),
+        "required": ("v", "ts", "run", "kind"),
+        # per-kind payload fields (mark(**fields) merge; the known
+        # ones): id/ids for candidate marks, n for aggregates,
+        # absorber/rule/margin for absorptions, trial coordinates and
+        # rank for terminal marks, scorer flags for annotations
+        "optional": ("id", "ids", "n", "stage", "rule", "absorber",
+                     "margin", "dm_idx", "acc", "jerk", "nh", "freq",
+                     "snr", "rank", "flags", "host"),
+        "writers": (
+            ("peasoup_tpu/obs/lineage.py", "LineageRecorder.mark",
+             "rec"),
+        ),
+        "readers": (
+            ("peasoup_tpu/obs/lineage.py", "read_lineage", "m"),
+            ("peasoup_tpu/obs/lineage.py", "_mark_ids", "m"),
+            ("peasoup_tpu/obs/lineage.py", "funnel", "m"),
+            ("peasoup_tpu/obs/lineage.py", "check_conservation", "m"),
+            ("peasoup_tpu/obs/lineage.py", "why_chain", "m"),
+            ("peasoup_tpu/obs/warehouse.py", "lineage_rows", "m"),
+            ("peasoup_tpu/serve/cli.py", "_render_why_mark", "m"),
+        ),
+        "doc": "per-candidate selection-decision marks "
+               "(lineage.jsonl)",
+    },
     "run_report": {
         "version": 2,
         "version_key": "schema_version",

@@ -376,6 +376,33 @@ class Warehouse:
             rows.extend(compile_rows(rec, run=run, clock=self._clock))
         return self.append_rows(rows)
 
+    # -- ingest: lineage ledger --------------------------------------------
+
+    def ingest_lineage(self, path: str, *,
+                       run: str | None = None) -> int:
+        """Flatten a ``lineage.jsonl`` ledger (obs/lineage.py, ISSUE
+        19) into rows: one ``lineage.<kind>`` count row per mark, plus
+        per-run selection-funnel rates (``lineage.pass_frac``,
+        ``lineage.absorbed_frac``, ``lineage.decoded``) computed by
+        the ledger's own :func:`~peasoup_tpu.obs.lineage.funnel` — the
+        series :mod:`.baseline` bands so a distillation behaviour
+        shift surfaces as a ``kind:"anomaly"`` record."""
+        from . import lineage
+
+        marks = lineage.read_lineage(path, run=run)
+        rows = lineage_rows(marks, clock=self._clock)
+        for rid in sorted({r["run"] for r in rows if r["run"]}):
+            fn = lineage.funnel(marks, runs=[rid])
+            if not fn["decoded"]:
+                continue
+            common = dict(
+                ts=max(r["ts"] for r in rows if r["run"] == rid),
+                run=rid, source="lineage", stage="funnel")
+            for name in ("pass_frac", "absorbed_frac", "decoded"):
+                rows.append(make_row(metric=f"lineage.{name}",
+                                     value=float(fn[name]), **common))
+        return self.append_rows(rows)
+
     # -- ingest: timelines -------------------------------------------------
 
     def ingest_timeline(self, path_or_workdir: str, *,
@@ -536,6 +563,30 @@ def compile_rows(rec: dict, *, run: str = "",
             ts=float(ts), run=run, host=host, source="compiles",
             metric="profile.capture", value=1.0,
             data={"path": str(rec.get("path") or "")}))
+    return rows
+
+
+def lineage_rows(marks, *, clock=time.time) -> list[dict]:
+    """Rows for lineage-ledger marks (obs/lineage.py, ISSUE 19) — a
+    declared reader of the ``lineage`` stream (PSL013): one
+    ``lineage.<kind>`` row per mark, valued at the number of
+    candidates the mark covers (``n`` for aggregates, the id list's
+    length, else 1 for single-candidate marks)."""
+    rows: list[dict] = []
+    for m in marks:
+        ts = m.get("ts")
+        if ts is None:
+            ts = clock()
+        n = m.get("n")
+        if n is None:
+            ids = m.get("ids")
+            n = len(ids) if isinstance(ids, list) else 1
+        rows.append(make_row(
+            ts=float(ts), run=str(m.get("run", "") or ""),
+            source="lineage", stage=str(m.get("stage", "") or ""),
+            host=str(m.get("host", "") or ""),
+            metric="lineage." + str(m.get("kind", "mark")),
+            value=float(n)))
     return rows
 
 

@@ -147,11 +147,30 @@ class OutputFileWriter:
             d.append(XMLElement("name", str(dev.device_kind)))
             d.append(XMLElement("platform", str(dev.platform)))
 
-    def add_candidates(self, candidates, byte_mapping) -> None:
+    def add_provenance(self, prov: dict) -> None:
+        """``<provenance>`` block (obs/lineage.py, ISSUE 19): the
+        producing run's identity — run id, git sha, geometry
+        fingerprint, trial lattice (requested and actual), host — so
+        any candidate in this file can be traced back through the
+        lineage ledger with the ``why`` verb."""
+        if not prov:
+            return
+        el = self.root.append(XMLElement("provenance"))
+        for key in ("run", "git_sha", "geometry", "lattice",
+                    "lattice_requested", "host"):
+            if prov.get(key) is not None:
+                el.append(XMLElement(key, prov[key]))
+
+    def add_candidates(self, candidates, byte_mapping,
+                       cand_ids=None) -> None:
         el = self.root.append(XMLElement("candidates"))
         for ii, c in enumerate(candidates):
             cand = el.append(XMLElement("candidate"))
             cand.add_attribute("id", ii)
+            if cand_ids is not None:
+                # lineage join key (ISSUE 19): the content-derived id
+                # the `why` verb resolves, distinct from the ordinal
+                cand.append(XMLElement("candidate_id", cand_ids[ii]))
             cand.append(XMLElement("period", 1.0 / c.freq))
             cand.append(XMLElement("opt_period", c.opt_period))
             cand.append(XMLElement("dm", c.dm))

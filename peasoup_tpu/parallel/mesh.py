@@ -36,6 +36,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import ConfigError, HBMBudgetError
+from ..obs import lineage
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
 from ..obs.trace import span, span_cursor
@@ -2015,6 +2016,16 @@ class MeshPulsarSearch(PulsarSearch):
                 ii = int(rows[key])
                 if ii < ndm:
                     all_clipped[ii] = int(counts_l[key].max())
+                    if lineage.enabled():
+                        # the clipped row's partial decode is discarded
+                        # here; the post-loop escalated re-search emits
+                        # fresh ``decoded`` marks for the row
+                        grp = groups_l.get(key)
+                        if grp is not None and len(grp[0]):
+                            lineage.mark(
+                                "superseded", run=self._lineage_run(),
+                                n=int(len(grp[0])),
+                                stage="clip_rerun", dm_idx=ii)
             # (overlapping the escalated re-search compiles with the
             # remaining chunks via a background warm thread was tried
             # and REVERTED: the warm executable's arena co-resides with
@@ -2553,11 +2564,33 @@ class MeshPulsarSearch(PulsarSearch):
             )
             if nxt is None:
                 break
+            if lineage.enabled():
+                # the escalated re-dispatch discards this pass's decode
+                # wholesale — its peaks never received candidate ids,
+                # so the ledger carries an AGGREGATE count only (the
+                # re-run emits fresh ``decoded`` marks)
+                n_disc = sum(
+                    len(g[0]) for ii, g in per_dm_groups.items()
+                    if ii < ndm)
+                if n_disc:
+                    lineage.mark("superseded", run=self._lineage_run(),
+                                 n=n_disc, stage="redispatch")
             cap, compact_k = nxt
         rerun = self._rerun_clipped_rows(
             clipped, counts_arr,
             lambda rows: (trials, {ii: ii for ii in rows}),
         )
+        if rerun and lineage.enabled():
+            # clipped rows' partial decodes are discarded in favour of
+            # the escalated host re-search (which emits its own
+            # ``decoded`` marks via process_dm_peaks)
+            lrun = self._lineage_run()
+            for ii in sorted(rerun):
+                grp = per_dm_groups.get(ii)
+                if grp is not None and len(grp[0]):
+                    lineage.mark("superseded", run=lrun,
+                                 n=int(len(grp[0])),
+                                 stage="clip_rerun", dm_idx=int(ii))
         if cfg.dump_dir:
             # debug buffer dumps work here because the fused path keeps
             # every dedispersed trial HBM-resident (the chunked driver
@@ -2828,6 +2861,19 @@ class MeshPulsarSearch(PulsarSearch):
             )
             if nxt is None:
                 break
+            if lineage.enabled():
+                # escalated re-dispatch discards every live beam's
+                # decode; aggregate supersession per beam, attributed
+                # to that beam's run id (see run()'s fused-path note)
+                for b in decoded:
+                    n_disc = sum(
+                        len(g[0])
+                        for ii, g in decoded[b][0].items() if ii < ndm)
+                    if n_disc:
+                        lineage.mark(
+                            "superseded",
+                            run=getattr(configs[b], "lineage_run", ""),
+                            n=n_disc, stage="redispatch")
             cap, compact_k = nxt
         # per-beam clipped-row re-searches on that beam's trials
         reruns: dict[int, dict] = {}
@@ -2835,11 +2881,27 @@ class MeshPulsarSearch(PulsarSearch):
             try:
                 _g, _mc, _mv, counts_b, clipped_b, _t = decoded[b]
                 trials_b = trials[b]
-                reruns[b] = self._rerun_clipped_rows(
-                    clipped_b, counts_b,
-                    lambda rows, _t=trials_b: (
-                        _t, {ii: ii for ii in rows}),
-                )
+                # host-path re-search marks (decoded/absorbed) must
+                # carry THIS beam's run id, not the driver config's
+                self._lineage_run_override = getattr(
+                    configs[b], "lineage_run", "")
+                try:
+                    reruns[b] = self._rerun_clipped_rows(
+                        clipped_b, counts_b,
+                        lambda rows, _t=trials_b: (
+                            _t, {ii: ii for ii in rows}),
+                    )
+                finally:
+                    self._lineage_run_override = ""
+                if reruns[b] and lineage.enabled():
+                    lrun_b = getattr(configs[b], "lineage_run", "")
+                    for ii in sorted(reruns[b]):
+                        grp = decoded[b][0].get(ii)
+                        if grp is not None and len(grp[0]):
+                            lineage.mark(
+                                "superseded", run=lrun_b,
+                                n=int(len(grp[0])),
+                                stage="clip_rerun", dm_idx=int(ii))
             except Exception as exc:
                 beam_fail[b] = exc
                 decoded.pop(b)
@@ -2879,6 +2941,8 @@ class MeshPulsarSearch(PulsarSearch):
                  for b in decoded for ii in range(ndm)
                  if ii not in reruns[b]),
                 dm_of=lambda k: k[1],
+                run_of=lambda k: getattr(
+                    configs[k[0]], "lineage_run", ""),
             )
         timers["searching"] = time.time() - t0
         # fan results back out per beam; a beam that fails here keeps

@@ -48,9 +48,48 @@ def _native_lib():
     return lib
 
 
+# -- tolerance-margin helpers (candidate lineage, ISSUE 19) ----------------
+# How far inside its distiller's acceptance window an absorbed
+# candidate sat (>= 0; ~0 means a borderline absorption a slightly
+# tighter tolerance would have kept).  Shared by the per-object
+# ``pair_margin`` methods below and the mesh driver's segmented batch
+# path, so both report identical margins for identical pairs.
+
+def harmonic_margin(f_fund: float, f_abs: float, max_denom: int,
+                    tol: float, max_harm: int) -> float:
+    """tol minus the closest |k*f_abs/(j*f_fund) - 1| over the (j, k)
+    ratio grid the harmonic predicate searched."""
+    jj = np.arange(1, max(int(max_harm), 1) + 1, dtype=np.float64)
+    kk = np.arange(1, max(int(max_denom), 1) + 1, dtype=np.float64)
+    ratio = kk[:, None] * float(f_abs) / (jj[None, :] * float(f_fund))
+    return float(tol - np.abs(ratio - 1.0).min())
+
+
+def drift_margin(f_fund: float, f_abs: float, drift: float,
+                 tol: float) -> float:
+    """Distance of ``f_abs`` from the nearer edge of the drift window
+    [min(f, f+drift*f) - tol*f, max(f, f+drift*f) + tol*f], as a
+    fraction of ``f_fund`` (the accel/jerk window shape)."""
+    f0 = float(f_fund)
+    shifted = f0 + float(drift) * f0
+    edge = f0 * float(tol)
+    lo = min(shifted, f0) - edge
+    hi = max(shifted, f0) + edge
+    return float(min(float(f_abs) - lo, hi - float(f_abs)) / f0)
+
+
+def dm_margin(f_fund: float, f_abs: float, tol: float) -> float:
+    """tol minus |f_abs/f_fund - 1| (the DM distiller's freq-ratio
+    window)."""
+    return float(tol - abs(float(f_abs) / float(f_fund) - 1.0))
+
+
 class BaseDistiller:
     #: native predicate id for distill_greedy, or None (numpy path only)
     native_type: int | None = None
+
+    #: lineage rule name stamped on absorption decisions (ISSUE 19)
+    rule = "distill"
 
     def __init__(self, keep_related: bool):
         self.keep_related = keep_related
@@ -59,6 +98,13 @@ class BaseDistiller:
         """Bool array over candidates idx+1.. that this fundamental
         absorbs."""
         raise NotImplementedError
+
+    def pair_margin(self, fi: int, ai: int) -> float:
+        """Tolerance margin of the (fundamental ``fi``, absorbed
+        ``ai``) pair — how far inside the acceptance window the
+        absorption sat.  Valid after :meth:`setup`; indices are into
+        the SNR-sorted candidate order."""
+        return 0.0
 
     def match_counts(self, idx: int) -> np.ndarray:
         """Int array over candidates idx+1..: how many times each is
@@ -74,7 +120,16 @@ class BaseDistiller:
         """(aux_array, max_harm, tobs_over_c) for distill_greedy."""
         raise NotImplementedError
 
-    def distill(self, cands: list[Candidate]) -> list[Candidate]:
+    def distill(self, cands: list[Candidate],
+                on_decision=None) -> list[Candidate]:
+        """Greedy SNR-sorted dedup; survivors in sorted order.
+
+        ``on_decision(fundamental, absorbed, margin)`` — the lineage
+        callback (ISSUE 19) — fires once per absorbed candidate, for
+        its FIRST (highest-SNR) absorber, with the pair's tolerance
+        margin.  Purely observational: candidate output (uniqueness
+        AND assoc lists) is bit-identical with or without it.
+        """
         size = len(cands)
         # std::sort with snr-greater comparator; stable for determinism
         cands = sorted(cands, key=lambda c: -c.snr)
@@ -82,13 +137,24 @@ class BaseDistiller:
         native = _native_lib() if self.native_type is not None else None
         if native is not None:
             aux, max_harm, tobs_over_c = self.native_args()
+            # pair recording only feeds assoc/lineage; uniqueness is
+            # independent of the flag (native/distill.c), so asking
+            # for pairs never changes the survivors
+            record = self.keep_related or on_decision is not None
             unique, pf, pa = native.distill_greedy(
                 self.native_type, self.freqs, aux, self.tolerance,
-                max_harm, tobs_over_c, self.keep_related,
+                max_harm, tobs_over_c, record,
             )
             if self.keep_related:
                 for fi, ai in zip(pf, pa):
                     cands[fi].append(cands[ai])
+            if on_decision is not None:
+                seen: set[int] = set()  # pairs are in walk order:
+                for fi, ai in zip(pf, pa):  # first absorber wins
+                    if ai not in seen:
+                        seen.add(ai)
+                        on_decision(cands[fi], cands[ai],
+                                    self.pair_margin(int(fi), int(ai)))
             return [cands[i] for i in range(size) if unique[i]]
         unique = np.ones(size, dtype=bool)
         for idx in range(size):
@@ -100,12 +166,18 @@ class BaseDistiller:
                 for ii in hit:
                     for _ in range(int(counts[ii - idx - 1])):
                         cands[idx].append(cands[ii])
+            if on_decision is not None:
+                for ii in hit:
+                    if unique[ii]:  # first absorber wins
+                        on_decision(cands[idx], cands[ii],
+                                    self.pair_margin(int(idx), int(ii)))
             unique[hit] = False
         return [cands[i] for i in range(size) if unique[i]]
 
 
 class HarmonicDistiller(BaseDistiller):
     native_type = 0
+    rule = "harmonic"
 
     def __init__(self, tol: float, max_harm: int, keep_related: bool,
                  fractional_harms: bool = True):
@@ -150,9 +222,15 @@ class HarmonicDistiller(BaseDistiller):
         # one absorption per matching (j,k), like distiller.hpp:91-100
         return self._ok_grid(idx).sum(axis=(1, 2))
 
+    def pair_margin(self, fi, ai):
+        return harmonic_margin(self.freqs[fi], self.freqs[ai],
+                               int(self.max_denoms[ai]),
+                               self.tolerance, self.max_harm)
+
 
 class AccelerationDistiller(BaseDistiller):
     native_type = 1
+    rule = "accel"
 
     def __init__(self, tobs: float, tolerance: float, keep_related: bool):
         super().__init__(keep_related)
@@ -177,6 +255,11 @@ class AccelerationDistiller(BaseDistiller):
         hi = np.maximum(acc_freq, fundi_freq) + edge
         return (freqs > lo) & (freqs < hi)
 
+    def pair_margin(self, fi, ai):
+        drift = (self.accs[fi] - self.accs[ai]) * self.tobs_over_c
+        return drift_margin(self.freqs[fi], self.freqs[ai], drift,
+                            self.tolerance)
+
 
 class JerkDistiller(BaseDistiller):
     """Jerk-adjacent de-dup (ISSUE 13): the jerk-axis analogue of
@@ -190,6 +273,7 @@ class JerkDistiller(BaseDistiller):
     (no native predicate id; jerk grids are small)."""
 
     native_type = None
+    rule = "jerk"
 
     def __init__(self, tobs: float, tolerance: float, keep_related: bool):
         super().__init__(keep_related)
@@ -212,9 +296,15 @@ class JerkDistiller(BaseDistiller):
         hi = np.maximum(jerk_freq, fundi_freq) + edge
         return (freqs > lo) & (freqs < hi)
 
+    def pair_margin(self, fi, ai):
+        drift = (self.jerks[fi] - self.jerks[ai]) * self.tobs2_over_6c
+        return drift_margin(self.freqs[fi], self.freqs[ai], drift,
+                            self.tolerance)
+
 
 class DMDistiller(BaseDistiller):
     native_type = 2
+    rule = "dm"
 
     def __init__(self, tolerance: float, keep_related: bool):
         super().__init__(keep_related)
@@ -226,3 +316,7 @@ class DMDistiller(BaseDistiller):
     def matches(self, idx):
         ratio = self.freqs[idx + 1 :] / self.freqs[idx]
         return (ratio > 1 - self.tolerance) & (ratio < 1 + self.tolerance)
+
+    def pair_margin(self, fi, ai):
+        return dm_margin(self.freqs[fi], self.freqs[ai],
+                         self.tolerance)

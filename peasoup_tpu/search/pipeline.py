@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from ..data.candidates import Candidate, CandidateCollection
 from ..errors import ConfigError
 from ..io.sigproc import Filterbank
+from ..obs import lineage
 from ..obs.events import warn_event
 from ..obs.metrics import REGISTRY as METRICS
 from ..obs.trace import device_seconds, span, span_cursor
@@ -284,6 +285,11 @@ class SearchResult:
     # per-stage SNR budget of the injected signal when the config named
     # an injection manifest (obs/injection.py, ISSUE 14); None otherwise
     injection: dict | None = None
+    # provenance block (obs/lineage.py, ISSUE 19): run id, git sha,
+    # geometry fingerprint, resolved trial lattice, host — stamped
+    # into store records and overview.xml so a candidate's origin is
+    # reconstructible from either artifact alone
+    provenance: dict | None = None
 
 
 class PulsarSearch:
@@ -653,6 +659,43 @@ class PulsarSearch:
             capacity=cap, jerk_list=trial_jerks,
         )
 
+    # -- candidate lineage hooks (obs/lineage.py, ISSUE 19) ----------------
+
+    def _lineage_run(self) -> str:
+        """Run id stamped on this driver's lineage marks.  The batched
+        mesh path temporarily overrides it per beam (each beam is its
+        own run) around per-beam host re-searches."""
+        return (getattr(self, "_lineage_run_override", "")
+                or getattr(self.config, "lineage_run", ""))
+
+    def _absorb_cb(self, still, lrun, stage=None):
+        """``on_decision`` callback recording one distiller pass's
+        absorptions as terminal lineage marks, or None when lineage is
+        off (the distillers then skip pair bookkeeping entirely)."""
+        if not lineage.enabled():
+            return None
+        rule = still.rule
+
+        def cb(fund, absorbed, margin):
+            lineage.mark(
+                "absorbed", run=lrun,
+                id=lineage.candidate_uid(lrun, absorbed),
+                absorber=lineage.candidate_uid(lrun, fund),
+                rule=rule, stage=stage,
+                margin=round(float(margin), 9),
+                snr=float(absorbed.snr), freq=float(absorbed.freq),
+            )
+        return cb
+
+    def _mark_decoded(self, lrun, dm_idx, cands, stage) -> None:
+        """One ``decoded`` mark: this DM row's merged peaks entered
+        the id'd funnel population."""
+        if not lineage.enabled():
+            return
+        ids = [lineage.candidate_uid(lrun, c) for c in cands]
+        lineage.mark("decoded", run=lrun, ids=ids, n=len(ids),
+                     stage=stage, dm_idx=int(dm_idx))
+
     def process_dm_peaks(self, dm, dm_idx, acc_list, idxs, snrs, counts,
                          capacity=None, jerk_list=None):
         """Turn per-(trial, spectrum) peak buffers into distilled
@@ -666,9 +709,40 @@ class PulsarSearch:
             )
             for j, acc in enumerate(acc_list)
         ]
+        if lineage.enabled():
+            lrun = self._lineage_run()
+            # pre-decode loss accounting (aggregates by design: these
+            # peaks never got ids).  clipped = beyond capacity,
+            # dropped = under-delivery sentinels, merged = duplicate
+            # spectrum bins collapsed by identify_unique_peaks
+            cap = capacity or self.config.peak_capacity
+            n_take = n_drop = 0
+            n_clip = 0
+            for j in range(len(acc_list)):
+                for level in range(len(self.bounds)):
+                    cnt = int(counts[j][level])
+                    take = min(cnt, cap)
+                    n_clip += max(cnt - cap, 0)
+                    bi = np.asarray(idxs[j][level][:take])
+                    n_take += take
+                    n_drop += int((bi < 0).sum())
+            n_dec = sum(len(g) for g in groups)
+            if n_clip:
+                lineage.mark("clipped", run=lrun, n=n_clip,
+                             stage="host", dm_idx=int(dm_idx))
+            if n_drop:
+                lineage.mark("dropped", run=lrun, n=n_drop,
+                             stage="host", dm_idx=int(dm_idx))
+            n_merge = n_take - n_drop - n_dec
+            if n_merge:
+                lineage.mark("merged", run=lrun, n=n_merge,
+                             stage="host", dm_idx=int(dm_idx))
+            self._mark_decoded(
+                lrun, dm_idx, [c for g in groups for c in g], "host")
         return self._distill_accel_groups(groups)
 
-    def _distill_dm_row(self, ii, group, acc_list, jerk_list=None):
+    def _distill_dm_row(self, ii, group, acc_list, jerk_list=None,
+                        lrun=None):
         """Build + distill one DM trial's candidates from its decoded
         peak group (None -> no peaks); the per-row fallback behind
         :meth:`_distill_rows_batch`."""
@@ -686,9 +760,13 @@ class PulsarSearch:
                           nh=int(nh), snr=float(sn), freq=float(fq))
                 for fq, sn, nh in zip(efreq[m], esnr[m], elvl[m])
             ])
-        return self._distill_accel_groups(groups)
+        if lrun is None:
+            lrun = self._lineage_run()
+        self._mark_decoded(lrun, ii, [c for g in groups for c in g],
+                           "mesh")
+        return self._distill_accel_groups(groups, lrun=lrun)
 
-    def _distill_rows_batch(self, rows, dm_of=None) -> dict:
+    def _distill_rows_batch(self, rows, dm_of=None, run_of=None) -> dict:
         """Vectorised per-DM distillation tail for many DM rows at once.
 
         ``rows``: iterable of ``(key, group_or_None, acc_list)`` with
@@ -717,6 +795,8 @@ class PulsarSearch:
                 for r in rows]
         if dm_of is None:
             dm_of = lambda k: k
+        if run_of is None:
+            run_of = lambda k: self._lineage_run()
         jp = getattr(self, "jerk_plan", None)
         # the native segmented distiller has no jerk predicate: any
         # jerk-axis search takes the per-row Python path (which chains
@@ -724,13 +804,16 @@ class PulsarSearch:
         jerk_free = jp is None or (jp.njerk == 1 and jp.max_abs == 0.0)
         if _native is None or not jerk_free:
             return {
-                ii: self._distill_dm_row(dm_of(ii), grp, acc_list, jerks)
+                ii: self._distill_dm_row(dm_of(ii), grp, acc_list,
+                                         jerks, lrun=run_of(ii))
                 for ii, grp, acc_list, jerks in rows
             }
+        want_lineage = lineage.enabled()
         out: dict = {}
         # ---- stage A: harmonic distill per (dm, accel) segment -------
         fa, sa, nha, acca = [], [], [], []
         bounds_a = [0]
+        seg_rows: list[int] = []  # accel segment -> row ordinal
         row_meta = []  # (dm_idx, n_accel_trials)
         for ii, grp, acc_list, _jerks in rows:
             if grp is None:
@@ -747,6 +830,7 @@ class PulsarSearch:
                 nha.append(np.asarray(elvl[m], np.int64)[order])
                 acca.append(np.full(int(m.sum()), float(acc)))
                 bounds_a.append(bounds_a[-1] + int(m.sum()))
+                seg_rows.append(len(row_meta))
             row_meta.append((ii, len(acc_list)))
         if not fa:
             return out
@@ -754,10 +838,50 @@ class PulsarSearch:
         sa = np.concatenate(sa)
         nha = np.concatenate(nha)
         acca = np.concatenate(acca)
-        uniq_a, _, _ = _native.distill_greedy_segmented(
+        row_keys = [ii for ii, _na in row_meta]
+        if want_lineage:
+            # element -> row ordinal, for run/dm attribution of marks
+            rowa = np.repeat(np.asarray(seg_rows, np.int64),
+                             np.diff(bounds_a))
+            for r, key in enumerate(row_keys):
+                sel = np.nonzero(rowa == r)[0]
+                lr = run_of(key)
+                dmi = int(dm_of(key))
+                ids = [lineage.uid_from_fields(
+                    lr, dmi, acca[k], 0.0, nha[k], fa[k])
+                    for k in sel]
+                lineage.mark("decoded", run=lr, ids=ids, n=len(ids),
+                             stage="batch", dm_idx=dmi)
+        # pair recording feeds only lineage here (stage-A survivors
+        # carry no assoc); uniqueness is independent of the flag, so
+        # candidates stay bit-identical with lineage on or off
+        uniq_a, pfa, paa = _native.distill_greedy_segmented(
             0, fa, (2.0 ** nha).astype(np.float64), bounds_a,
-            cfg.freq_tol, cfg.max_harm, 0.0, False,
+            cfg.freq_tol, cfg.max_harm, 0.0, want_lineage,
         )
+        if want_lineage:
+            from .distill import harmonic_margin
+
+            seen_a: set[int] = set()  # pairs are in walk order:
+            for fi, ai in zip(pfa, paa):  # first absorber wins
+                if ai in seen_a:
+                    continue
+                seen_a.add(ai)
+                key = row_keys[int(rowa[ai])]
+                lr = run_of(key)
+                dmi = int(dm_of(key))
+                lineage.mark(
+                    "absorbed", run=lr,
+                    id=lineage.uid_from_fields(
+                        lr, dmi, acca[ai], 0.0, nha[ai], fa[ai]),
+                    absorber=lineage.uid_from_fields(
+                        lr, dmi, acca[fi], 0.0, nha[fi], fa[fi]),
+                    rule="harmonic", stage="dm_row",
+                    margin=round(harmonic_margin(
+                        fa[fi], fa[ai], int(2.0 ** nha[ai]),
+                        cfg.freq_tol, cfg.max_harm), 9),
+                    snr=float(sa[ai]), freq=float(fa[ai]),
+                )
         # ---- stage B: acceleration distill per DM segment ------------
         fb, sb, nhb, accb = [], [], [], []
         bounds_b = [0]
@@ -787,6 +911,32 @@ class PulsarSearch:
         # ---- materialise Candidate objects (assoc via pair list) -----
         dmib = np.repeat([dm_of(ii) for ii, _na in row_meta],
                          np.diff(bounds_b))
+        if want_lineage:
+            from .distill import drift_margin
+
+            tobs_over_c = self.tobs / SPEED_OF_LIGHT
+            rowb = np.repeat(np.arange(len(row_meta), dtype=np.int64),
+                             np.diff(bounds_b))
+            seen_b: set[int] = set()
+            for fi, ai in zip(pf, pa_):
+                if ai in seen_b:
+                    continue
+                seen_b.add(ai)
+                lr = run_of(row_keys[int(rowb[ai])])
+                dmi = int(dmib[ai])
+                lineage.mark(
+                    "absorbed", run=lr,
+                    id=lineage.uid_from_fields(
+                        lr, dmi, accb[ai], 0.0, nhb[ai], fb[ai]),
+                    absorber=lineage.uid_from_fields(
+                        lr, dmi, accb[fi], 0.0, nhb[fi], fb[fi]),
+                    rule="accel", stage="dm_row",
+                    margin=round(drift_margin(
+                        fb[fi], fb[ai],
+                        (accb[fi] - accb[ai]) * tobs_over_c,
+                        cfg.freq_tol), 9),
+                    snr=float(sb[ai]), freq=float(fb[ai]),
+                )
         objs = [
             Candidate(dm=float(self.dm_list[dmib[k]]),
                       dm_idx=int(dmib[k]), acc=float(accb[k]),
@@ -802,25 +952,34 @@ class PulsarSearch:
         return out
 
     def _distill_accel_groups(
-        self, groups: list[list[Candidate]]
+        self, groups: list[list[Candidate]], lrun=None
     ) -> list[Candidate]:
         """Per-DM distillation tail shared by the host-loop and mesh
         paths: harmonic distillation within each accel trial
         (`pipeline_multi.cu:238`), acceleration distillation across
         them (`pipeline_multi.cu:243`)."""
         cfg = self.config
+        if lrun is None:
+            lrun = self._lineage_run()
         harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, False)
+        cb_h = self._absorb_cb(harm_still, lrun, stage="dm_row")
         accel_trial_cands: list[Candidate] = []
         for cands in groups:
-            accel_trial_cands.extend(harm_still.distill(cands))
+            accel_trial_cands.extend(
+                harm_still.distill(cands, on_decision=cb_h))
         acc_still = AccelerationDistiller(self.tobs, cfg.freq_tol, True)
-        out = acc_still.distill(accel_trial_cands)
+        out = acc_still.distill(
+            accel_trial_cands,
+            on_decision=self._absorb_cb(acc_still, lrun,
+                                        stage="dm_row"))
         jp = getattr(self, "jerk_plan", None)
         if jp is not None and jp.njerk > 1:
             # jerk-adjacent de-dup (ISSUE 13), only when the axis is
             # real — accel-only runs keep the exact pre-jerk chain
             jerk_still = JerkDistiller(self.tobs, cfg.freq_tol, True)
-            out = jerk_still.distill(out)
+            out = jerk_still.distill(
+                out, on_decision=self._absorb_cb(jerk_still, lrun,
+                                                 stage="dm_row"))
         return out
 
     def _peaks_to_candidates(self, idxs, snrs, counts, dm, dm_idx, acc,
@@ -1063,23 +1222,38 @@ class PulsarSearch:
         SearchResult routes outputs to that beam's outdir.
         """
         cfg = self.config if config is None else config
+        lrun = (getattr(cfg, "lineage_run", "")
+                or self._lineage_run())
         with span("Distill", metric="distillation",
                   n_candidates=len(dm_cands.cands)):
             dm_still = DMDistiller(cfg.freq_tol, True)
             harm_still = HarmonicDistiller(cfg.freq_tol, cfg.max_harm, True,
                                            False)
-            cands = dm_still.distill(dm_cands.cands)
-            cands = harm_still.distill(cands)
+            cands = dm_still.distill(
+                dm_cands.cands,
+                on_decision=self._absorb_cb(dm_still, lrun,
+                                            stage="cross_dm"))
+            cands = harm_still.distill(
+                cands,
+                on_decision=self._absorb_cb(harm_still, lrun,
+                                            stage="cross_dm"))
 
         hdr = self.fil.header
         scorer = CandidateScorer(
             hdr.tsamp, hdr.cfreq, hdr.foff, abs(hdr.foff) * self.fil.nchans
         )
-        scorer.score_all(cands)
+        on_score = None
+        if lineage.enabled():
+            def on_score(c, flags):
+                lineage.mark("scored", run=lrun,
+                             id=lineage.candidate_uid(lrun, c),
+                             flags=flags)
+        scorer.score_all(cands, on_score=on_score)
 
         import time
 
         t0 = time.time()
+        did_fold = False
         if cfg.npdmp > 0:
             dm_row_lookup = None
             fold_program = None
@@ -1121,6 +1295,7 @@ class PulsarSearch:
                     search_accel_chunk.clear_cache()
                     search_accel_chunk_legacy.clear_cache()
                     gc.collect()
+                did_fold = True
                 with span("Folding", metric="folding",
                           npdmp=int(cfg.npdmp),
                           **({"gflops": round(
@@ -1140,7 +1315,31 @@ class PulsarSearch:
                     )
         timers["folding"] = time.time() - t0
 
+        if lineage.enabled():
+            # terminal: everything beyond the output limit is cut;
+            # the survivors are emitted with their final rank.  The
+            # fold top-N selection is annotated (non-terminal) so a
+            # `why` query states whether a candidate was folded or
+            # ranked out of the fold budget.
+            for rank, c in enumerate(cands[cfg.limit:],
+                                     start=cfg.limit):
+                lineage.mark("cut", run=lrun,
+                             id=lineage.candidate_uid(lrun, c),
+                             stage="limit", rank=rank,
+                             snr=float(c.snr))
         cands = cands[: cfg.limit]
+        if lineage.enabled():
+            for rank, c in enumerate(cands):
+                cid = lineage.candidate_uid(lrun, c)
+                if did_fold and (
+                        FOLD_MIN_PERIOD < 1.0 / c.freq
+                        < FOLD_MAX_PERIOD):
+                    lineage.mark(
+                        "folded" if rank < cfg.npdmp else "fold_cut",
+                        run=lrun, id=cid, rank=rank)
+                lineage.mark("emitted", run=lrun, id=cid, rank=rank,
+                             snr=float(c.snr), freq=float(c.freq),
+                             dm_idx=int(c.dm_idx))
         injection = None
         if cfg.injection_manifest:
             try:
@@ -1171,7 +1370,37 @@ class PulsarSearch:
             config=cfg,
             header=hdr,
             injection=injection,
+            provenance=self._provenance(cfg),
         )
+
+    def _provenance(self, cfg) -> dict:
+        """The provenance block stamped into store records and
+        overview.xml (ISSUE 19): enough to reconstruct where a
+        candidate came from — run id (hashes into candidate ids), git
+        sha, geometry fingerprint (joins the compile ledger and
+        warehouse rows), the RESOLVED trial lattice plus what the
+        config requested (tuner verdict visibility), and the host."""
+        import socket
+
+        from ..obs.history import git_describe
+        from ..obs.warehouse import geometry_fingerprint
+
+        geo = {
+            "nchans": int(self.fil.nchans),
+            "nbits": int(self.fil.header.nbits),
+            "size": int(self.size),
+            "out_nsamps": int(self.out_nsamps),
+            "n_dm": int(len(self.dm_list)),
+        }
+        git = git_describe()
+        return {
+            "run": getattr(cfg, "lineage_run", ""),
+            "git_sha": str(git.get("sha", "")),
+            "geometry": geometry_fingerprint(geo),
+            "lattice": getattr(self, "lattice", "f32"),
+            "lattice_requested": getattr(cfg, "trial_lattice", "f32"),
+            "host": socket.gethostname(),
+        }
 
     def _injection_budget(self, cands, cfg) -> dict:
         """Per-stage SNR budget of an injected signal (ISSUE 14).

@@ -180,6 +180,12 @@ class SearchConfig:
     # run_report.json.  Diagnostics-only — never part of the search
     # identity key, never changes the candidate list
     injection_manifest: str = ""
+    # candidate-lineage run id (obs/lineage.py, ISSUE 19): stamped on
+    # every decision mark and hashed into candidate ids; the worker
+    # sets it to the job id, the CLI to the observation basename.
+    # Diagnostics-only — never part of the search identity key, never
+    # changes the candidate list
+    lineage_run: str = ""
 
     # -- geometry accessors (the cost model reads these; keeping them
     # -- here means plan-derived figures have exactly one definition)

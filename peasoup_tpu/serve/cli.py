@@ -14,6 +14,8 @@ Verbs::
     status        [--jobs] [--fleet] [--watch [--interval S]]
     health        [--json PATH] [--stale-after N] [--window S]
                   [--slo KEY=VALUE ...]
+    why           <candidate-id> [--lineage PATH] [--json PATH]
+    query         <freq> [--freq-tol F] [--max-harm N] [--json PATH]
     coincidence   [--freq-tol F] [--min-sources N] [--json PATH]
     timeline      <job_id> [--json PATH] [--trace_json PATH]
     requeue       <job_ids...> | --running | --failed | --expired
@@ -29,7 +31,13 @@ auto-detected from jax.distributed, or injected with
 prints the queue + store state (``--fleet`` aggregates every host's
 snapshot into one table and writes ``fleet_report.json``);
 ``coincidence`` runs the survey-level coincidencer over the merged
-store shards; ``requeue`` recovers jobs from a crashed worker
+store shards; ``why`` reconstructs a candidate's full selection
+decision chain — decode, absorptions with margins, score flags,
+fold/limit cuts, store ingest — from its store record and the spool's
+lineage ledger (obs/lineage.py, ISSUE 19); ``query`` finds store
+records harmonically related to a frequency, each carrying its
+candidate id and provenance block; ``requeue`` recovers jobs from a
+crashed worker
 (``--running``, or ``--expired`` for lease-based recovery that only
 touches jobs whose host stopped heartbeating) or retries quarantined
 ones (``--failed``).
@@ -247,6 +255,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="override an SLO target (repeatable), e.g. "
                          "--slo queue_wait_p95_s=120")
 
+    py = sub.add_parser(
+        "why",
+        help="reconstruct one candidate's full selection decision "
+             "chain (store record -> lineage ledger, ISSUE 19)")
+    py.add_argument("candidate_id",
+                    help="candidate id (or unique prefix) from a store "
+                         "record, overview.xml <candidate_id>, or a "
+                         "query/coincidence listing")
+    py.add_argument("--lineage", dest="lineage_path", default=None,
+                    help="lineage ledger to read (default: "
+                         "<spool>/lineage.jsonl)")
+    py.add_argument("--json", dest="json_path", default=None,
+                    help="also write the chain document to this JSON "
+                         "file")
+
+    pq = sub.add_parser(
+        "query",
+        help="store records harmonically related to a frequency "
+             "across the survey")
+    pq.add_argument("freq", type=float, help="frequency in Hz")
+    pq.add_argument("--freq-tol", type=float, default=1e-4,
+                    help="fractional frequency-match tolerance")
+    pq.add_argument("--max-harm", type=int, default=1,
+                    help="match up to this harmonic ratio (1 = plain "
+                         "frequency match)")
+    pq.add_argument("--json", dest="json_path", default=None,
+                    help="also write the matching records (with their "
+                         "candidate ids + provenance blocks) to this "
+                         "JSON file")
+
     pc = sub.add_parser(
         "coincidence",
         help="survey-level coincidence over the merged store shards")
@@ -335,6 +373,11 @@ def _add_worker_args(pw) -> None:
                          "<spool>/profiles/, registered in the compile "
                          "ledger; tolerant no-op where the profiler "
                          "is unavailable; 0 disables)")
+    pw.add_argument("--no-lineage", action="store_true",
+                    help="disable the candidate-provenance ledger "
+                         "(<spool>/lineage.jsonl; the `why` verb's "
+                         "data source — candidate output is "
+                         "bit-identical either way)")
 
 
 def cmd_submit(spool, args) -> int:
@@ -382,6 +425,7 @@ def cmd_worker(spool, args) -> int:
         batch=args.batch,
         telemetry_interval_s=args.telemetry_interval,
         profile_every=args.profile_every,
+        lineage=not args.no_lineage,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -429,6 +473,7 @@ def cmd_fleet_worker(spool, args) -> int:
         batch=args.batch,
         telemetry_interval_s=args.telemetry_interval,
         profile_every=args.profile_every,
+        lineage=not args.no_lineage,
     )
     summary = worker.drain(max_jobs=args.max_jobs,
                            wait=not args.drain, poll_s=args.poll)
@@ -725,6 +770,159 @@ def cmd_health(spool, args) -> int:
     return 1 if report["severity"] == "crit" else 0
 
 
+def _render_why_mark(m: dict) -> str:
+    """One lineage mark as a human-readable line (a declared reader of
+    the ``lineage`` stream — obs/streams.py — so lint rule PSL013
+    proves the keys touched here are ones the writer emits)."""
+    bits = []
+    if m.get("stage"):
+        bits.append(f"stage={m['stage']}")
+    if m.get("rule"):
+        bits.append(f"rule={m['rule']}")
+    if m.get("absorber"):
+        bits.append(f"absorber={m['absorber']}")
+    if m.get("margin") is not None:
+        bits.append(f"margin={float(m['margin']):.3g}")
+    if m.get("rank") is not None:
+        bits.append(f"rank={m['rank']}")
+    if m.get("snr") is not None:
+        bits.append(f"snr={float(m['snr']):.2f}")
+    if m.get("freq") is not None:
+        bits.append(f"freq={float(m['freq']):.6f}")
+    if m.get("dm_idx") is not None:
+        bits.append(f"dm_idx={m['dm_idx']}")
+    if m.get("flags"):
+        flags = m["flags"]
+        bits.append("flags[" + " ".join(
+            (k if v is True else f"{k}={v}")
+            for k, v in sorted(flags.items())) + "]")
+    kind = str(m.get("kind", "?"))
+    return f"{kind:<10}" + ("  " + "  ".join(bits) if bits else "")
+
+
+def _print_why_chain(chain: dict, indent: int = 0) -> None:
+    """Render one candidate's decision chain, recursing into the
+    candidates it absorbed."""
+    pad = "  " * indent
+    head = "absorbed " if indent else ""
+    print(f"{pad}{head}candidate {chain['id']}"
+          + (f"  (run {chain['run']})" if chain.get("run") else ""))
+    if chain.get("decoded"):
+        print(f"{pad}  decoded")
+    for m in chain.get("annotations", []):
+        print(f"{pad}  {_render_why_mark(m)}")
+    if chain.get("terminal") is not None:
+        print(f"{pad}  {_render_why_mark(chain['terminal'])}")
+    elif chain.get("decoded"):
+        print(f"{pad}  (no terminal state recorded -- conservation "
+              f"violation, or the run is still in flight)")
+    for child in chain.get("children", []):
+        _print_why_chain(child, indent + 1)
+
+
+def cmd_why(spool, args) -> int:
+    """``why <candidate-id>``: store record -> lineage ledger -> the
+    full decision chain (absorbed children, margins, score flags, the
+    fold/limit verdicts, and the injection SNR budget when the run
+    was a known-answer canary)."""
+    import json
+
+    from ..obs import lineage
+    from .store import ShardedCandidateStore
+
+    cid = args.candidate_id
+    store = ShardedCandidateStore(spool.root)
+    matches = [r for r in store.records(include_canary=True)
+               if str(r.get("cand_id", "")).startswith(cid)]
+    ids = sorted({r["cand_id"] for r in matches})
+    if len(ids) > 1:
+        print(f"candidate id prefix {cid!r} is ambiguous: "
+              f"{', '.join(ids[:8])}", file=sys.stderr)
+        return 1
+    rec = matches[-1] if matches else None
+    if rec is not None:
+        cid = rec["cand_id"]
+        run = (rec.get("prov") or {}).get("run") or rec.get("job_id")
+    else:
+        run = None
+    path = (args.lineage_path
+            or os.path.join(spool.root, "lineage.jsonl"))
+    marks = lineage.read_lineage(path, run=run)
+    chain = lineage.why_chain(marks, cid)
+    if rec is None and not chain["decoded"] \
+            and chain["terminal"] is None:
+        print(f"candidate {cid!r}: no store record and no lineage "
+              f"marks (looked in {path})", file=sys.stderr)
+        return 1
+    if rec is not None:
+        prov = rec.get("prov") or {}
+        print(f"candidate {cid}  job {rec.get('job_id')}  "
+              f"source {rec.get('source')}")
+        print(f"  freq={rec.get('freq'):.6f} Hz  dm={rec.get('dm')}  "
+              f"acc={rec.get('acc')}  snr={rec.get('snr')}"
+              + ("  [canary]" if rec.get("canary") else ""))
+        if prov:
+            print("  provenance: " + "  ".join(
+                f"{k}={prov[k]}" for k in
+                ("run", "git_sha", "geometry", "lattice", "host")
+                if prov.get(k)))
+    _print_why_chain(chain)
+    # stage-SNR budget (obs/injection.py, ISSUE 14): present when the
+    # producing job ran with an injection manifest
+    injection = None
+    job_id = rec.get("job_id") if rec else run
+    if job_id:
+        rep_path = os.path.join(spool.work_dir(str(job_id)), "out",
+                                "run_report.json")
+        try:
+            with open(rep_path, encoding="utf-8") as f:
+                injection = json.load(f).get("injection")
+        except (OSError, ValueError):
+            injection = None
+    if injection:
+        snr = injection.get("snr", {})
+        loss = injection.get("loss", {})
+        print("  injection budget: " + "  ".join(
+            f"{k}={snr[k]}" for k in
+            ("whiten", "fourier_bin", "interbin", "harmonic_best",
+             "peak") if k in snr))
+        if loss:
+            print("  injection loss:   " + "  ".join(
+                f"{k}={v}" for k, v in sorted(loss.items())))
+    if args.json_path:
+        atomic_write_json(
+            args.json_path,
+            {"v": 1, "candidate_id": cid, "record": rec,
+             "chain": chain, "injection": injection},
+            sort_keys=True)
+        print(f"wrote {args.json_path}")
+    return 0
+
+
+def cmd_query(spool, args) -> int:
+    from .store import ShardedCandidateStore
+
+    store = ShardedCandidateStore(spool.root)
+    recs = store.query(args.freq, freq_tol=args.freq_tol,
+                       max_harm=args.max_harm)
+    for r in recs:
+        prov = r.get("prov") or {}
+        sha = f"  git={prov['git_sha']}" if prov.get("git_sha") else ""
+        print(f"{r.get('cand_id', '-'):<16}  f={r['freq']:.6f} Hz  "
+              f"snr={r.get('snr', 0.0):.2f}  "
+              f"{os.path.basename(r.get('source', ''))}{sha}")
+    print(f"{len(recs)} record(s) matching {args.freq:g} Hz "
+          f"(tol {args.freq_tol:g}, max_harm {args.max_harm})")
+    if args.json_path:
+        atomic_write_json(
+            args.json_path,
+            {"v": 1, "freq": args.freq, "freq_tol": args.freq_tol,
+             "max_harm": args.max_harm, "records": recs},
+            sort_keys=True)
+        print(f"wrote {args.json_path}")
+    return 0
+
+
 def cmd_coincidence(spool, args) -> int:
     from .store import ShardedCandidateStore
 
@@ -735,9 +933,11 @@ def cmd_coincidence(spool, args) -> int:
         best = group[0]
         srcs = sorted({os.path.basename(r.get("source", ""))
                        for r in group})
+        cid = best.get("cand_id")
         print(f"group {i}: f={best['freq']:.6f} Hz  "
               f"snr={best.get('snr', 0.0):.2f}  "
-              f"{len(group)} detection(s) in {len(srcs)} "
+              + (f"id={cid}  " if cid else "")
+              + f"{len(group)} detection(s) in {len(srcs)} "
               f"observation(s): {', '.join(srcs)}")
     print(f"{len(groups)} coincident group(s) across "
           f"{len(store.shard_files())} shard(s)")
@@ -823,6 +1023,8 @@ def main(argv=None) -> int:
         "admission": cmd_admission,
         "status": cmd_status,
         "health": cmd_health,
+        "why": cmd_why,
+        "query": cmd_query,
         "coincidence": cmd_coincidence,
         "timeline": cmd_timeline,
         "requeue": cmd_requeue,

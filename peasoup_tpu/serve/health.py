@@ -730,6 +730,80 @@ def rule_anomaly(ctx: HealthContext) -> list[HealthFinding]:
         f"({len(anomalies)} historical)", data=data)]
 
 
+#: distillation-funnel collapse (ISSUE 19): how far the newest drain's
+#: absorbed fraction may sit from the ledger median before it counts
+#: as a behaviour shift, and the pass-fraction floor/baseline for the
+#: hard-collapse verdict
+DISTILL_ABSORBED_BAND = 0.15
+DISTILL_PASS_CRIT = 0.01
+DISTILL_PASS_BASELINE = 0.10
+
+
+def _median(values: list[float]) -> float:
+    values = sorted(values)
+    mid = len(values) // 2
+    return (values[mid] if len(values) % 2
+            else 0.5 * (values[mid - 1] + values[mid]))
+
+
+@health_rule
+def rule_distill_collapse(ctx: HealthContext) -> list[HealthFinding]:
+    """Distillation-funnel collapse (ISSUE 19): the lineage ledger's
+    exact selection-funnel rates ride each drain's serve record
+    (``lineage_pass_frac`` = emitted/decoded, ``lineage_absorbed_frac``
+    = absorbed/decoded), so a *distillation behaviour shift* — a
+    mistuned harmonic tolerance silently eating real candidates, or a
+    broken distiller passing everything through — is a ledger
+    comparison, not a post-mortem.  Warn when the newest drain's
+    absorbed fraction departs the baseline band around the ledger
+    median; crit when the funnel hard-collapses: almost nothing
+    (<1%) survives where the baseline passes >10%.  Fewer than 3
+    funnel-bearing records = no baseline = ok."""
+    recs = [r for r in ctx.ledger
+            if r.get("kind") == "serve"
+            and float(r.get("metrics", {})
+                      .get("lineage_decoded", 0) or 0) > 0]
+    if len(recs) < 3:
+        return [HealthFinding(
+            "distill_collapse", OK,
+            f"not enough funnel-bearing serve records for a baseline "
+            f"({len(recs)} < 3)", data={"records": len(recs)})]
+    head = recs[-1]["metrics"]
+    base = [r["metrics"] for r in recs[:-1]]
+    head_pass = float(head.get("lineage_pass_frac", 0.0) or 0.0)
+    head_abs = float(head.get("lineage_absorbed_frac", 0.0) or 0.0)
+    med_pass = _median([float(m.get("lineage_pass_frac", 0.0) or 0.0)
+                        for m in base])
+    med_abs = _median([float(m.get("lineage_absorbed_frac", 0.0) or 0.0)
+                       for m in base])
+    data = {"pass_frac": round(head_pass, 4),
+            "absorbed_frac": round(head_abs, 4),
+            "median_pass_frac": round(med_pass, 4),
+            "median_absorbed_frac": round(med_abs, 4),
+            "band": DISTILL_ABSORBED_BAND,
+            "records": len(recs)}
+    if head_pass < DISTILL_PASS_CRIT and med_pass > DISTILL_PASS_BASELINE:
+        return [HealthFinding(
+            "distill_collapse", CRIT,
+            f"selection funnel collapsed: {100 * head_pass:.2f}% of "
+            f"decoded peaks survive distillation where the ledger "
+            f"baseline passes {100 * med_pass:.1f}% — a distiller "
+            f"tolerance is eating the science; run `why` on a known "
+            f"candidate to see which rule absorbs it", data=data)]
+    if abs(head_abs - med_abs) > DISTILL_ABSORBED_BAND:
+        return [HealthFinding(
+            "distill_collapse", WARN,
+            f"absorbed fraction {head_abs:.2f} departed the baseline "
+            f"band ({med_abs:.2f} +/- {DISTILL_ABSORBED_BAND:.2f}) — "
+            f"distillation behaviour shifted since the ledger "
+            f"baseline", data=data)]
+    return [HealthFinding(
+        "distill_collapse", OK,
+        f"funnel pass {head_pass:.2f} / absorbed {head_abs:.2f} vs "
+        f"baseline medians {med_pass:.2f} / {med_abs:.2f}",
+        data=data)]
+
+
 # -- SLO summary -----------------------------------------------------------
 
 def _weighted_percentile(pairs: list[tuple[float, float]],

@@ -20,7 +20,13 @@ Record schema (``v`` = 1; consumers tolerate additions)::
     job_id     str    spool job that produced the record
     source     str    input filterbank path (the observation)
     utc        float  ingest time (unix seconds)
+    cand_id    str    stable content-derived candidate id
+                      (obs/lineage.py, ISSUE 19) — the ``why`` verb's
+                      join key into the lineage ledger
+    dm_idx     int    DM trial index (part of the id's preimage)
     dm, acc, jerk, freq, snr, folded_snr, nh, period  candidate fields
+    prov       dict   producing run's provenance block (run id, git
+                      sha, geometry fingerprint, lattice, host)
     canary     bool   present (true) only on canary-job records
                       (obs/injection.py, ISSUE 14) — excluded from
                       every science read unless ``include_canary=True``
@@ -95,14 +101,26 @@ def _iter_records(path: str, source: str | None = None,
             yield rec
 
 
+#: provenance fields copied from ``SearchResult.provenance`` onto each
+#: store record (obs/lineage.py, ISSUE 19) — enough for ``why`` to
+#: relocate the run's lineage ledger and pin the producing build
+PROV_FIELDS = ("run", "git_sha", "geometry", "lattice", "host")
+
+
 def _record_from_candidate(job_id: str, source: str, cand,
-                           utc: float, canary: bool = False) -> dict:
+                           utc: float, canary: bool = False,
+                           prov: dict | None = None) -> dict:
+    from ..obs.lineage import candidate_uid
+
+    run = (prov or {}).get("run") or str(job_id)
     rec = {
         "v": STORE_VERSION,
         "job_id": str(job_id),
         "source": str(source),
         "utc": round(float(utc), 3),
+        "cand_id": candidate_uid(run, cand),
         "dm": round(float(cand.dm), 6),
+        "dm_idx": int(getattr(cand, "dm_idx", 0)),
         "acc": round(float(cand.acc), 6),
         "jerk": round(float(getattr(cand, "jerk", 0.0)), 6),
         "freq": float(cand.freq),
@@ -111,6 +129,8 @@ def _record_from_candidate(job_id: str, source: str, cand,
         "nh": int(cand.nh),
         "period": (1.0 / float(cand.freq)) if cand.freq else 0.0,
     }
+    if prov:
+        rec["prov"] = {k: prov[k] for k in PROV_FIELDS if k in prov}
     if canary:
         # tag-only-when-true keeps science records byte-identical to
         # the pre-canary schema
@@ -127,14 +147,19 @@ class CandidateStore:
     # -- ingest ------------------------------------------------------------
 
     def ingest(self, job_id: str, source: str, candidates,
-               utc: float | None = None, canary: bool = False) -> int:
+               utc: float | None = None, canary: bool = False,
+               provenance: dict | None = None) -> int:
         """Append one job's distilled candidates; returns the count.
 
         ``canary=True`` tags every record so the default read side
-        excludes them from science queries and coincidence."""
+        excludes them from science queries and coincidence.
+        ``provenance`` (``SearchResult.provenance``) stamps each record
+        with the producing run's identity block (ISSUE 19) so ``why``
+        can reconstruct the decision chain from the record alone."""
         utc = time.time() if utc is None else utc
         recs = [
-            _record_from_candidate(job_id, source, c, utc, canary)
+            _record_from_candidate(job_id, source, c, utc, canary,
+                                   provenance)
             for c in candidates
         ]
         if not recs:

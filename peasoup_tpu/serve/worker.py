@@ -180,7 +180,8 @@ class SurveyWorker:
                  prefetch: bool = True, run_job_fn=None,
                  history_path: str | None = None, sleeper=None,
                  batch: int = 1, telemetry_interval_s: float = 5.0,
-                 profile_every: int = 0, profile_dir: str | None = None):
+                 profile_every: int = 0, profile_dir: str | None = None,
+                 lineage: bool = True):
         self.spool = spool
         self.store = store if store is not None else CandidateStore(
             os.path.join(spool.root, "candidates.jsonl"))
@@ -213,6 +214,11 @@ class SurveyWorker:
         self.profile_every = max(0, int(profile_every))
         self.profile_dir = profile_dir or os.path.join(
             spool.root, "profiles")
+        #: candidate provenance (ISSUE 19): record every selection
+        #: decision into the spool's ``lineage.jsonl``; False is the
+        #: ``--no-lineage`` escape hatch (candidate output is
+        #: bit-identical either way)
+        self.lineage = bool(lineage)
         self._jobs_started = 0
         #: observation-granularity pipeline depth (ISSUE 11): how many
         #: jobs ahead the prefetcher reads (and device-stages).  Jobs
@@ -232,6 +238,9 @@ class SurveyWorker:
         #: waits of every job this worker finished
         self._sojourns: list[float] = []
         self._queue_waits: list[float] = []
+        #: run ids (job ids) finished this drain — the funnel scope of
+        #: the drain summary's lineage block
+        self._drained_runs: list[str] = []
 
     # -- config / geometry -------------------------------------------------
 
@@ -250,6 +259,10 @@ class SurveyWorker:
         cfg.infilename = job.input
         work = self.spool.work_dir(job.job_id)
         cfg.outdir = os.path.join(work, "out")
+        # lineage run id (ISSUE 19): every decision mark this job's
+        # search emits is attributed to the job, so per-job funnels
+        # and the `why` verb scope to one observation exactly
+        cfg.lineage_run = job.job_id
         if not cfg.checkpoint_file:
             # crash-resume: a re-claimed job resumes its completed DM
             # rows instead of recomputing (search/checkpoint.py keys
@@ -437,6 +450,23 @@ class SurveyWorker:
         METRICS.observe("scheduler.sojourn", soj)
         self._sojourns.append(float(soj))
         self._queue_waits.append(float(job.queue_wait_s or 0.0))
+        self._drained_runs.append(str(job.job_id))
+
+    def _mark_store(self, job: JobRecord, result) -> None:
+        """Lineage annotations for the store ingest (ISSUE 19):
+        science candidates are ``stored``; a canary job's candidates
+        are ``quarantined`` — tagged out of every science read — so
+        the funnel shows known-answer probes leaving the population."""
+        from ..obs import lineage
+
+        if not lineage.enabled() or not result.candidates:
+            return
+        run = str(job.job_id)
+        lineage.mark(
+            "quarantined" if job.canary else "stored", run=run,
+            ids=[lineage.candidate_uid(run, c)
+                 for c in result.candidates],
+            n=len(result.candidates))
 
     def _run_batch_jobs(self, jobs: list[JobRecord]) -> int:
         """Run claimed same-bucket jobs through ONE batched dispatch;
@@ -540,7 +570,9 @@ class SurveyWorker:
                               job_id=job.job_id):
                         ingested = self.store.ingest(
                             job.job_id, job.input, result.candidates,
-                            canary=bool(job.canary))
+                            canary=bool(job.canary),
+                            provenance=result.provenance)
+                        self._mark_store(job, result)
                     best = max((float(c.snr)
                                 for c in result.candidates), default=0.0)
                     summary = {
@@ -604,7 +636,9 @@ class SurveyWorker:
                   job_id=job.job_id):
             ingested = self.store.ingest(
                 job.job_id, job.input, result.candidates,
-                canary=bool(job.canary))
+                canary=bool(job.canary),
+                provenance=result.provenance)
+            self._mark_store(job, result)
         best = max((float(c.snr) for c in result.candidates),
                    default=0.0)
         summary = {
@@ -807,6 +841,14 @@ class SurveyWorker:
         configure_compile_ledger(
             os.path.join(self.spool.root, "compiles.jsonl"))
         install_compile_ledger()
+        from ..obs import lineage
+
+        # candidate provenance ledger (ISSUE 19): one spool-level
+        # lineage.jsonl recording every selection decision of every
+        # job this drain runs; empty path = the --no-lineage hatch
+        lineage_path = os.path.join(self.spool.root, "lineage.jsonl")
+        lineage.configure_lineage(lineage_path if self.lineage else "")
+        lov0 = lineage.overhead()  # lineage mark-cost origin
         sampler = self._start_telemetry()
         ov0 = timeline.overhead()  # mark-cost ledger origin
         t0 = time.time()
@@ -886,6 +928,26 @@ class SurveyWorker:
             "overhead_s": round(ov1["seconds"] - ov0["seconds"], 6),
             "errors": ov1["errors"] - ov0["errors"],
         }
+        lov1 = lineage.overhead()
+        lg = {
+            "marks": lov1["marks"] - lov0["marks"],
+            "overhead_s": round(lov1["seconds"] - lov0["seconds"], 6),
+            "errors": lov1["errors"] - lov0["errors"],
+        }
+        if self.lineage and self._drained_runs:
+            # the drain's selection funnel, scoped to the jobs THIS
+            # worker finished (fleet mates write their own records)
+            fn = lineage.funnel(lineage.read_lineage(lineage_path),
+                                runs=self._drained_runs)
+            lg.update({
+                "decoded": fn["decoded"],
+                "absorbed": fn["absorbed"],
+                "cut": fn["cut"],
+                "emitted": fn["emitted"],
+                "pass_frac": round(fn["pass_frac"], 6),
+                "absorbed_frac": round(fn["absorbed_frac"], 6),
+            })
+        summary["lineage"] = lg
         self._append_throughput(summary)
         return summary
 
@@ -962,6 +1024,7 @@ class SurveyWorker:
         snap = METRICS.snapshot()
         counters = snap.get("counters", {})
         tl = summary.get("timeline", {})
+        lg = summary.get("lineage", {})
         rec = make_history_record(
             "serve",
             {
@@ -1008,6 +1071,17 @@ class SurveyWorker:
                     counters.get("canary.recovered", 0)),
                 "canary_missed": int(
                     counters.get("canary.missed", 0)),
+                # candidate provenance (ISSUE 19): the drain's exact
+                # selection funnel + the ledger's self-accounted cost;
+                # baselines band the fracs and the distill_collapse
+                # health rule fires on departures
+                "lineage_marks": int(lg.get("marks", 0)),
+                "lineage_overhead_s": float(lg.get("overhead_s", 0.0)),
+                "lineage_decoded": int(lg.get("decoded", 0)),
+                "lineage_emitted": int(lg.get("emitted", 0)),
+                "lineage_pass_frac": float(lg.get("pass_frac", 0.0)),
+                "lineage_absorbed_frac": float(
+                    lg.get("absorbed_frac", 0.0)),
             },
             stage_device_s=stage_device_seconds(snap),
             config={

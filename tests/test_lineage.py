@@ -1,0 +1,332 @@
+"""Candidate provenance (ISSUE 19): stable ids, the lineage ledger,
+exact funnel conservation, the `why` chain, and the distillation
+baselines — plus the assoc-count round-trip pinning ``<nassoc>`` to
+the binary writer's pre-order flatten."""
+
+import json
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from peasoup_tpu.data import Candidate
+from peasoup_tpu.obs import lineage
+from peasoup_tpu.obs.baseline import funnel_anomalies
+from peasoup_tpu.obs.warehouse import Warehouse, lineage_rows
+from peasoup_tpu.output import (
+    CandidateFileParser,
+    OutputFileWriter,
+    write_candidate_binary,
+)
+from peasoup_tpu.serve.health import HealthContext, rule_distill_collapse
+
+
+# -------------------------------------------------------------------------
+# stable candidate ids
+# -------------------------------------------------------------------------
+
+def test_uid_stable_and_json_roundtrip():
+    c = Candidate(dm=12.5, dm_idx=7, acc=-3.25, jerk=0.5, nh=2,
+                  snr=9.0, freq=123.456789)
+    uid = lineage.candidate_uid("run-a", c)
+    assert len(uid) == 16 and int(uid, 16) >= 0
+    # same fields -> same id, however they arrive
+    assert uid == lineage.uid_from_fields(
+        "run-a", c.dm_idx, c.acc, c.jerk, c.nh, c.freq)
+    # json round-trip (store record / overview.xml) reproduces the id:
+    # repr(float) is the shortest exact round-trip
+    fields = json.loads(json.dumps(
+        {"dm_idx": c.dm_idx, "acc": c.acc, "jerk": c.jerk,
+         "nh": c.nh, "freq": c.freq}))
+    assert uid == lineage.uid_from_fields("run-a", **fields)
+    # mutating what folding mutates must NOT move the id
+    c.folded_snr, c.opt_period = 42.0, 0.1
+    assert uid == lineage.candidate_uid("run-a", c)
+    # but run and any trial coordinate must
+    assert uid != lineage.candidate_uid("run-b", c)
+    c2 = Candidate(dm=12.5, dm_idx=8, acc=-3.25, jerk=0.5, nh=2,
+                   snr=9.0, freq=123.456789)
+    assert uid != lineage.candidate_uid("run-a", c2)
+
+
+# -------------------------------------------------------------------------
+# the recorder: rotation, torn lines, failure latch
+# -------------------------------------------------------------------------
+
+def test_recorder_writes_and_reader_filters(tmp_path):
+    path = str(tmp_path / "lineage.jsonl")
+    rec = lineage.LineageRecorder(path, run="r1")
+    rec.mark("decoded", ids=["a", "b"])
+    rec.mark("emitted", id="a", rank=0)
+    rec.mark("absorbed", run="r2", id="x", absorber="y",
+             rule="harmonic", margin=1e-4)
+    rec.close()
+    with open(path, "a") as f:
+        f.write('{"v": 1, "run": "r1", "kind": "torn')  # crashed tail
+        f.write("\n")
+        f.write(json.dumps({"v": 99, "run": "r1",
+                            "kind": "future"}) + "\n")
+    marks = lineage.read_lineage(path)
+    assert [m["kind"] for m in marks] == ["decoded", "emitted",
+                                          "absorbed"]
+    assert all(m["v"] == lineage.LINEAGE_VERSION for m in marks)
+    only_r1 = lineage.read_lineage(path, run="r1")
+    assert [m["kind"] for m in only_r1] == ["decoded", "emitted"]
+    # None-valued fields are elided, not serialised
+    assert "rank" in marks[1] and "margin" in marks[2]
+
+
+def test_recorder_rotates_and_reader_spans_generations(tmp_path):
+    path = str(tmp_path / "lineage.jsonl")
+    rec = lineage.LineageRecorder(path, run="r", max_bytes=256)
+    for i in range(40):
+        rec.mark("decoded", ids=[f"c{i}"])
+    rec.close()
+    assert (tmp_path / "lineage.jsonl.1").exists()
+    marks = lineage.read_lineage(path)
+    # one sealed generation is retained: the reader sees a contiguous
+    # TAIL of the append order ending at the newest mark
+    got = [m["ids"][0] for m in marks]
+    want = [f"c{i}" for i in range(40)]
+    assert 0 < len(got) < 40
+    assert got == want[-len(got):]
+
+
+def test_recorder_io_failure_latches_never_raises(tmp_path):
+    blocker = tmp_path / "dir"
+    blocker.write_text("a file where the ledger dir should be")
+    rec = lineage.LineageRecorder(str(blocker / "lineage.jsonl"))
+    before = lineage.overhead()
+    rec.mark("decoded", ids=["a"])  # must not raise
+    rec.mark("emitted", id="a")
+    after = lineage.overhead()
+    assert after["errors"] >= before["errors"] + 1
+    assert after["marks"] >= before["marks"] + 2
+
+
+def test_module_level_configure_and_noop_when_off(tmp_path):
+    path = str(tmp_path / "lineage.jsonl")
+    try:
+        lineage.configure_lineage(path, run="r9")
+        assert lineage.enabled()
+        lineage.mark("decoded", ids=["z"])
+        lineage.configure_lineage("")  # the --no-lineage escape hatch
+        assert not lineage.enabled()
+        lineage.mark("decoded", ids=["never-written"])
+    finally:
+        lineage.configure_lineage("")
+    marks = lineage.read_lineage(path)
+    assert len(marks) == 1 and marks[0]["run"] == "r9"
+
+
+# -------------------------------------------------------------------------
+# funnel accounting + the conservation proof
+# -------------------------------------------------------------------------
+
+def _marks_ok(run="r"):
+    # ids embed the run like real candidate_uid ids do, so a
+    # multi-run ledger never collides
+    a, b, c, d = (f"{run}-{x}" for x in "abcd")
+    return [
+        {"v": 1, "run": run, "kind": "decoded",
+         "ids": [a, b, c, d]},
+        {"v": 1, "run": run, "kind": "clipped", "n": 3},
+        {"v": 1, "run": run, "kind": "absorbed", "id": a,
+         "absorber": b, "rule": "harmonic", "margin": 1e-4},
+        {"v": 1, "run": run, "kind": "cut", "id": c, "stage": "limit"},
+        {"v": 1, "run": run, "kind": "scored", "id": b},
+        {"v": 1, "run": run, "kind": "emitted", "id": b, "rank": 0},
+        {"v": 1, "run": run, "kind": "emitted", "id": d, "rank": 1},
+    ]
+
+
+def test_funnel_conserves_exactly():
+    fn = lineage.funnel(_marks_ok())
+    assert fn["decoded"] == 4
+    assert fn["decoded"] == fn["absorbed"] + fn["cut"] + fn["emitted"]
+    assert (fn["absorbed"], fn["cut"], fn["emitted"]) == (1, 1, 2)
+    assert fn["clipped"] == 3  # aggregate: counted, outside invariant
+    assert fn["pass_frac"] == pytest.approx(0.5)
+    assert fn["absorbed_frac"] == pytest.approx(0.25)
+    assert lineage.check_conservation(_marks_ok()) == []
+
+
+def test_funnel_filters_by_run():
+    marks = _marks_ok("r1") + _marks_ok("r2")
+    assert lineage.funnel(marks, runs=["r1"])["decoded"] == 4
+    assert lineage.funnel(marks)["decoded"] == 8
+    assert lineage.check_conservation(marks) == []
+
+
+def test_conservation_detects_each_violation():
+    leaked = _marks_ok()[:-1]  # d decoded, no terminal
+    assert any("no terminal" in p
+               for p in lineage.check_conservation(leaked))
+    double = _marks_ok() + [
+        {"v": 1, "run": "r", "kind": "cut", "id": "r-d"}]
+    assert any("2 terminal states" in p
+               for p in lineage.check_conservation(double))
+    orphan = _marks_ok() + [
+        {"v": 1, "run": "r", "kind": "emitted", "id": "ghost"}]
+    assert any("never decoded" in p
+               for p in lineage.check_conservation(orphan))
+
+
+def test_why_chain_recurses_into_absorbed_children():
+    marks = _marks_ok() + [
+        # an earlier stage: "r-a" had itself absorbed "r-z"
+        {"v": 1, "run": "r", "kind": "decoded", "ids": ["r-z"]},
+        {"v": 1, "run": "r", "kind": "absorbed", "id": "r-z",
+         "absorber": "r-a", "rule": "dm", "margin": 0.5},
+    ]
+    chain = lineage.why_chain(marks, "r-b")
+    assert chain["decoded"] and chain["run"] == "r"
+    assert chain["terminal"]["kind"] == "emitted"
+    assert [m["kind"] for m in chain["annotations"]] == ["scored"]
+    kid = chain["children"][0]
+    assert kid["id"] == "r-a" and kid["absorbed_into"] == "r-b"
+    assert kid["terminal"]["rule"] == "harmonic"
+    grandkid = kid["children"][0]
+    assert (grandkid["id"] == "r-z"
+            and grandkid["terminal"]["rule"] == "dm")
+    # depth limit stops the recursion, never errors
+    shallow = lineage.why_chain(marks, "r-b", max_depth=1)
+    assert shallow["children"][0]["children"] == []
+
+
+# -------------------------------------------------------------------------
+# warehouse ingest + funnel baselines + the health rule
+# -------------------------------------------------------------------------
+
+def test_lineage_rows_and_ingest(tmp_path):
+    path = str(tmp_path / "lineage.jsonl")
+    rec = lineage.LineageRecorder(path, run="r1")
+    for m in _marks_ok("r1"):
+        rec.mark(m["kind"], **{k: v for k, v in m.items()
+                               if k not in ("v", "run", "kind")})
+    rec.close()
+    rows = lineage_rows(lineage.read_lineage(path))
+    by_metric = {}
+    for r in rows:
+        by_metric.setdefault(r["metric"], 0.0)
+        by_metric[r["metric"]] += r["value"]
+    assert by_metric["lineage.decoded"] == 4.0
+    assert by_metric["lineage.clipped"] == 3.0  # aggregate uses n
+    assert by_metric["lineage.emitted"] == 2.0
+
+    wh = Warehouse(str(tmp_path / "wh"))
+    n = wh.ingest_lineage(path)
+    assert n == len(rows) + 3  # + pass_frac / absorbed_frac / decoded
+    funnel_rows = wh.rows(stage="funnel")
+    got = {r["metric"]: r["value"] for r in funnel_rows}
+    assert got["lineage.pass_frac"] == pytest.approx(0.5)
+    assert got["lineage.absorbed_frac"] == pytest.approx(0.25)
+    assert got["lineage.decoded"] == 4.0
+
+
+def _serve_rec(i, pass_frac, absorbed_frac, decoded=100):
+    return {"kind": "serve", "utc": 1000.0 + 60.0 * i,
+            "config": {"worker": "w0"},
+            "metrics": {"lineage_decoded": decoded,
+                        "lineage_pass_frac": pass_frac,
+                        "lineage_absorbed_frac": absorbed_frac}}
+
+
+def _collapse_ctx(ledger):
+    return HealthContext(now=time.time(), samples=[], recent=[],
+                         latest={}, queue={}, running=[],
+                         ledger=list(ledger))
+
+
+def test_distill_collapse_needs_baseline():
+    recs = [_serve_rec(i, 0.3, 0.6) for i in range(2)]
+    (f,) = rule_distill_collapse(_collapse_ctx(recs))
+    assert f.severity == "ok" and "baseline" in f.message
+    # funnel-free serve records don't count toward the baseline
+    recs += [_serve_rec(9, 0.0, 0.0, decoded=0)] * 5
+    (f,) = rule_distill_collapse(_collapse_ctx(recs))
+    assert f.severity == "ok" and f.data["records"] == 2
+
+
+def test_distill_collapse_bands():
+    steady = [_serve_rec(i, 0.30, 0.60) for i in range(4)]
+    (f,) = rule_distill_collapse(_collapse_ctx(steady))
+    assert f.severity == "ok"
+    shifted = steady[:-1] + [_serve_rec(9, 0.10, 0.90)]
+    (f,) = rule_distill_collapse(_collapse_ctx(shifted))
+    assert f.severity == "warn"
+    collapsed = steady[:-1] + [_serve_rec(9, 0.005, 0.62)]
+    (f,) = rule_distill_collapse(_collapse_ctx(collapsed))
+    assert f.severity == "crit" and "why" in f.message
+
+
+def test_funnel_anomalies_attribute_the_shift():
+    steady = [_serve_rec(i, 0.30, 0.60) for i in range(5)]
+    assert funnel_anomalies(steady) == []
+    shifted = steady + [_serve_rec(9, 0.05, 0.95)]
+    anoms = funnel_anomalies(shifted)
+    metrics = {a["metric"] for a in anoms}
+    assert metrics == {"lineage_pass_frac", "lineage_absorbed_frac"}
+    for a in anoms:
+        assert a["kind"] == "anomaly"
+        assert a["key"]["stage"] == "distill"
+        assert a["key"]["host"] == "w0"
+    # funnel-free records alone -> nothing to judge
+    assert funnel_anomalies(
+        [_serve_rec(i, 0.0, 0.0, decoded=0) for i in range(6)]) == []
+
+
+# -------------------------------------------------------------------------
+# satellite: count_assoc == binary pre-order flatten == <nassoc>
+# -------------------------------------------------------------------------
+
+def _assoc_tree():
+    """root absorbs two candidates, one of which absorbed another —
+    the nested shape the distillers actually produce."""
+    leaf = Candidate(dm=1.0, dm_idx=1, acc=0.5, jerk=0.25, nh=1,
+                     snr=5.0, freq=200.0)
+    mid = Candidate(dm=2.0, dm_idx=2, acc=1.0, jerk=-0.5, nh=2,
+                    snr=7.0, freq=100.0, assoc=[leaf])
+    sib = Candidate(dm=3.0, dm_idx=3, acc=-1.0, jerk=0.0, nh=1,
+                    snr=6.0, freq=50.0)
+    root = Candidate(dm=4.0, dm_idx=4, acc=2.0, jerk=1.5, nh=4,
+                     snr=9.0, freq=25.0, assoc=[mid, sib])
+    lone = Candidate(dm=5.0, dm_idx=5, acc=0.0, jerk=0.0, nh=1,
+                     snr=4.0, freq=10.0)
+    return [root, lone]
+
+
+def test_nassoc_pins_preorder_flatten_and_xml(tmp_path):
+    cands = _assoc_tree()
+    root = cands[0]
+    assert root.count_assoc() == 3  # mid + leaf + sib
+    # count_assoc is exactly the flattened tree minus the candidate
+    for c in cands:
+        assert c.count_assoc() == len(c.collect()) - 1
+
+    # binary layout: ndets per candidate == 1 + count_assoc, rows in
+    # the same pre-order collect() walks, jerk column intact
+    path = str(tmp_path / "candidates.peasoup")
+    mapping = write_candidate_binary(cands, path)
+    with CandidateFileParser(path) as parser:
+        for ii, c in enumerate(cands):
+            _, hits = parser.cand_from_offset(mapping[ii])
+            flat = c.collect()
+            assert len(hits) == 1 + c.count_assoc() == len(flat)
+            for row, d in zip(hits, flat):
+                assert row["dm_idx"] == d.dm_idx
+                assert row["freq"] == pytest.approx(d.freq)
+                assert row["jerk"] == pytest.approx(d.jerk)
+
+    # XML: <nassoc> must agree with the binary block it points into
+    w = OutputFileWriter()
+    w.add_candidates(cands, mapping,
+                     cand_ids=[lineage.candidate_uid("r", c)
+                               for c in cands])
+    tree = ET.fromstring(w.to_string())
+    els = tree.findall(".//candidate")
+    assert len(els) == len(cands)
+    for el, c in zip(els, cands):
+        assert int(float(el.findtext("nassoc"))) == c.count_assoc()
+        assert el.findtext("candidate_id") == lineage.candidate_uid(
+            "r", c)
