@@ -789,6 +789,118 @@ def run_coldstart_bench(n: int) -> int:
         shutil.rmtree(work, ignore_errors=True)
 
 
+def store_arg(argv: list[str]) -> int | None:
+    """``--store [records]``: run the log-structured candidate-store
+    micro-bench (synthetic survey, full-scan vs indexed query, one
+    compaction) instead of the e2e search benchmark (default
+    100000 records)."""
+    if "--store" not in argv:
+        return None
+    i = argv.index("--store")
+    if i + 1 < len(argv) and not argv[i + 1].startswith("-"):
+        return max(1000, int(argv[i + 1]))
+    return 100_000
+
+
+def run_store_bench(n: int) -> int:
+    """``bench.py --store N``: ISSUE 20's acceptance measurement.
+
+    Synthesizes an ``N``-record survey across 4 host shards, times a
+    seeded set of harmonic ``query()`` calls against the raw JSONL
+    tails (full scan), compacts into sealed segments, re-times the
+    SAME queries through the frequency fence-post indexes, and checks
+    the two answer sets are record-identical.  One ``kind:"store"``
+    ledger record carries ``store_query_p50_ms`` (indexed),
+    ``store_query_full_scan_p50_ms``, ``store_query_speedup`` and
+    ``compaction_s`` — the perf gate's new store metrics.
+    ``--no-history`` routes the record to a throwaway ledger."""
+    import random
+    import shutil
+    import statistics
+    import tempfile
+    import types
+
+    from peasoup_tpu.serve.compaction import (CompactionPolicy,
+                                              Compactor)
+    from peasoup_tpu.serve.store import ShardedCandidateStore
+
+    work = tempfile.mkdtemp(prefix="peasoup-store-bench-")
+    history = (os.path.join(work, "history.jsonl")
+               if "--no-history" in sys.argv[1:] else None)
+    rng = random.Random(0)
+    try:
+        n_hosts, per_job = 4, 250
+        stores = [ShardedCandidateStore(work, host_label=f"host{h}")
+                  for h in range(n_hosts)]
+        written, job = 0, 0
+        while written < n:
+            batch = min(per_job, n - written)
+            cands = [types.SimpleNamespace(
+                dm=rng.uniform(0.0, 250.0), dm_idx=i,
+                acc=rng.uniform(-5.0, 5.0), jerk=0.0,
+                freq=rng.uniform(0.5, 500.0),
+                snr=rng.uniform(7.0, 30.0), folded_snr=9.0, nh=2)
+                for i in range(batch)]
+            stores[job % n_hosts].ingest(
+                f"job-{job:06d}", f"obs{job:06d}.fil", cands,
+                utc=1000.0 + job)
+            written += batch
+            job += 1
+        store = ShardedCandidateStore(work)
+        shards = store.shard_files()
+        probe_freqs = [rng.uniform(1.0, 400.0) for _ in range(12)]
+
+        def timed_queries() -> tuple[list, float]:
+            out, lat = [], []
+            for f in probe_freqs:
+                t0 = time.perf_counter()
+                out.append(store.query(f, freq_tol=1e-4, max_harm=4))
+                lat.append((time.perf_counter() - t0) * 1000.0)
+            return out, statistics.median(lat)
+
+        full_hits, full_p50_ms = timed_queries()
+        t0 = time.perf_counter()
+        report = Compactor(work, CompactionPolicy(min_bytes=1)) \
+            .compact_once(force=True)
+        compaction_s = time.perf_counter() - t0
+        idx_hits, idx_p50_ms = timed_queries()
+        identical = full_hits == idx_hits
+        speedup = (full_p50_ms / idx_p50_ms) if idx_p50_ms > 0 \
+            else float("inf")
+        reads = dict(store.last_read_stats)
+
+        from peasoup_tpu.obs.history import (append_history,
+                                             make_history_record)
+        append_history(make_history_record(
+            "store",
+            {"store_query_p50_ms": round(idx_p50_ms, 3),
+             "store_query_full_scan_p50_ms": round(full_p50_ms, 3),
+             "store_query_speedup": round(speedup, 2),
+             "compaction_s": round(compaction_s, 3),
+             "store_records": written},
+            config={"shards": len(shards), "queries": len(probe_freqs),
+                    "identical": bool(identical)}), history)
+        out = {
+            "metric": "store_query_p50_ms",
+            "value": round(idx_p50_ms, 3), "unit": "ms",
+            "full_scan_p50_ms": round(full_p50_ms, 3),
+            "speedup": round(speedup, 2),
+            "compaction_s": round(compaction_s, 3),
+            "records": written, "shards": len(shards),
+            "sealed_records": report.get("records"),
+            "identical": bool(identical),
+            "read_stats": reads,
+        }
+        ok = identical and report.get("compacted", False)
+        if not ok:
+            out["error"] = ("indexed query diverged from full scan"
+                            if not identical else "compaction failed")
+        print(json.dumps(out))
+        return 0 if ok else 1
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def trace_arg(argv: list[str]) -> str | None:
     """``--trace [path]``: write a Chrome trace-event JSON of the
     benchmark's spans (default ./bench_trace.json)."""
@@ -823,6 +935,9 @@ def main() -> None:
     cs = coldstart_arg(sys.argv[1:])
     if cs is not None:
         sys.exit(run_coldstart_bench(cs))
+    st = store_arg(sys.argv[1:])
+    if st is not None:
+        sys.exit(run_store_bench(st))
     trace_path = trace_arg(sys.argv[1:])
     from peasoup_tpu.io import read_filterbank
     from peasoup_tpu.obs.metrics import REGISTRY, install_compile_hook

@@ -1,4 +1,5 @@
-"""Artifact contracts: atomic writes (PSL012), stream schemas (PSL013).
+"""Artifact contracts: atomic writes (PSL012/PSL014), stream schemas
+(PSL013).
 
 **PSL012 — atomic-write discipline.**  OBSERVABILITY.md's first
 shared design rule is rename atomicity: a killed writer leaves the
@@ -12,6 +13,20 @@ tolerated by every reader) and binary payload streaming (``"wb"``:
 the injection harness's ``.fil`` writer) are exempt; this rule is
 about truncate-in-place races on spool records, leases, reports,
 sidecars and indexes.
+
+**PSL014 — rename publication discipline.**  PSL012 proves nobody
+truncates in place, but only for *constant text* modes — a dynamic
+``mode=`` expression or a binary update mode (``"wb+"``) slips
+through, and a hand-rolled ``tmp + os.replace`` inside ``serve/`` /
+``obs/`` re-implements atomicio minus its unlink-on-error and opt-in
+fsync (exactly the gap a killed segment/index writer would fall
+into — ISSUE 20's compactor is why this rule exists).  So in the
+scanned planes: ``open`` modes must be string literals and must not
+be binary update modes, and ``os.replace`` / ``os.rename`` may
+appear only in ``serve/queue.py`` (the spool's state machine — the
+rename IS the state transition) or as the sanctioned shard-rotation
+idiom ``os.replace(path, path + ".1")``.  Everything else publishes
+through :mod:`peasoup_tpu.utils.atomicio`.
 
 **PSL013 — stream contracts.**  :mod:`peasoup_tpu.obs.streams`
 declares each artifact stream's schema (version, required/optional
@@ -74,6 +89,96 @@ class AtomicWriteRule(Rule):
                 f"through peasoup_tpu.utils.atomicio "
                 f"(atomic_write_text/json: tmp + os.replace, opt-in "
                 f"fsync) so a killed writer never leaves a torn file")
+
+
+# --------------------------------------------------------------------------
+# PSL014 — rename publication discipline
+# --------------------------------------------------------------------------
+
+#: binary truncate-and-read-back modes: in-place update of a payload
+#: file (plain ``"wb"`` payload streaming stays legal, as in PSL012)
+_BINARY_UPDATE = {"wb+", "w+b", "bw+", "+wb", "b+w", "+bw"}
+
+#: the one module whose renames ARE the product: the spool state
+#: machine (a job changes state by os.rename of its record file)
+_RENAME_SANCTIONED = ("serve/queue.py",)
+
+
+def _is_rotation_dst(node: ast.AST) -> bool:
+    """The sanctioned shard-rotation spelling: destination is
+    ``<expr> + ".1"`` (telemetry/compilation/warehouse/lineage/events
+    all rotate their JSONL shard this way)."""
+    return (isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Add)
+            and isinstance(node.right, ast.Constant)
+            and node.right.value == ".1")
+
+
+class RenameDisciplineRule(Rule):
+    """Dynamic/binary-update ``open`` modes and hand-rolled
+    ``os.replace``/``os.rename`` publication in the serve/obs planes
+    must go through ``peasoup_tpu.utils.atomicio``."""
+
+    id = "PSL014"
+    title = "non-atomicio rename publication / unprovable open mode"
+
+    def applies(self, relpath: str) -> bool:
+        return _in_pkg(relpath, "serve", "obs")
+
+    def run(self, sf: SourceFile):
+        sanctioned = any(sf.relpath.endswith(s)
+                         for s in _RENAME_SANCTIONED)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "open"):
+                yield from self._check_open(sf, node)
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("replace", "rename")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"
+                    and not sanctioned):
+                yield from self._check_rename(sf, node)
+
+    def _check_open(self, sf, node):
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return  # default "r": provably non-truncating
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)):
+            yield sf.violation(
+                self.id, node,
+                "open() mode is a runtime expression — PSL012 can "
+                "only prove atomic-write discipline for literal "
+                "modes; spell the mode as a string constant (or "
+                "write through peasoup_tpu.utils.atomicio)")
+        elif mode.value in _BINARY_UPDATE:
+            yield sf.violation(
+                self.id, node,
+                f"open(..., {mode.value!r}) truncates a binary "
+                f"artifact in place; stage the new payload through "
+                f"peasoup_tpu.utils.atomicio (tmp + os.replace) "
+                f"instead of updating it under readers")
+
+    def _check_rename(self, sf, node):
+        if len(node.args) >= 2 and _is_rotation_dst(node.args[1]):
+            return  # shard rotation: os.replace(path, path + ".1")
+        yield sf.violation(
+            self.id, node,
+            "hand-rolled os.replace/os.rename publication — use "
+            "peasoup_tpu.utils.atomicio (atomic_write_text/json or "
+            "the atomic_writer context manager: tmp naming, "
+            "unlink-on-error, opt-in fsync) so every artifact "
+            "publication shares one proven spelling; only "
+            "serve/queue.py's state machine and the "
+            "`os.replace(path, path + \".1\")` shard rotation are "
+            "sanctioned")
 
 
 # --------------------------------------------------------------------------

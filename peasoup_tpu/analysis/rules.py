@@ -33,6 +33,10 @@ PSL012   truncating ``open(path, "w")`` under ``serve/``/``obs/``
 PSL013   artifact-stream record key or schema version outside the
          declared contract in ``obs/streams.py`` (undeclared writer
          key, impossible reader key, drifted version constant)
+PSL014   non-atomicio rename publication under ``serve/``/``obs/``:
+         dynamic/binary-update ``open`` modes PSL012 cannot prove,
+         and direct ``os.replace``/``os.rename`` outside the spool
+         state machine and the ``path + ".1"`` shard rotation
 =======  ==========================================================
 
 Jit detection is syntactic and intra-module: a function is "known
@@ -784,7 +788,8 @@ class MetricsCatalogRule(Rule):
 # imported at the tail so concurrency/contracts can subclass Rule
 # (defined above) without a cycle at module-init time
 from .concurrency import LockDisciplineRule, LockOrderRule  # noqa: E402
-from .contracts import AtomicWriteRule, StreamContractRule  # noqa: E402
+from .contracts import (AtomicWriteRule, RenameDisciplineRule,  # noqa: E402
+                        StreamContractRule)
 
 ALL_RULES: tuple[Rule, ...] = (
     NoBareWarningsRule(),
@@ -800,6 +805,7 @@ ALL_RULES: tuple[Rule, ...] = (
     LockOrderRule(),
     AtomicWriteRule(),
     StreamContractRule(),
+    RenameDisciplineRule(),
 )
 
 

@@ -120,6 +120,10 @@ CATALOG: dict[str, str] = {
     "search.fft_size": "(gauge) padded FFT size of the run",
     "search.n_devices": "(gauge) devices the run sharded over",
     "search.n_dm_trials": "(gauge) DM trials of the run",
+    # -- survey store (ISSUE 20) --------------------------------------------
+    "store.compactions": "shard tails folded into sealed segments",
+    "store.compacted_records": "records sealed into segments",
+    "store.query_requests": "query-service requests answered",
     # -- supervisor ---------------------------------------------------------
     "supervisor.actions": "supervisor actions executed",
     "supervisor.throttled": "supervisor actions skipped by the "

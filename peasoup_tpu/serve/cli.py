@@ -310,6 +310,49 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--width", type=int, default=40,
                     help="waterfall bar width in characters")
 
+    pk = sub.add_parser(
+        "compact",
+        help="fold unsealed store shard tails into sealed, indexed "
+             "segments (ISSUE 20)")
+    pk.add_argument("--min-bytes", type=int, default=None,
+                    help="size threshold: fold tails at/above this "
+                         "many bytes (default: the compactor's "
+                         "1 MiB)")
+    pk.add_argument("--min-age", type=float, default=None,
+                    help="age threshold: also fold tails whose shard "
+                         "has been quiet this many seconds")
+    pk.add_argument("--force", action="store_true",
+                    help="fold every non-empty tail regardless of "
+                         "thresholds")
+    pk.add_argument("--status", action="store_true",
+                    help="print the segment manifest summary and "
+                         "exit without compacting")
+    pk.add_argument("--history", action="store_true",
+                    help="append a kind:'store' ledger record with "
+                         "compaction_s to the bench history")
+    pk.add_argument("--fault-stage", default=None,
+                    help="chaos drills only: die (os._exit) at this "
+                         "compaction stage (scan, segment_partial, "
+                         "segment_done, index_done, pre_manifest)")
+
+    pv2 = sub.add_parser(
+        "query-service",
+        help="long-lived science-query loop over the store "
+             "(query/coincidence/why reads via the file inbox, "
+             "per-request latency ledger records)")
+    pv2.add_argument("--poll", type=float, default=0.5,
+                     help="inbox poll interval in seconds")
+    pv2.add_argument("--max-requests", type=int, default=0,
+                     help="exit after answering this many requests "
+                          "(0 = serve forever)")
+    pv2.add_argument("--once", action="store_true",
+                     help="drain the inbox once and exit (drills, "
+                          "tests)")
+    pv2.add_argument("--ledger", dest="ledger_path", default=None,
+                     help="bench-history ledger to append "
+                          "kind:'query' records to (default: the "
+                          "repo ledger)")
+
     pr = sub.add_parser("requeue", help="move jobs back to pending")
     pr.add_argument("job_ids", nargs="*", help="specific job ids")
     pr.add_argument("--running", action="store_true",
@@ -832,8 +875,10 @@ def cmd_why(spool, args) -> int:
 
     cid = args.candidate_id
     store = ShardedCandidateStore(spool.root)
-    matches = [r for r in store.records(include_canary=True)
-               if str(r.get("cand_id", "")).startswith(cid)]
+    # sidecar-index lookup (ISSUE 20): on a compacted store the
+    # record join is a cand_id -> segment+offset map hit plus a tail
+    # stream — never a shard scan
+    matches = [rec for rec, _origin in store.lookup(cid)]
     ids = sorted({r["cand_id"] for r in matches})
     if len(ids) > 1:
         print(f"candidate id prefix {cid!r} is ambiguous: "
@@ -952,6 +997,78 @@ def cmd_coincidence(spool, args) -> int:
     return 0
 
 
+def cmd_compact(spool, args) -> int:
+    from .compaction import (CompactionLocked, CompactionPolicy,
+                             Compactor, shard_tail_sizes)
+    from .segments import load_manifest
+
+    if args.status:
+        man = load_manifest(spool.root)
+        segs = man.get("segments", [])
+        total = sum(int(s.get("records", 0)) for s in segs)
+        print(f"{len(segs)} sealed segment(s), {total} record(s)")
+        for s in segs:
+            print(f"  {s['name']}: {s['records']} rec  "
+                  f"{s['bytes']} B  "
+                  f"f=[{s['freq_min']:.6f}, {s['freq_max']:.6f}] Hz")
+        for base, tail in sorted(shard_tail_sizes(spool.root).items()):
+            print(f"  tail {base}: {tail} unsealed byte(s)")
+        return 0
+
+    kw = {}
+    if args.min_bytes is not None:
+        kw["min_bytes"] = args.min_bytes
+    policy = CompactionPolicy(min_age_s=args.min_age, **kw)
+    fault = None
+    if args.fault_stage:
+        # chaos drills: die with the disk in exactly the state a
+        # SIGKILLed compactor would leave (no unwind, no cleanup)
+        stage = args.fault_stage
+
+        def fault(s, _stage=stage):
+            if s == _stage:
+                os._exit(137)
+
+    comp = Compactor(spool.root, policy,
+                     **({"fault": fault} if fault else {}))
+    try:
+        report = comp.compact_once(force=args.force)
+    except CompactionLocked as exc:
+        print(f"compaction locked: {exc}", file=sys.stderr)
+        return 1
+    if report.get("compacted"):
+        print(f"sealed {report['segment']}: {report['records']} "
+              f"record(s) from {len(report['shards'])} shard(s) in "
+              f"{report['duration_s']:.3f}s "
+              f"({report['duplicates_dropped']} duplicate(s) "
+              f"dropped, {report['supersedes']} superseded)")
+    else:
+        print(f"nothing to compact ({report.get('reason', '?')})")
+    if args.history and report.get("compacted"):
+        from ..obs.history import append_history, make_history_record
+        append_history(make_history_record(
+            "store",
+            {"compaction_s": report["duration_s"],
+             "compacted_records": report["records"]},
+            config={"spool": spool.root,
+                    "segment": report["segment"]},
+            extra={"utc": round(time.time(), 3)}))
+    return 0
+
+
+def cmd_query_service(spool, args) -> int:
+    from .query_service import QueryService
+
+    svc = QueryService(spool.root, ledger_path=args.ledger_path)
+    if args.once:
+        served = svc.poll_once()
+    else:
+        served = svc.run(poll_s=args.poll,
+                         max_requests=args.max_requests)
+    print(f"query-service answered {served} request(s)")
+    return 0
+
+
 def cmd_timeline(spool, args) -> int:
     import json
 
@@ -1026,6 +1143,8 @@ def main(argv=None) -> int:
         "why": cmd_why,
         "query": cmd_query,
         "coincidence": cmd_coincidence,
+        "compact": cmd_compact,
+        "query-service": cmd_query_service,
         "timeline": cmd_timeline,
         "requeue": cmd_requeue,
     }[args.verb](spool, args)

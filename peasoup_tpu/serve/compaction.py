@@ -1,0 +1,376 @@
+"""Background compaction: fold live JSONL shard tails into sealed,
+indexed segments (ISSUE 20).
+
+Lifecycle of one ``compact_once``::
+
+    lock        segments/compact.lock (O_EXCL; stale/dead-pid steal)
+    plan        per shard: size - folded_offset tail, keep tails past
+                the size/age threshold (all of them under ``force``)
+    scan        parse each eligible tail's COMPLETE lines only — a
+                torn last line stays live, exactly as readers treat it
+    dedup       same cand_id ingested twice -> newest record wins
+                (utc, then pinned shard order); ids already sealed in
+                older segments go to the new segment's ``supersedes``
+    seal        write seg-<seq>.jsonl + seg-<seq>.idx.json, each via
+                write-temp-then-atomic-rename (segments.write_segment)
+    publish     write MANIFEST.json (fsync'd atomic replace) — THE
+                commit point: folded offsets advance and the segment
+                becomes visible in the same rename
+    rebuild     reset each folded shard's live-tail coincidence bins
+                to start at the new folded offset (the sealed bins now
+                live in the segment's sidecar)
+
+A compactor killed anywhere before ``publish`` changes nothing a
+reader can see: orphan ``seg-*`` / temp files are ignored (the
+manifest is the only source of truth) and removed by the next run.
+Shard files are never truncated or rewritten — they are append-only
+for live writers; folding only advances the manifest offset at which
+merged readers start the tail.  ``fault`` is the chaos hook
+(tools/chaos.py ``compactor_kill``): stages named in
+:func:`segments.write_segment` plus ``"scan"`` and ``"pre_manifest"``
+let a drill die at every syscall boundary a SIGKILL could hit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..obs.metrics import REGISTRY as METRICS
+from .segments import (SEG_PREFIX, SegmentSet, _noop_fault,
+                       load_manifest, segment_dir, update_bins_file,
+                       write_manifest, write_segment)
+from .store import LEGACY_BASENAME, SHARD_PREFIX
+
+#: default size threshold: a shard tail at/above this many bytes is
+#: eligible for folding
+DEFAULT_MIN_BYTES = 1 << 20
+
+#: a compact.lock older than this whose owner pid is gone is stolen
+DEFAULT_LOCK_STALE_S = 600.0
+
+LOCK_BASENAME = "compact.lock"
+
+
+class CompactionPolicy:
+    """When is a shard tail sealed?  ``min_bytes`` (size pressure) OR
+    ``min_age_s`` since last append (quiet shards drain eventually);
+    ``min_age_s=None`` disables the age trigger."""
+
+    def __init__(self, min_bytes: int = DEFAULT_MIN_BYTES,
+                 min_age_s: float | None = None):
+        self.min_bytes = int(min_bytes)
+        self.min_age_s = (None if min_age_s is None
+                          else float(min_age_s))
+
+    def eligible(self, tail_bytes: int, age_s: float) -> bool:
+        if tail_bytes <= 0:
+            return False
+        if tail_bytes >= self.min_bytes:
+            return True
+        return (self.min_age_s is not None
+                and age_s >= self.min_age_s)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (OSError, ValueError, TypeError):
+        return False
+    return True
+
+
+class CompactionLocked(RuntimeError):
+    """Another compactor holds the store's compaction lock."""
+
+
+class Compactor:
+    """One store's compactor.  Safe to run concurrently with live
+    ingests and merged reads; NOT safe to run two of per store, which
+    the lock file enforces."""
+
+    def __init__(self, root: str, policy: CompactionPolicy | None = None,
+                 *, fault=_noop_fault, clock=time.time,
+                 lock_stale_s: float = DEFAULT_LOCK_STALE_S):
+        self.root = os.path.abspath(root)
+        self.policy = policy or CompactionPolicy()
+        self.fault = fault
+        self.clock = clock
+        self.lock_stale_s = float(lock_stale_s)
+
+    # -- lock --------------------------------------------------------------
+
+    def _lock_path(self) -> str:
+        return os.path.join(segment_dir(self.root), LOCK_BASENAME)
+
+    def _acquire_lock(self) -> None:
+        os.makedirs(segment_dir(self.root), exist_ok=True)
+        path = self._lock_path()
+        for attempt in (0, 1):
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if attempt or not self._lock_is_stale(path):
+                    raise CompactionLocked(path)
+                try:
+                    os.unlink(path)  # dead owner: steal
+                except OSError:
+                    pass
+                continue
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump({"pid": os.getpid(),
+                           "utc": float(self.clock())}, f)
+            return
+
+    def _lock_is_stale(self, path: str) -> bool:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return True  # unreadable lock: treat as crashed owner
+        age = float(self.clock()) - float(doc.get("utc", 0.0))
+        if not _pid_alive(doc.get("pid", -1)):
+            return True  # owner died (a SIGKILL'd drill, a crash)
+        # owner looks alive — could be a recycled pid or another
+        # host's compactor that wedged; steal only past the deadline
+        return age >= self.lock_stale_s
+
+    def _release_lock(self) -> None:
+        try:
+            os.unlink(self._lock_path())
+        except OSError:
+            pass
+
+    # -- planning ----------------------------------------------------------
+
+    def _live_files(self) -> list[str]:
+        """Live JSONL files in the store's pinned merge order
+        (store.ShardedCandidateStore.shard_files: legacy first, then
+        shards by basename)."""
+        out = []
+        legacy = os.path.join(self.root, LEGACY_BASENAME)
+        if os.path.exists(legacy):
+            out.append(legacy)
+        try:
+            names = sorted(
+                n for n in os.listdir(self.root)
+                if n.startswith(SHARD_PREFIX) and n.endswith(".jsonl"))
+        except OSError:
+            names = []
+        out.extend(os.path.join(self.root, n) for n in names)
+        return out
+
+    def plan(self, *, force: bool = False) -> list[dict]:
+        """Eligible shard tails: ``[{path, basename, start, end}]``
+        with ``end`` clamped to the last complete line later, at scan
+        time."""
+        man = load_manifest(self.root)
+        now = float(self.clock())
+        out = []
+        for path in self._live_files():
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            base = os.path.basename(path)
+            start = int((man.get("folded") or {}).get(base, {})
+                        .get("bytes", 0))
+            tail = int(st.st_size) - start
+            age = max(0.0, now - float(st.st_mtime))
+            if force and tail > 0:
+                out.append({"path": path, "basename": base,
+                            "start": start, "end": int(st.st_size)})
+            elif self.policy.eligible(tail, age):
+                out.append({"path": path, "basename": base,
+                            "start": start, "end": int(st.st_size)})
+        return out
+
+    # -- scan --------------------------------------------------------------
+
+    @staticmethod
+    def _scan_tail(path: str, start: int, end: int):
+        """Parse the complete lines of ``path[start:end]``; returns
+        ``(records, consumed_bytes, science_count)``.  Records keep
+        canary tags (segments store everything); lines that no reader
+        would ever surface (corrupt JSON, non-dict, missing ``freq``)
+        are folded away — they were already invisible."""
+        try:
+            with open(path, "rb") as f:
+                f.seek(start)
+                data = f.read(max(0, end - start))
+        except OSError:
+            return [], 0, 0
+        cut = data.rfind(b"\n")
+        if cut < 0:
+            return [], 0, 0
+        data = data[:cut + 1]
+        recs = []
+        science = 0
+        for raw in data.splitlines():
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict) or "freq" not in rec:
+                continue
+            recs.append(rec)
+            if not rec.get("canary"):
+                science += 1
+        return recs, len(data), science
+
+    # -- the fold ----------------------------------------------------------
+
+    def compact_once(self, *, force: bool = False) -> dict:
+        """One full fold; returns a report dict.  ``compacted`` is
+        False (with a ``reason``) when there is nothing to do or the
+        lock is held elsewhere."""
+        t0 = float(self.clock())
+        try:
+            self._acquire_lock()
+        except CompactionLocked:
+            return {"compacted": False, "reason": "locked"}
+        try:
+            return self._compact_locked(force=force, t0=t0)
+        finally:
+            self._release_lock()
+
+    def _compact_locked(self, *, force: bool, t0: float) -> dict:
+        man = load_manifest(self.root)
+        self._clean_orphans(man)
+        plan = self.plan(force=force)
+        if not plan:
+            return {"compacted": False, "reason": "no eligible tails"}
+        self.fault("scan")
+
+        folded: list[tuple[int, int, dict]] = []  # (shard#, line#, rec)
+        per_shard: dict[str, dict] = {}
+        for si, item in enumerate(plan):
+            recs, consumed, science = self._scan_tail(
+                item["path"], item["start"], item["end"])
+            if consumed <= 0:
+                continue
+            per_shard[item["basename"]] = {
+                "bytes": item["start"] + consumed,
+                "records": science,
+            }
+            for li, rec in enumerate(recs):
+                folded.append((si, li, rec))
+        if not per_shard:
+            return {"compacted": False, "reason": "no complete lines"}
+
+        # dedup: newest (utc, shard order, line order) wins per cand_id
+        keep: dict[str, tuple] = {}
+        anonymous: list[dict] = []
+        for si, li, rec in folded:
+            cid = rec.get("cand_id")
+            if not cid:
+                anonymous.append(rec)
+                continue
+            key = (float(rec.get("utc", 0.0)), si, li)
+            prev = keep.get(str(cid))
+            if prev is None or key > prev[0]:
+                keep[str(cid)] = (key, rec)
+        records = anonymous + [rec for _, rec in keep.values()]
+        duplicates = len(folded) - len(records)
+
+        # ids re-ingested after an earlier seal: the old sealed copy
+        # is superseded by this segment
+        segs = SegmentSet(self.root)
+        supersedes = [cid for cid in keep if segs.contains_cand(cid)]
+
+        report = {
+            "compacted": True,
+            "records": len(records),
+            "duplicates_dropped": duplicates,
+            "supersedes": len(supersedes),
+            "shards": sorted(per_shard),
+        }
+        new_man = {
+            "v": man.get("v", 1),
+            "seq": int(man.get("seq", 0)),
+            "segments": list(man.get("segments") or []),
+            "folded": dict(man.get("folded") or {}),
+        }
+        if records:
+            seq = int(man.get("seq", 0)) + 1
+            entry = write_segment(self.root, seq, records,
+                                  supersedes=supersedes,
+                                  fault=self.fault)
+            entry["canary"] = sum(
+                1 for r in records if r.get("canary"))
+            new_man["seq"] = seq
+            new_man["segments"].append(entry)
+            report["segment"] = entry["name"]
+        for base, info in per_shard.items():
+            prev = new_man["folded"].get(base) or {}
+            new_man["folded"][base] = {
+                "bytes": int(info["bytes"]),
+                "records": int(prev.get("records", 0))
+                + int(info["records"]),
+            }
+        self.fault("pre_manifest")
+        write_manifest(self.root, new_man)
+
+        # live-tail coincidence bins restart at the new folded offset
+        # (sealed bins now come from the segment sidecars)
+        for base, info in per_shard.items():
+            update_bins_file(self.root, base, [],
+                             covered=int(info["bytes"]),
+                             rebuild_from=int(info["bytes"]))
+
+        METRICS.inc("store.compactions")
+        METRICS.inc("store.compacted_records", len(records))
+        report["duration_s"] = round(float(self.clock()) - t0, 6)
+        return report
+
+    def _clean_orphans(self, man: dict) -> None:
+        """Remove seg files a crashed run left unpublished.  Safe
+        under the lock: nothing outside the manifest is ever opened by
+        readers, and only seg-prefixed temp files are touched (bins
+        files have live single writers)."""
+        d = segment_dir(self.root)
+        known = {e.get("name") for e in man.get("segments") or []}
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return
+        for n in names:
+            if not n.startswith(SEG_PREFIX):
+                continue
+            stem = n.split(".", 1)[0]
+            if ".tmp" in n or stem not in known:
+                try:
+                    os.unlink(os.path.join(d, n))
+                except OSError:
+                    pass
+
+
+def shard_tail_sizes(root: str) -> dict[str, int]:
+    """Unsealed tail bytes per live shard basename — the health
+    plane's shard-size signal (serve/health.py rule_shard_backlog)."""
+    man = load_manifest(root)
+    out: dict[str, int] = {}
+    legacy = os.path.join(root, LEGACY_BASENAME)
+    paths = []
+    if os.path.exists(legacy):
+        paths.append(legacy)
+    try:
+        paths.extend(
+            os.path.join(root, n) for n in sorted(os.listdir(root))
+            if n.startswith(SHARD_PREFIX) and n.endswith(".jsonl"))
+    except OSError:
+        pass
+    for path in paths:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            continue
+        base = os.path.basename(path)
+        start = int((man.get("folded") or {}).get(base, {})
+                    .get("bytes", 0))
+        out[base] = max(0, int(size) - start)
+    return out

@@ -51,6 +51,7 @@ from ..obs.telemetry import (
     latest_by_host,
     read_samples,
 )
+from .compaction import DEFAULT_MIN_BYTES, shard_tail_sizes
 from .queue import JobSpool
 
 OK = "ok"
@@ -76,6 +77,12 @@ DEFAULT_SLO = {
     # queue-wait + job targets — the latency a SUBMITTER experiences
     "sojourn_p50_s": 960.0,
     "sojourn_p95_s": 4200.0,
+    # science-query latency over the survey store (ISSUE 20): the
+    # query service's per-request kind:"query" ledger records; in
+    # MILLISECONDS — an indexed read is three orders of magnitude
+    # below the job-latency scale and its SLO should say so
+    "query_p50_ms": 250.0,
+    "query_p95_ms": 2000.0,
 }
 
 #: retry/quarantine/reap thresholds for the spike rules (per window)
@@ -126,6 +133,10 @@ class HealthContext:
     #: (cheap, header-free proxy for the batcher's bucket key), capped
     #: at _BUCKET_SCAN_CAP records so health stays O(small)
     pending_buckets: dict = field(default_factory=dict)
+    #: candidate-store unsealed tail bytes per shard basename
+    #: (serve/compaction.shard_tail_sizes) — the shard-size signal the
+    #: compaction rule and supervisor action key on (ISSUE 20)
+    store_tails: dict = field(default_factory=dict)
 
 
 def default_ts_dir(spool: JobSpool) -> str:
@@ -183,11 +194,12 @@ def build_context(spool: JobSpool, *, ts_dir: str | None = None,
         running=running,
         ledger=load_history(ledger_path or default_ledger_path(),
                             kinds=("serve", "loadgen", "sensitivity",
-                                   "anomaly")),
+                                   "anomaly", "query")),
         window_s=float(window_s),
         stale_after=float(stale_after),
         slo=targets,
         pending_buckets=pending_bucket_mix(spool),
+        store_tails=shard_tail_sizes(spool.root),
     )
 
 
@@ -802,6 +814,100 @@ def rule_distill_collapse(ctx: HealthContext) -> list[HealthFinding]:
         f"funnel pass {head_pass:.2f} / absorbed {head_abs:.2f} vs "
         f"baseline medians {med_pass:.2f} / {med_abs:.2f}",
         data=data)]
+
+
+@health_rule
+def rule_query_latency(ctx: HealthContext) -> list[HealthFinding]:
+    """Science-query latency SLO (ISSUE 20): the query service
+    appends one ``kind:"query"`` ledger record per request
+    (serve/query_service.py).  Compare the window's p50/p95 against
+    the ``query_p50_ms``/``query_p95_ms`` targets: warn when p50
+    breaches its target, crit when p95 breaches (tail latency is what
+    an interactive science session feels) or p50 blows through the
+    p95 budget.  No query traffic in the window = ok — an idle
+    service is not an unhealthy one."""
+    lat = [
+        float(r["metrics"]["query_latency_ms"])
+        for r in ctx.ledger
+        if r.get("kind") == "query"
+        and isinstance(r.get("metrics", {}).get("query_latency_ms"),
+                       (int, float))
+        and float(r.get("utc", 0.0)) >= ctx.now - ctx.window_s
+    ]
+    if not lat:
+        return [HealthFinding(
+            "query_latency", OK,
+            "no query traffic in the window", data={"requests": 0})]
+    p50 = percentile(lat, 0.50)
+    p95 = percentile(lat, 0.95)
+    t50 = float(ctx.slo.get("query_p50_ms",
+                            DEFAULT_SLO["query_p50_ms"]))
+    t95 = float(ctx.slo.get("query_p95_ms",
+                            DEFAULT_SLO["query_p95_ms"]))
+    data = {"requests": len(lat), "p50_ms": round(p50, 3),
+            "p95_ms": round(p95, 3), "target_p50_ms": t50,
+            "target_p95_ms": t95}
+    if p95 > t95 or p50 > t95:
+        return [HealthFinding(
+            "query_latency", CRIT,
+            f"query p95 {p95:.0f}ms / p50 {p50:.0f}ms breach the "
+            f"{t95:.0f}ms tail budget over {len(lat)} requests — "
+            f"check shard tails (is compaction keeping up?)",
+            data=data)]
+    if p50 > t50:
+        return [HealthFinding(
+            "query_latency", WARN,
+            f"query p50 {p50:.0f}ms above the {t50:.0f}ms target "
+            f"over {len(lat)} requests", data=data)]
+    return [HealthFinding(
+        "query_latency", OK,
+        f"query p50 {p50:.0f}ms / p95 {p95:.0f}ms within targets "
+        f"over {len(lat)} requests", data=data)]
+
+
+#: shard-tail crit multiple: a tail this many times the compaction
+#: threshold means the compactor has fallen badly behind
+SHARD_TAIL_CRIT_X = 4.0
+
+
+@health_rule
+def rule_shard_backlog(ctx: HealthContext) -> list[HealthFinding]:
+    """Unsealed candidate-shard backlog (ISSUE 20): every byte past
+    the compaction threshold is a byte every query re-scans.  Warn
+    when any shard's unsealed tail reaches the compactor's size
+    threshold (``compaction.DEFAULT_MIN_BYTES``), crit at
+    :data:`SHARD_TAIL_CRIT_X` times it — the trigger the supervisor's
+    rate-limited ``compact_store`` action fires on.  No store or no
+    tails = ok."""
+    tails = {k: int(v) for k, v in (ctx.store_tails or {}).items()
+             if int(v) > 0}
+    if not tails:
+        return [HealthFinding(
+            "shard_backlog", OK, "no unsealed store tails",
+            data={"shards": 0, "tail_bytes": 0})]
+    worst_shard = max(tails, key=tails.get)
+    worst = tails[worst_shard]
+    total = sum(tails.values())
+    data = {"shards": len(tails), "tail_bytes": total,
+            "worst_shard": worst_shard, "worst_bytes": worst,
+            "threshold_bytes": int(DEFAULT_MIN_BYTES)}
+    if worst >= SHARD_TAIL_CRIT_X * DEFAULT_MIN_BYTES:
+        return [HealthFinding(
+            "shard_backlog", CRIT,
+            f"shard {worst_shard} has {worst} unsealed bytes "
+            f"(>= {SHARD_TAIL_CRIT_X:.0f}x the "
+            f"{DEFAULT_MIN_BYTES} compaction threshold) — the "
+            f"compactor is not keeping up", data=data)]
+    if worst >= DEFAULT_MIN_BYTES:
+        return [HealthFinding(
+            "shard_backlog", WARN,
+            f"shard {worst_shard} has {worst} unsealed bytes past "
+            f"the {DEFAULT_MIN_BYTES} compaction threshold",
+            data=data)]
+    return [HealthFinding(
+        "shard_backlog", OK,
+        f"{len(tails)} live tail(s), worst {worst} bytes — under "
+        f"the compaction threshold", data=data)]
 
 
 # -- SLO summary -----------------------------------------------------------

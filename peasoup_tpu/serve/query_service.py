@@ -1,0 +1,234 @@
+"""Always-on survey query service (ISSUE 20).
+
+Science queries over the candidate store are a first-class workload
+with their own SLOs, not an ad-hoc log replay: this module is the
+long-lived loop behind the ``peasoup-serve query-service`` verb.  It
+serves three read ops over the log-structured store
+(serve/store.py + serve/segments.py):
+
+``query``        harmonically related records
+                 (``freq``, ``freq_tol``, ``max_harm``)
+``coincidence``  cross-observation groups
+                 (``freq_tol``, ``min_sources``)
+``why``          record → lineage join by ``cand_id`` prefix — the
+                 sidecar-index lookup the ``why`` verb uses
+                 (``cand_id``, optional ``run_dir``)
+
+Transport is the spool's own medium — files, not sockets: a client
+drops ``queries/q-<id>.json`` (atomic rename, like every spool
+artifact) and collects ``queries/q-<id>.result.json``; the service
+polls the inbox on a ``threading.Event`` wait (PSL008-clean, same
+idiom as the supervisor loop).  In-process callers skip the files and
+call :meth:`QueryService.serve_request`.
+
+Every request appends one ``kind:"query"`` record to the bench
+history ledger (obs/history.py) with its latency and result size —
+the stream the ``query_latency`` SLO rule (serve/health.py) and the
+perf gate's ``store_query_p50_ms`` metric read."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+from ..obs.history import append_history, make_history_record
+from ..obs.metrics import REGISTRY as METRICS
+from ..utils.atomicio import atomic_write_json
+from .store import ShardedCandidateStore
+
+QUERIES_DIRNAME = "queries"
+
+REQUEST_PREFIX = "q-"
+
+#: ops the service accepts; anything else is answered with an error
+#: result (never a crash — a malformed request must not kill the loop)
+OPS = ("query", "coincidence", "why")
+
+
+def queries_dir(root: str) -> str:
+    return os.path.join(os.path.abspath(root), QUERIES_DIRNAME)
+
+
+def submit_request(root: str, req: dict) -> str:
+    """Client side: drop one request into the inbox (atomic rename so
+    the service never reads a torn request).  Returns the request id;
+    the result will land at :func:`result_path`."""
+    d = queries_dir(root)
+    os.makedirs(d, exist_ok=True)
+    rid = str(req.get("id") or uuid.uuid4().hex[:12])
+    req = dict(req, id=rid)
+    atomic_write_json(os.path.join(d, f"{REQUEST_PREFIX}{rid}.json"),
+                      req, sort_keys=True, trailing_newline=True)
+    return rid
+
+
+def result_path(root: str, rid: str) -> str:
+    return os.path.join(queries_dir(root),
+                        f"{REQUEST_PREFIX}{rid}.result.json")
+
+
+class QueryService:
+    """One store's query loop.  Injectable clock and stop event (the
+    supervisor pattern) keep it deterministic under test."""
+
+    def __init__(self, root: str, *, ledger_path: str | None = None,
+                 clock=time.perf_counter, utc=time.time,
+                 stop_event: threading.Event | None = None):
+        self.root = os.path.abspath(root)
+        self.ledger_path = ledger_path
+        self.clock = clock
+        self.utc = utc
+        self._stop = stop_event or threading.Event()
+        self.served = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- op handlers -------------------------------------------------------
+
+    def _store(self) -> ShardedCandidateStore:
+        return ShardedCandidateStore(self.root)
+
+    def _op_query(self, store, req: dict) -> dict:
+        hits = store.query(float(req["freq"]),
+                           float(req.get("freq_tol", 1e-4)),
+                           int(req.get("max_harm", 1)))
+        return {"records": hits}
+
+    def _op_coincidence(self, store, req: dict) -> dict:
+        groups = store.coincident_groups(
+            float(req.get("freq_tol", 1e-4)),
+            int(req.get("min_sources", 2)))
+        return {"groups": groups}
+
+    def _op_why(self, store, req: dict) -> dict:
+        """The ``why`` verb's record join: sidecar-index lookup of the
+        newest record per matching cand id, plus each record's origin
+        (segment name or live shard basename)."""
+        prefix = str(req.get("cand_id", ""))
+        if not prefix:
+            raise ValueError("why needs a cand_id prefix")
+        hits = store.lookup(prefix)
+        return {
+            "records": [
+                dict(rec, _origin=origin) for rec, origin in hits
+            ],
+        }
+
+    # -- request plumbing --------------------------------------------------
+
+    def serve_request(self, req: dict) -> dict:
+        """Answer one request dict; always returns a result dict
+        (``ok`` False + ``error`` on a bad request) and always appends
+        the ``kind:"query"`` latency ledger record."""
+        t0 = float(self.clock())
+        op = str(req.get("op", ""))
+        try:
+            store = self._store()
+            if op == "query":
+                body = self._op_query(store, req)
+            elif op == "coincidence":
+                body = self._op_coincidence(store, req)
+            elif op == "why":
+                body = self._op_why(store, req)
+            else:
+                raise ValueError(f"unknown op {op!r} (expected one of "
+                                 f"{', '.join(OPS)})")
+            result = {"ok": True, "op": op, **body}
+            nrec = len(body.get("records", body.get("groups", ())))
+        except (KeyError, TypeError, ValueError) as exc:
+            result = {"ok": False, "op": op, "error": str(exc)}
+            nrec = 0
+        latency_ms = (float(self.clock()) - t0) * 1000.0
+        result["latency_ms"] = round(latency_ms, 3)
+        if "id" in req:
+            result["id"] = req["id"]
+        self.served += 1
+        METRICS.inc("store.query_requests")
+        self._ledger(op, latency_ms, nrec, result["ok"])
+        return result
+
+    def _ledger(self, op: str, latency_ms: float, nrec: int,
+                ok: bool) -> None:
+        rec = make_history_record(
+            "query",
+            {"query_latency_ms": round(latency_ms, 3),
+             "result_records": int(nrec)},
+            config={"spool": self.root, "op": op, "ok": bool(ok)},
+            extra={"utc": round(float(self.utc()), 3)},
+        )
+        append_history(rec, self.ledger_path)
+
+    # -- the inbox loop ----------------------------------------------------
+
+    def poll_once(self) -> int:
+        """Serve every pending inbox request; returns how many."""
+        d = queries_dir(self.root)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            return 0
+        served = 0
+        for name in names:
+            if not name.startswith(REQUEST_PREFIX):
+                continue
+            if not name.endswith(".json") or \
+                    name.endswith(".result.json"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    req = json.load(f)
+            except (OSError, ValueError):
+                continue  # mid-rename or garbage: next poll
+            if not isinstance(req, dict):
+                req = {"op": "invalid"}
+            rid = str(req.get("id")
+                      or name[len(REQUEST_PREFIX):-len(".json")])
+            req.setdefault("id", rid)
+            result = self.serve_request(req)
+            atomic_write_json(result_path(self.root, rid), result,
+                              sort_keys=True, trailing_newline=True,
+                              default=str)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            served += 1
+        return served
+
+    def run(self, *, poll_s: float = 0.5,
+            max_requests: int = 0) -> int:
+        """The service loop: drain the inbox, wait, repeat — until
+        :meth:`stop` (or ``max_requests`` answered, for drills and
+        tests).  Returns requests served this run."""
+        served = 0
+        while not self._stop.is_set():
+            served += self.poll_once()
+            if max_requests and served >= max_requests:
+                break
+            if self._stop.wait(float(poll_s)):
+                break
+        return served
+
+
+def wait_result(root: str, rid: str, *, timeout_s: float = 30.0,
+                poll_s: float = 0.05,
+                stop_event: threading.Event | None = None) -> dict | None:
+    """Client side: block until the service answers ``rid`` (or the
+    timeout passes); waits on an Event, never a bare sleep."""
+    ev = stop_event or threading.Event()
+    path = result_path(root, rid)
+    deadline = time.monotonic() + float(timeout_s)
+    while time.monotonic() < deadline:
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            pass
+        if ev.wait(float(poll_s)):
+            return None
+    return None

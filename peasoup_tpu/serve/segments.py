@@ -1,0 +1,657 @@
+"""Sealed store segments: the log-structured layer under the survey
+store (ISSUE 20).
+
+The live store is append-only JSONL shards (serve/store.py).  That is
+the right *write* path — one atomic line append, no locks — and the
+wrong *read* path at survey scale: every query re-parses every line.
+This module adds the LSM-style read side:
+
+* **Sealed segments** (``segments/seg-<seq>.jsonl``): immutable,
+  frequency-sorted record files folded out of shard prefixes by the
+  compactor (serve/compaction.py).  Same record schema as the shards
+  (STORE.md "Record schema compatibility"), so a segment is readable
+  by any JSONL consumer.
+* **Sidecar indexes** (``seg-<seq>.idx.json``): frequency fence posts
+  (byte offset every :data:`FENCE_EVERY` records) for range reads, a
+  ``cand_id -> byte offset`` map for the ``why`` verb's record join,
+  per-frequency-bin source lists for incremental coincidence, bloom
+  summaries over sources and cand ids, and min/max summaries that let
+  a query skip whole segments.
+* **Manifest** (``segments/MANIFEST.json``): the single source of
+  truth.  It names the sealed segments (in seal order) and records,
+  per shard, how many bytes/records have been folded.  A merged read
+  is ``segments ∪ unsealed shard tails``; a segment or index file not
+  named by the manifest does not exist as far as readers are
+  concerned, which is the whole crash-safety story: the compactor
+  publishes segment, then index, then manifest (each
+  write-temp-then-atomic-rename via ``utils/atomicio``), so a
+  compactor killed at ANY point leaves the previous manifest — and
+  therefore the previous, complete view — intact.
+* **Live-tail coincidence bins** (``segments/bins-<shard>.json``):
+  per-frequency-bin source lists for the *unsealed* tail of each
+  shard, rewritten atomically by that shard's single writer on every
+  ingest.  Together with the per-segment bin summaries they make
+  ``coincident_groups()`` a seeded lookup over hot bins (the
+  reference coincidencer's per-bin beam-count masks, SURVEY.md §3.4,
+  transplanted to survey scale) instead of an O(survey) distill.
+  Bin data may safely OVER-approximate (stale files, folded overlap):
+  extra occupied/hot bins only enlarge the seed set.  Readers close
+  the under-approximation hole by scanning any shard bytes past the
+  file's ``covered`` offset inline.
+
+Retention / dedup policy: a record's identity is its ``cand_id``.
+Re-ingesting the same candidate (a re-run) REPLACES: the compactor
+drops older duplicates when sealing and records cross-segment
+replacements in the newer segment's ``supersedes`` list; merged reads
+suppress segment records whose id reappears later (a later segment's
+``supersedes`` or a live tail line).  Duplicates are therefore never
+visible through a sealed read and disappear from the physical store
+no later than the segment seal that folds them.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import math
+import os
+
+from ..utils.atomicio import (atomic_write_json, atomic_writer,
+                              fsync_dir)
+
+SEGMENTS_VERSION = 1
+
+#: subdirectory of the store root holding segments, sidecars, manifest
+SEGMENT_DIRNAME = "segments"
+
+MANIFEST_BASENAME = "MANIFEST.json"
+
+SEG_PREFIX = "seg-"
+
+#: fence-post stride: one (freq, byte offset) post per this many
+#: records — a range read over-reads at most one stride
+FENCE_EVERY = 256
+
+#: fractional width of one coincidence frequency bin; query tolerances
+#: map to a neighbour radius in bins (:func:`neighbor_radius`), so the
+#: bin grid never constrains the tolerance a caller may use
+BIN_TOL = 1e-4
+
+#: natural-log width of one bin
+_BIN_W = math.log1p(BIN_TOL)
+
+BLOOM_BITS = 1024
+BLOOM_HASHES = 3
+
+
+# -- frequency bins ---------------------------------------------------------
+
+def freq_bin(freq: float) -> int | None:
+    """Log-spaced bin index of a frequency; None for non-positive
+    frequencies (they can never satisfy a ratio-tolerance match and
+    are excluded from the bin structure)."""
+    f = float(freq)
+    if not f > 0.0 or not math.isfinite(f):
+        return None
+    return int(math.floor(math.log(f) / _BIN_W))
+
+
+def neighbor_radius(freq_tol: float) -> int:
+    """Bin radius guaranteeing: two frequencies whose ratio lies
+    within ``1 ± freq_tol`` are at most this many bins apart."""
+    return int(math.floor(math.log1p(float(freq_tol)) / _BIN_W)) + 1
+
+
+def bin_freq_range(bin_lo: int, bin_hi: int) -> tuple[float, float]:
+    """Closed frequency interval covering bins ``bin_lo..bin_hi``
+    (with slack so edge records are never missed by a range read;
+    membership is always re-checked via :func:`freq_bin`)."""
+    lo = math.exp(_BIN_W * bin_lo) * (1.0 - 1e-9)
+    hi = math.exp(_BIN_W * (bin_hi + 1)) * (1.0 + 1e-9)
+    return lo, hi
+
+
+# -- bloom summaries --------------------------------------------------------
+
+def _bloom_positions(item: str):
+    h = hashlib.sha1(str(item).encode("utf-8")).digest()
+    for i in range(BLOOM_HASHES):
+        yield int.from_bytes(h[4 * i:4 * i + 4], "big") % BLOOM_BITS
+
+
+def bloom_make(items) -> str:
+    """Hex-encoded bloom filter over ``items`` (sources, cand ids)."""
+    bits = bytearray(BLOOM_BITS // 8)
+    for item in items:
+        for pos in _bloom_positions(item):
+            bits[pos // 8] |= 1 << (pos % 8)
+    return bytes(bits).hex()
+
+
+def bloom_may_contain(hexbits: str, item: str) -> bool:
+    """False means definitely absent; True means 'check the index'."""
+    try:
+        bits = bytes.fromhex(hexbits or "")
+    except ValueError:
+        return True
+    if len(bits) != BLOOM_BITS // 8:
+        return True  # unknown bloom geometry: never rule out
+    return all(bits[p // 8] & (1 << (p % 8))
+               for p in _bloom_positions(item))
+
+
+# -- paths / manifest -------------------------------------------------------
+
+def segment_dir(root: str) -> str:
+    return os.path.join(os.path.abspath(root), SEGMENT_DIRNAME)
+
+
+def manifest_path(root: str) -> str:
+    return os.path.join(segment_dir(root), MANIFEST_BASENAME)
+
+
+def segment_name(seq: int) -> str:
+    return f"{SEG_PREFIX}{int(seq):06d}"
+
+
+def empty_manifest() -> dict:
+    return {"v": SEGMENTS_VERSION, "seq": 0, "segments": [],
+            "folded": {}}
+
+
+def load_manifest(root: str) -> dict:
+    """The current manifest, or an empty one when the store has never
+    been compacted (or the manifest is unreadable — readers then see
+    the full shards, which is always a complete view)."""
+    try:
+        with open(manifest_path(root), encoding="utf-8") as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return empty_manifest()
+    if not isinstance(man, dict) or man.get("v") != SEGMENTS_VERSION:
+        return empty_manifest()
+    man.setdefault("seq", 0)
+    man.setdefault("segments", [])
+    man.setdefault("folded", {})
+    return man
+
+
+def write_manifest(root: str, man: dict) -> None:
+    """Publish a new manifest — THE commit point of a compaction.
+    fsync'd: once a reader has seen records only via segments, losing
+    the manifest to power loss must not lose the records with it."""
+    atomic_write_json(manifest_path(root), man, fsync=True, indent=1,
+                      sort_keys=True, trailing_newline=True)
+    fsync_dir(manifest_path(root))
+
+
+def folded_offset(man: dict, shard_basename: str) -> int:
+    """Bytes of ``shard_basename`` already folded into segments; the
+    shard's live tail begins here."""
+    info = (man.get("folded") or {}).get(shard_basename) or {}
+    return int(info.get("bytes", 0))
+
+
+# -- record canonical order -------------------------------------------------
+
+def record_sort_key(rec: dict):
+    """Total order of records inside a segment (and of canonicalised
+    query results): frequency first — the index dimension — then
+    enough identity fields that the order is deterministic for any
+    record set."""
+    return (float(rec.get("freq", 0.0)), float(rec.get("utc", 0.0)),
+            str(rec.get("cand_id", "")), str(rec.get("source", "")),
+            str(rec.get("job_id", "")))
+
+
+# -- segment writer ---------------------------------------------------------
+
+def _noop_fault(stage: str) -> None:
+    return None
+
+
+def write_segment(root: str, seq: int, records: list[dict], *,
+                  supersedes=(), fault=_noop_fault) -> dict:
+    """Seal ``records`` (already deduped) as segment ``seq``: write
+    the frequency-sorted record file, then its sidecar index, each via
+    write-temp-then-atomic-rename.  Returns the manifest entry; the
+    CALLER publishes it by writing the manifest (the commit point).
+
+    ``fault(stage)`` is the chaos hook (tools/chaos.py): stages
+    ``"segment_partial"`` (half the records written to the temp
+    file), ``"segment_done"`` (temp complete, not yet renamed) and
+    ``"index_done"`` (segment + index on disk, manifest not yet
+    written) let a drill die at exactly the syscall boundaries a
+    SIGKILL could hit.
+    """
+    d = segment_dir(root)
+    os.makedirs(d, exist_ok=True)
+    name = segment_name(seq)
+    seg_path = os.path.join(d, name + ".jsonl")
+    idx_path = os.path.join(d, name + ".idx.json")
+
+    recs = sorted(records, key=record_sort_key)
+    fence: list[list] = []
+    cands: dict[str, int] = {}
+    bins: dict[str, list] = {}
+    bin_sources: dict[int, set] = {}
+    sources: set = set()
+    utc_min = utc_max = None
+    half = len(recs) // 2
+    offset = 0
+    with atomic_writer(seg_path, fsync=True) as f:
+        for i, rec in enumerate(recs):
+            if i == half:
+                fault("segment_partial")
+            line = json.dumps(rec, sort_keys=True)
+            if i % FENCE_EVERY == 0:
+                fence.append([float(rec.get("freq", 0.0)), offset])
+            cid = rec.get("cand_id")
+            if cid:
+                cands[str(cid)] = offset
+            if not rec.get("canary"):
+                src = str(rec.get("source", ""))
+                sources.add(src)
+                b = freq_bin(rec.get("freq", 0.0))
+                if b is not None:
+                    bin_sources.setdefault(b, set()).add(src)
+            utc = rec.get("utc")
+            if isinstance(utc, (int, float)):
+                utc_min = utc if utc_min is None else min(utc_min, utc)
+                utc_max = utc if utc_max is None else max(utc_max, utc)
+            f.write(line + "\n")
+            offset += len(line.encode("utf-8")) + 1
+        fault("segment_done")
+
+    for b, srcs in bin_sources.items():
+        bins[str(b)] = sorted(srcs)
+    idx = {
+        "v": SEGMENTS_VERSION,
+        "name": name,
+        "records": len(recs),
+        "bytes": offset,
+        "freq_min": float(recs[0].get("freq", 0.0)) if recs else 0.0,
+        "freq_max": float(recs[-1].get("freq", 0.0)) if recs else 0.0,
+        "utc_min": utc_min,
+        "utc_max": utc_max,
+        "sources": sorted(sources),
+        "source_bloom": bloom_make(sources),
+        "cand_bloom": bloom_make(cands),
+        "fence": fence,
+        "cands": cands,
+        "bins": bins,
+        "supersedes": sorted(str(s) for s in supersedes),
+    }
+    atomic_write_json(idx_path, idx, fsync=True, sort_keys=True,
+                      trailing_newline=True)
+    fault("index_done")
+    return {
+        "name": name,
+        "records": len(recs),
+        "bytes": offset,
+        "freq_min": idx["freq_min"],
+        "freq_max": idx["freq_max"],
+        "supersedes": len(idx["supersedes"]),
+    }
+
+
+# -- segment reader ---------------------------------------------------------
+
+#: process-wide sidecar-index cache: segments are immutable once the
+#: manifest names them, so an idx keyed by (path, size, mtime_ns) can
+#: never go stale — it only falls out when a new segment replaces the
+#: path (never happens: seq numbers are monotonic) or the cache fills
+_IDX_CACHE: dict[str, tuple[tuple, dict]] = {}
+_IDX_CACHE_MAX = 64
+
+
+def _cached_idx(path: str) -> dict | None:
+    """Load a sidecar index through the immutability cache; None when
+    the file is unreadable (caller degrades to index-less reads)."""
+    try:
+        st = os.stat(path)
+        sig = (st.st_size, st.st_mtime_ns)
+    except OSError:
+        return None
+    hit = _IDX_CACHE.get(path)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    try:
+        with open(path, encoding="utf-8") as f:
+            idx = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if len(_IDX_CACHE) >= _IDX_CACHE_MAX:
+        _IDX_CACHE.pop(next(iter(_IDX_CACHE)))
+    _IDX_CACHE[path] = (sig, idx)
+    return idx
+
+
+class Segment:
+    """One sealed segment: lazy sidecar index, streamed record file.
+    All read paths count parsed lines into ``reads`` (the shared
+    :class:`SegmentSet` counter dict) so tests can assert a query
+    touched only indexed spans."""
+
+    def __init__(self, dirpath: str, entry: dict, reads: dict):
+        self.dir = dirpath
+        self.name = str(entry.get("name", ""))
+        self.entry = entry
+        self.path = os.path.join(dirpath, self.name + ".jsonl")
+        self.idx_path = os.path.join(dirpath, self.name + ".idx.json")
+        self._idx: dict | None = None
+        self.reads = reads
+
+    @property
+    def idx(self) -> dict:
+        if self._idx is None:
+            self._idx = _cached_idx(self.idx_path)
+            if self._idx is None:
+                # index lost: degrade to an index-less segment (full
+                # streams still work; range reads scan)
+                self._idx = {"fence": [], "cands": {}, "bins": {},
+                             "sources": [], "supersedes": []}
+        return self._idx
+
+    @property
+    def records_count(self) -> int:
+        return int(self.entry.get("records", 0))
+
+    @property
+    def supersedes(self) -> set:
+        return set(self.idx.get("supersedes") or ())
+
+    def contains_cand(self, cand_id: str) -> bool:
+        idx = self.idx
+        bloom = idx.get("cand_bloom")
+        if bloom and not bloom_may_contain(bloom, cand_id):
+            return False
+        return str(cand_id) in (idx.get("cands") or {})
+
+    def may_contain_source(self, source: str) -> bool:
+        bloom = self.idx.get("source_bloom")
+        if bloom and not bloom_may_contain(bloom, str(source)):
+            return False
+        srcs = self.idx.get("sources")
+        return (str(source) in srcs) if srcs else True
+
+    def bin_sources(self) -> dict[int, set]:
+        out: dict[int, set] = {}
+        for key, srcs in (self.idx.get("bins") or {}).items():
+            try:
+                out[int(key)] = set(srcs)
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    def _iter_lines(self, start: int = 0, counter: str = "segment_lines"):
+        try:
+            f = open(self.path, "rb")
+        except OSError:
+            return
+        with f:
+            if start:
+                f.seek(start)
+            for raw in f:
+                if not raw.endswith(b"\n"):
+                    return  # torn tail can't exist in a sealed file,
+                    # but never yield a partial line regardless
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                self.reads[counter] = self.reads.get(counter, 0) + 1
+                yield rec
+
+    def iter_records(self):
+        """All records, segment (frequency) order."""
+        return self._iter_lines()
+
+    def lookup(self, cand_id: str) -> dict | None:
+        """Index-read one record by exact cand id: one seek + one
+        line, never a scan."""
+        off = (self.idx.get("cands") or {}).get(str(cand_id))
+        if off is None:
+            return None
+        for rec in self._iter_lines(int(off), counter="lookup_lines"):
+            return rec
+        return None
+
+    def iter_freq_range(self, lo: float, hi: float):
+        """Records with ``lo <= freq <= hi`` via fence-post seek: jump
+        to the last post at or before ``lo``, stop at the first record
+        past ``hi`` (the file is frequency-sorted)."""
+        entry_lo = self.entry.get("freq_min", self.idx.get("freq_min"))
+        entry_hi = self.entry.get("freq_max", self.idx.get("freq_max"))
+        if entry_lo is not None and float(entry_hi) < lo:
+            self.reads["segments_skipped"] = \
+                self.reads.get("segments_skipped", 0) + 1
+            return
+        if entry_lo is not None and float(entry_lo) > hi:
+            self.reads["segments_skipped"] = \
+                self.reads.get("segments_skipped", 0) + 1
+            return
+        fence = self.idx.get("fence") or []
+        start = 0
+        if fence:
+            freqs = [p[0] for p in fence]
+            i = bisect.bisect_right(freqs, lo) - 1
+            if i >= 0:
+                start = int(fence[i][1])
+            self.reads["fence_seeks"] = \
+                self.reads.get("fence_seeks", 0) + 1
+        for rec in self._iter_lines(start, counter="range_lines"):
+            f = float(rec.get("freq", 0.0))
+            if f > hi:
+                return
+            if f >= lo:
+                yield rec
+
+
+class SegmentSet:
+    """The sealed half of a store: manifest + segments, loaded fresh
+    per logical read so concurrent compactions are seen atomically
+    (a reader holds ONE manifest for the whole read — either the old
+    complete view or the new one, never a mix)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.dir = segment_dir(self.root)
+        self.manifest = load_manifest(self.root)
+        self.reads: dict[str, int] = {}
+        self.segments = [
+            Segment(self.dir, entry, self.reads)
+            for entry in self.manifest.get("segments") or []
+        ]
+
+    def __bool__(self) -> bool:
+        return bool(self.segments)
+
+    def folded_offset(self, shard_basename: str) -> int:
+        return folded_offset(self.manifest, shard_basename)
+
+    def folded_records(self, shard_basename: str) -> int:
+        info = (self.manifest.get("folded") or {}).get(
+            shard_basename) or {}
+        return int(info.get("records", 0))
+
+    def total_records(self) -> int:
+        return sum(s.records_count for s in self.segments)
+
+    def suppressed_for(self, i: int) -> set:
+        """cand ids that later segments supersede — records in
+        segment ``i`` carrying one of these ids are replaced."""
+        out: set = set()
+        for later in self.segments[i + 1:]:
+            out |= later.supersedes
+        return out
+
+    def contains_cand(self, cand_id: str) -> bool:
+        return any(s.contains_cand(cand_id) for s in self.segments)
+
+    def lookup(self, cand_id: str):
+        """Newest sealed record for an exact cand id, plus the segment
+        name it lives in: ``(record, segment_name)`` or None."""
+        for i in range(len(self.segments) - 1, -1, -1):
+            seg = self.segments[i]
+            if not seg.contains_cand(cand_id):
+                continue
+            if cand_id in self.suppressed_for(i):
+                continue
+            rec = seg.lookup(cand_id)
+            if rec is not None:
+                return rec, seg.name
+        return None
+
+    def lookup_prefix(self, prefix: str):
+        """All sealed (record, segment_name) pairs whose cand id
+        starts with ``prefix`` — an index-key scan, never a record
+        scan."""
+        out = []
+        for i, seg in enumerate(self.segments):
+            suppressed = self.suppressed_for(i)
+            for cid in (seg.idx.get("cands") or {}):
+                if cid.startswith(prefix) and cid not in suppressed:
+                    rec = seg.lookup(cid)
+                    if rec is not None:
+                        out.append((rec, seg.name))
+        return out
+
+    def bin_sources(self) -> dict[int, set]:
+        """Union of per-segment frequency-bin source lists."""
+        out: dict[int, set] = {}
+        for seg in self.segments:
+            for b, srcs in seg.bin_sources().items():
+                out.setdefault(b, set()).update(srcs)
+        return out
+
+
+# -- live-tail coincidence bins --------------------------------------------
+
+def bins_path(root: str, shard_basename: str) -> str:
+    return os.path.join(segment_dir(root),
+                        f"bins-{shard_basename}.json")
+
+
+def load_bins_file(root: str, shard_basename: str) -> dict:
+    try:
+        with open(bins_path(root, shard_basename),
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {"v": SEGMENTS_VERSION, "start": 0, "covered": 0,
+                "bins": {}}
+    if not isinstance(doc, dict) or doc.get("v") != SEGMENTS_VERSION:
+        return {"v": SEGMENTS_VERSION, "start": 0, "covered": 0,
+                "bins": {}}
+    doc.setdefault("start", 0)
+    doc.setdefault("covered", 0)
+    doc.setdefault("bins", {})
+    return doc
+
+
+def update_bins_file(root: str, shard_basename: str, records,
+                     covered: int, *, rebuild_from: int | None = None,
+                     start: int | None = None) -> None:
+    """Merge ``records``' (bin, source) pairs into the shard's live
+    bin file and advance its ``covered`` byte offset (atomic replace;
+    the shard's single writer is the only caller).  With
+    ``rebuild_from`` the file is reset to cover ``[rebuild_from,
+    covered)`` — the post-compaction shrink that drops bins the
+    sealed segments now carry."""
+    doc = load_bins_file(root, shard_basename)
+    if rebuild_from is not None:
+        doc = {"v": SEGMENTS_VERSION, "start": int(rebuild_from),
+               "covered": int(rebuild_from), "bins": {}}
+    if start is not None:
+        doc["start"] = int(start)
+    bins = doc["bins"]
+    for rec in records:
+        if rec.get("canary"):
+            continue
+        b = freq_bin(rec.get("freq", 0.0))
+        if b is None:
+            continue
+        srcs = bins.setdefault(str(b), [])
+        src = str(rec.get("source", ""))
+        if src not in srcs:
+            srcs.append(src)
+            srcs.sort()
+    doc["covered"] = max(int(doc.get("covered", 0)), int(covered))
+    d = segment_dir(root)
+    os.makedirs(d, exist_ok=True)
+    atomic_write_json(bins_path(root, shard_basename), doc,
+                      sort_keys=True, trailing_newline=True)
+
+
+# -- seeded coincidence planning -------------------------------------------
+
+def hot_components(bin_sources: dict[int, set], freq_tol: float,
+                   min_sources: int) -> list[tuple[int, int]]:
+    """Plan a seeded coincidence pass: from per-bin source sets,
+    return the ``(bin_lo, bin_hi)`` spans of every connected component
+    (occupied bins chained by gaps <= the tolerance's neighbour
+    radius) that contains at least one HOT bin — a bin whose ±radius
+    window unions >= ``min_sources`` distinct sources.
+
+    Components are closed under the within-tolerance relation, so
+    distilling only their records provably reproduces the full
+    distill's qualifying groups: no record outside a returned span can
+    match any record inside one (it would be bin-adjacent, hence in
+    the same component), and any qualifying group's fundamental is a
+    hot bin by construction.
+    """
+    if not bin_sources:
+        return []
+    radius = neighbor_radius(freq_tol)
+    occupied = sorted(bin_sources)
+
+    # components: consecutive occupied bins chained by gap <= radius
+    comps: list[list[int]] = [[occupied[0]]]
+    for b in occupied[1:]:
+        if b - comps[-1][-1] <= radius:
+            comps[-1].append(b)
+        else:
+            comps.append([b])
+
+    # hot test per component via a sliding window over its bins
+    spans: list[tuple[int, int]] = []
+    for comp in comps:
+        hot = False
+        j0 = 0
+        for i, b in enumerate(comp):
+            # union sources over comp bins within [b-radius, b+radius]
+            while comp[j0] < b - radius:
+                j0 += 1
+            srcs: set = set()
+            j = j0
+            while j < len(comp) and comp[j] <= b + radius:
+                srcs |= bin_sources[comp[j]]
+                if len(srcs) >= min_sources:
+                    hot = True
+                    break
+                j += 1
+            if hot:
+                break
+        if hot:
+            spans.append((comp[0], comp[-1]))
+    return spans
+
+
+def spans_to_freq_windows(spans) -> list[tuple[float, float]]:
+    """Frequency intervals (with edge slack) covering the bin spans;
+    callers re-check membership with :func:`freq_bin` so slack can
+    only over-fetch, never mis-classify."""
+    return [bin_freq_range(lo, hi) for lo, hi in spans]
+
+
+def bins_in_spans(b: int | None, spans) -> bool:
+    """Span membership via bisect — spans are sorted and disjoint
+    (hot_components emits them in bin order)."""
+    if b is None or not spans:
+        return False
+    i = bisect.bisect_right(spans, (b, float("inf"))) - 1
+    return i >= 0 and spans[i][0] <= b <= spans[i][1]

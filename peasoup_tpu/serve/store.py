@@ -22,7 +22,9 @@ Record schema (``v`` = 1; consumers tolerate additions)::
     utc        float  ingest time (unix seconds)
     cand_id    str    stable content-derived candidate id
                       (obs/lineage.py, ISSUE 19) — the ``why`` verb's
-                      join key into the lineage ledger
+                      join key into the lineage ledger, and the
+                      record's IDENTITY for the store's retention
+                      policy (a re-run replaces, never duplicates)
     dm_idx     int    DM trial index (part of the id's preimage)
     dm, acc, jerk, freq, snr, folded_snr, nh, period  candidate fields
     prov       dict   producing run's provenance block (run id, git
@@ -38,12 +40,24 @@ worker cannot poison the survey.
 Fleet mode shards the ledger per host
 (:class:`ShardedCandidateStore`): each host APPENDS only to its own
 ``store-<host>.jsonl`` — append-only single-writer files need no
-cross-host locking on a shared filesystem — while every query
-(:meth:`~CandidateStore.query`, the coincidencer
-:meth:`~CandidateStore.coincident_groups`) reads the MERGE of all
-shards plus the legacy single-store file.  A torn tail on one shard
-(its host died mid-append) skips that line only; the merge is
-unaffected.
+cross-host locking on a shared filesystem — while every query reads
+the MERGE of all shards.  **Pinned total merge order** (ISSUE 20):
+the legacy single-store file ``candidates.jsonl`` first (it predates
+every shard), then shards sorted by basename; within a file, line
+order.  The order is a property of the NAMES alone — never of glob or
+directory enumeration order — so merged reads are deterministic
+across hosts and filesystems.  A torn tail on one shard (its host
+died mid-append) skips that line only; the merge is unaffected.
+
+At survey scale the sharded store is *log-structured* (ISSUE 20,
+serve/segments.py): a background compactor (serve/compaction.py)
+folds shard prefixes into immutable frequency-sorted segments with
+sidecar indexes, and every read-side method here sees
+``sealed segments ∪ unsealed shard tails`` — record-identical to the
+full scan, while :meth:`query`, :meth:`coincident_groups` and
+:meth:`lookup` touch only indexed spans.  A store that has never been
+compacted behaves exactly as before (the segment set is empty and
+every tail starts at byte 0).
 """
 
 from __future__ import annotations
@@ -56,6 +70,8 @@ import time
 
 import numpy as np
 
+from . import segments as seglib
+
 STORE_VERSION = 1
 
 #: fleet store shards: <spool>/store-<host_label>.jsonl
@@ -63,6 +79,10 @@ SHARD_PREFIX = "store-"
 
 #: the pre-fleet single-store file, still merged by the sharded reader
 LEGACY_BASENAME = "candidates.jsonl"
+
+#: batch size for the streaming numpy ratio test in :meth:`query` —
+#: bounds peak memory at O(batch), not O(survey)
+QUERY_BATCH = 4096
 
 
 def safe_label(label: str) -> str:
@@ -73,20 +93,26 @@ def safe_label(label: str) -> str:
 
 def _iter_records(path: str, source: str | None = None,
                   min_snr: float | None = None,
-                  include_canary: bool = False):
+                  include_canary: bool = False, start: int = 0):
     """Yield one file's records in file order; corrupt/torn lines and
     a missing file are skipped (ledger rules).  Canary-job records are
     skipped unless ``include_canary`` — known-answer probes must never
-    pollute science reads."""
-    if not os.path.exists(path):
+    pollute science reads.  ``start`` seeks to a byte offset first
+    (always a line boundary: the segment manifest's folded offsets are
+    produced from complete lines only)."""
+    try:
+        f = open(path, "rb")
+    except OSError:
         return
-    with open(path, encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
+    with f:
+        if start:
+            f.seek(int(start))
+        for raw in f:
+            raw = raw.strip()
+            if not raw:
                 continue
             try:
-                rec = json.loads(line)
+                rec = json.loads(raw)
             except ValueError:
                 continue  # torn tail from a killed worker
             if not isinstance(rec, dict) or "freq" not in rec:
@@ -99,6 +125,18 @@ def _iter_records(path: str, source: str | None = None,
                     rec.get("snr", 0.0) < min_snr:
                 continue
             yield rec
+
+
+def _passes(rec: dict, source, min_snr, include_canary) -> bool:
+    """The read-side filter, factored out so segment reads apply the
+    exact predicate `_iter_records` applies to shard reads."""
+    if rec.get("canary") and not include_canary:
+        return False
+    if source is not None and rec.get("source") != source:
+        return False
+    if min_snr is not None and rec.get("snr", 0.0) < min_snr:
+        return False
+    return True
 
 
 #: provenance fields copied from ``SearchResult.provenance`` onto each
@@ -138,6 +176,97 @@ def _record_from_candidate(job_id: str, source: str, cand,
     return rec
 
 
+# -- shared query predicates ------------------------------------------------
+
+def _harmonic_windows(freq: float, freq_tol: float,
+                      max_harm: int) -> list[tuple[float, float]]:
+    """Merged frequency intervals that contain every f satisfying the
+    harmonic-ratio predicate — the index prefilter.  Matching is
+    always re-decided by :func:`_harmonic_hits`, so windows only need
+    to be a superset."""
+    raw = []
+    for j in range(1, int(max_harm) + 1):
+        for k in range(1, int(max_harm) + 1):
+            center = j * float(freq) / k
+            raw.append((center * (1.0 - freq_tol),
+                        center * (1.0 + freq_tol)))
+    raw.sort()
+    merged = [list(raw[0])]
+    for lo, hi in raw[1:]:
+        if lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return [(lo, hi) for lo, hi in merged]
+
+
+def _harmonic_hits(freqs, freq: float, freq_tol: float,
+                   max_harm: int):
+    """Boolean mask over ``freqs``: the search's fractional-ratio
+    predicate ``k*f / (j*freq) in (1 ± freq_tol)`` for some integer
+    ``j, k <= max_harm`` — identical arithmetic on every path (full
+    scan, batch stream, segment range read)."""
+    freqs = np.asarray(freqs, np.float64)
+    hh = np.arange(1, int(max_harm) + 1, dtype=np.float64)
+    # ratio[i, k, j] = hh[k] * f_i / (hh[j] * freq)
+    ratio = (hh[None, :, None] * freqs[:, None, None]
+             / (hh[None, None, :] * float(freq)))
+    return ((ratio > 1 - freq_tol) & (ratio < 1 + freq_tol)).any(
+        axis=(1, 2))
+
+
+def _query_stream(rec_iter, freq: float, freq_tol: float,
+                  max_harm: int, batch: int = QUERY_BATCH):
+    """Run the ratio test over a record stream in fixed-size batches —
+    O(batch) peak memory however large the survey is."""
+    hits: list[dict] = []
+    buf: list[dict] = []
+
+    def _flush():
+        if not buf:
+            return
+        ok = _harmonic_hits([r["freq"] for r in buf], freq, freq_tol,
+                            max_harm)
+        hits.extend(r for r, h in zip(buf, ok) if h)
+        buf.clear()
+
+    for rec in rec_iter:
+        buf.append(rec)
+        if len(buf) >= batch:
+            _flush()
+    _flush()
+    return hits
+
+
+def _distill_groups(recs: list[dict], freq_tol: float,
+                    min_sources: int) -> list[list[dict]]:
+    """The coincidence core shared by the full-scan and seeded paths:
+    canonical pre-sort (strongest first, then the segment record
+    order — deterministic whatever order the records arrived in),
+    DMDistiller greedy matching, group by family, keep groups spanning
+    >= ``min_sources`` distinct observations."""
+    from ..data.candidates import Candidate
+    from ..search.distill import DMDistiller
+
+    if not recs:
+        return []
+    recs = sorted(recs, key=lambda r: (-float(r.get("snr", 0.0)),
+                                       seglib.record_sort_key(r)))
+    cands = [
+        Candidate(dm=r.get("dm", 0.0), snr=r.get("snr", 0.0),
+                  freq=r["freq"])
+        for r in recs
+    ]
+    by_id = {id(c): r for c, r in zip(cands, recs)}
+    fundamentals = DMDistiller(freq_tol, True).distill(cands)
+    groups: list[list[dict]] = []
+    for fund in fundamentals:
+        family = [by_id[id(c)] for c in fund.collect()]
+        if len({r["source"] for r in family}) >= min_sources:
+            groups.append(family)
+    return groups
+
+
 class CandidateStore:
     """Append-only JSONL candidate ledger with survey-level queries."""
 
@@ -164,15 +293,25 @@ class CandidateStore:
         ]
         if not recs:
             return 0
+        self._append(recs)
+        return len(recs)
+
+    def _append(self, recs: list[dict]) -> None:
         d = os.path.dirname(self.path)
         if d:
             os.makedirs(d, exist_ok=True)
         with open(self.path, "a", encoding="utf-8") as f:
             for rec in recs:
                 f.write(json.dumps(rec, sort_keys=True) + "\n")
-        return len(recs)
 
     # -- load / filter -----------------------------------------------------
+
+    def iter_records(self, source: str | None = None,
+                     min_snr: float | None = None,
+                     include_canary: bool = False):
+        """Streaming :meth:`records` — O(1) memory, file order."""
+        return _iter_records(self.path, source, min_snr,
+                             include_canary)
 
     def records(self, source: str | None = None,
                 min_snr: float | None = None,
@@ -180,15 +319,20 @@ class CandidateStore:
         """All SCIENCE records in file order; corrupt lines skipped.
         ``include_canary=True`` adds the canary-tagged records (the
         canary drain's own bookkeeping reads)."""
-        return list(_iter_records(self.path, source, min_snr,
-                                  include_canary))
+        return list(self.iter_records(source, min_snr,
+                                      include_canary))
 
     def count(self) -> int:
-        return len(self.records())
+        """Science-record count, streamed (never materialises the
+        records)."""
+        return sum(1 for _ in self.iter_records())
 
     def sources(self) -> list[str]:
-        """Distinct observations that contributed records."""
-        return sorted({r.get("source", "") for r in self.records()})
+        """Distinct observations that contributed records, streamed."""
+        out: set[str] = set()
+        for rec in self.iter_records():
+            out.add(rec.get("source", ""))
+        return sorted(out)
 
     # -- survey queries ----------------------------------------------------
 
@@ -200,20 +344,12 @@ class CandidateStore:
         ``HarmonicDistiller``: a record at ``f`` matches when
         ``k*f / (j*freq)`` lies within ``1 ± freq_tol`` for some
         integer ``j, k <= max_harm`` (``max_harm=1`` is a plain
-        frequency-ratio match).
+        frequency-ratio match).  The scan streams in
+        :data:`QUERY_BATCH`-record batches, so memory stays bounded
+        at any survey size.
         """
-        recs = self.records()
-        if not recs:
-            return []
-        freqs = np.array([r["freq"] for r in recs], np.float64)
-        # numerator and denominator harmonics both range 1..max_harm
-        hh = np.arange(1, int(max_harm) + 1, dtype=np.float64)
-        # ratio[i, k, j] = hh[k] * f_i / (hh[j] * freq)
-        ratio = (hh[None, :, None] * freqs[:, None, None]
-                 / (hh[None, None, :] * float(freq)))
-        ok = ((ratio > 1 - freq_tol) & (ratio < 1 + freq_tol)).any(
-            axis=(1, 2))
-        return [r for r, hit in zip(recs, ok) if hit]
+        return _query_stream(self.iter_records(), freq, freq_tol,
+                             max_harm)
 
     def coincident_groups(self, freq_tol: float = 1e-4,
                           min_sources: int = 2) -> list[list[dict]]:
@@ -224,27 +360,12 @@ class CandidateStore:
         SNR-sorted matching (frequency ratio within tolerance
         regardless of DM) — the candidate-level analogue of the beam
         coincidencer — so store matching can never drift from the
-        in-run distillation semantics.
+        in-run distillation semantics.  Records are canonically
+        pre-sorted (snr desc, then frequency/identity) so the result
+        is deterministic for a given record SET, independent of file
+        or shard order.
         """
-        from ..data.candidates import Candidate
-        from ..search.distill import DMDistiller
-
-        recs = self.records()
-        if not recs:
-            return []
-        cands = [
-            Candidate(dm=r.get("dm", 0.0), snr=r.get("snr", 0.0),
-                      freq=r["freq"])
-            for r in recs
-        ]
-        by_id = {id(c): r for c, r in zip(cands, recs)}
-        fundamentals = DMDistiller(freq_tol, True).distill(cands)
-        groups: list[list[dict]] = []
-        for fund in fundamentals:
-            family = [by_id[id(c)] for c in fund.collect()]
-            if len({r["source"] for r in family}) >= min_sources:
-                groups.append(family)
-        return groups
+        return _distill_groups(self.records(), freq_tol, min_sources)
 
 
 def shard_path(root: str, host_label: str) -> str:
@@ -254,18 +375,26 @@ def shard_path(root: str, host_label: str) -> str:
 
 
 class ShardedCandidateStore(CandidateStore):
-    """Fleet store: per-host append-only shards, merged reads.
+    """Fleet store: per-host append-only shards, merged log-structured
+    reads.
 
     ``host_label`` names the shard THIS process appends to
     (``store-<host>.jsonl``); without one the store is a pure merged
     reader (the ``status --fleet`` / ``coincidence`` verbs) and
     ingests fall through to the legacy single-store file so nothing is
-    ever dropped.  Every read-side method — :meth:`records` and
-    therefore :meth:`count`, :meth:`sources`, :meth:`query` and the
-    coincidencer :meth:`coincident_groups` — sees the merge of ALL
-    shards plus the legacy file, in (shard name, file order): a
-    deterministic order, so merged queries equal the single-store
-    answer on the same record set (tests/test_fleet.py asserts this).
+    ever dropped.
+
+    Every read-side method sees ``sealed segments ∪ unsealed shard
+    tails`` under the **pinned total merge order**: sealed segments in
+    seal sequence first, then the legacy ``candidates.jsonl`` tail,
+    then shard tails sorted by basename (a pure function of the file
+    NAMES — deterministic on every host and filesystem; the glob-order
+    fragility of the pre-ISSUE-20 reader is gone).  Retention: a
+    ``cand_id`` appearing more than once (a re-run) resolves to the
+    newest copy — a live tail line beats any sealed copy, a later
+    segment's ``supersedes`` beats an earlier segment — so merged
+    reads never show a duplicate that compaction has had a chance to
+    see, and :meth:`count` matches ``len(records())`` exactly.
     """
 
     def __init__(self, root: str, host_label: str | None = None):
@@ -276,28 +405,326 @@ class ShardedCandidateStore(CandidateStore):
             shard_path(self.root, self.host_label)
             if self.host_label is not None
             else os.path.join(self.root, LEGACY_BASENAME))
+        #: read-volume counters of the most recent segment-aware read
+        #: (tests assert queries touch only indexed spans)
+        self.last_read_stats: dict[str, int] = {}
+
+    # -- ingest (bins upkeep) ----------------------------------------------
+
+    def _append(self, recs: list[dict]) -> None:
+        """Shard append + live-tail coincidence-bin upkeep: after the
+        line append, fold the new records' (frequency bin, source)
+        pairs into this shard's ``segments/bins-*.json`` so
+        :meth:`coincident_groups` stays a seeded lookup without
+        rescanning the tail (ISSUE 20).  The bins file is advisory —
+        readers close any coverage gap by scanning uncovered tail
+        bytes — so a crash between the two writes loses nothing."""
+        super()._append(recs)
+        base = os.path.basename(self.path)
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        folded = seglib.folded_offset(seglib.load_manifest(self.root),
+                                      base)
+        doc = seglib.load_bins_file(self.root, base)
+        if int(doc.get("start", 0)) < folded:
+            # a compaction sealed part of our coverage: rebuild the
+            # live bins from the new folded offset (the sealed part
+            # now lives in the segment sidecars)
+            tail = list(_iter_records(self.path, start=folded))
+            seglib.update_bins_file(self.root, base, tail,
+                                    covered=size,
+                                    rebuild_from=folded)
+        else:
+            seglib.update_bins_file(self.root, base, recs,
+                                    covered=size)
+
+    # -- merge plumbing ----------------------------------------------------
 
     def shard_files(self) -> list[str]:
-        """All shard files plus the legacy store, merge order."""
-        shards = sorted(
-            glob.glob(os.path.join(self.root, f"{SHARD_PREFIX}*.jsonl")))
+        """Live JSONL files in pinned merge order: legacy store first
+        (it predates every shard), then shards sorted by basename.
+        Deterministic by construction — derived from names, never from
+        enumeration order."""
+        out: list[str] = []
         legacy = os.path.join(self.root, LEGACY_BASENAME)
         if os.path.exists(legacy):
-            shards.append(legacy)
-        return shards
+            out.append(legacy)
+        shards = sorted(
+            glob.glob(os.path.join(self.root, f"{SHARD_PREFIX}*.jsonl")),
+            key=os.path.basename)
+        out.extend(shards)
+        return out
+
+    def _segments(self) -> "seglib.SegmentSet":
+        segs = seglib.SegmentSet(self.root)
+        self.last_read_stats = segs.reads
+        return segs
+
+    def _tails(self, segs):
+        """Buffer every unsealed tail record (unfiltered — identity
+        resolution must see canaries and all sources) plus the
+        last-occurrence index per cand_id and each record's origin
+        basename.  Post-compaction tails are small; pre-compaction
+        this is the whole store, i.e. exactly the legacy read."""
+        tails: list[dict] = []
+        origins: list[str] = []
+        last: dict[str, int] = {}
+        for path in self.shard_files():
+            base = os.path.basename(path)
+            start = segs.folded_offset(base)
+            for rec in _iter_records(path, include_canary=True,
+                                     start=start):
+                cid = rec.get("cand_id")
+                if cid:
+                    last[str(cid)] = len(tails)
+                tails.append(rec)
+                origins.append(base)
+        segs.reads["tail_lines"] = (segs.reads.get("tail_lines", 0)
+                                    + len(tails))
+        return tails, last, origins
+
+    def _iter_merged(self, source=None, min_snr=None,
+                     include_canary=False, segs=None, tails=None,
+                     last=None):
+        """Segments ∪ tails with retention applied, pinned order."""
+        segs = self._segments() if segs is None else segs
+        if tails is None:
+            tails, last, _ = self._tails(segs)
+        for i, seg in enumerate(segs.segments):
+            suppressed = segs.suppressed_for(i)
+            for rec in seg.iter_records():
+                cid = rec.get("cand_id")
+                if cid and (cid in suppressed or cid in last):
+                    continue  # replaced by a newer copy
+                if _passes(rec, source, min_snr, include_canary):
+                    yield rec
+        for idx, rec in enumerate(tails):
+            cid = rec.get("cand_id")
+            if cid and last.get(str(cid)) != idx:
+                continue  # an older duplicate within the tails
+            if _passes(rec, source, min_snr, include_canary):
+                yield rec
+
+    def iter_records(self, source: str | None = None,
+                     min_snr: float | None = None,
+                     include_canary: bool = False):
+        return self._iter_merged(source, min_snr, include_canary)
 
     def records(self, source: str | None = None,
                 min_snr: float | None = None,
                 include_canary: bool = False) -> list[dict]:
-        out: list[dict] = []
+        return list(self._iter_merged(source, min_snr,
+                                      include_canary))
+
+    # -- counters (index fast paths) ---------------------------------------
+
+    def count(self) -> int:
+        """``len(records())`` without reading segment bodies when the
+        index allows: segment record counts come from the manifest;
+        only retention collisions (tail ids also sealed, cross-segment
+        supersessions) and canary exclusions force index lookups, and
+        only a canary count forces nothing — the common no-collision
+        survey is O(tails + #segments)."""
+        segs = self._segments()
+        if not segs:
+            return sum(
+                1 for path in self.shard_files()
+                for _ in _iter_records(path))
+        tails, last, _ = self._tails(segs)
+        total = 0
+        for i, seg in enumerate(segs.segments):
+            n = seg.records_count - int(seg.entry.get("canary", 0))
+            suspects = segs.suppressed_for(i) | set(last)
+            if suspects:
+                hidden = 0
+                for cid in suspects:
+                    if not seg.contains_cand(cid):
+                        continue
+                    rec = seg.lookup(cid)
+                    if rec is not None and not rec.get("canary"):
+                        hidden += 1
+                n -= hidden
+            total += n
+        for idx, rec in enumerate(tails):
+            cid = rec.get("cand_id")
+            if cid and last.get(str(cid)) != idx:
+                continue
+            if rec.get("canary"):
+                continue
+            total += 1
+        return total
+
+    def sources(self) -> list[str]:
+        """Distinct science observations — per-segment source
+        summaries plus a streamed tail scan; segment bodies are never
+        read."""
+        segs = self._segments()
+        out: set[str] = set()
+        for seg in segs.segments:
+            out.update(seg.idx.get("sources") or ())
         for path in self.shard_files():
-            out.extend(_iter_records(path, source, min_snr,
-                                     include_canary))
-        return out
+            start = segs.folded_offset(os.path.basename(path))
+            for rec in _iter_records(path, start=start):
+                out.add(rec.get("source", ""))
+        return sorted(out)
 
     def shard_counts(self) -> dict[str, int]:
-        """Readable records per shard basename (fleet status table)."""
-        return {
-            os.path.basename(p): sum(1 for _ in _iter_records(p))
-            for p in self.shard_files()
-        }
+        """Science records ingested per shard basename (fleet status
+        table): the manifest's folded-record count plus a streamed
+        count of the unsealed tail — ingest attribution, before
+        cross-shard retention."""
+        segs = self._segments()
+        out: dict[str, int] = {}
+        for path in self.shard_files():
+            base = os.path.basename(path)
+            start = segs.folded_offset(base)
+            out[base] = segs.folded_records(base) + sum(
+                1 for _ in _iter_records(path, start=start))
+        return out
+
+    # -- indexed survey queries --------------------------------------------
+
+    def query(self, freq: float, freq_tol: float = 1e-4,
+              max_harm: int = 1) -> list[dict]:
+        """Harmonically related records (see
+        :meth:`CandidateStore.query`) via the segment indexes: each
+        sealed segment contributes only fence-post range reads over
+        the harmonic windows (or is skipped outright by its min/max
+        summary); only the unsealed tails are scanned.  Results are
+        canonically ordered (frequency, then identity) so the answer
+        is a pure function of the record set — identical before and
+        after any compaction."""
+        segs = self._segments()
+        tails, last, _ = self._tails(segs)
+        windows = _harmonic_windows(float(freq), float(freq_tol),
+                                    int(max_harm))
+        hits: list[dict] = []
+        for i, seg in enumerate(segs.segments):
+            suppressed = segs.suppressed_for(i)
+            cand_rows: list[dict] = []
+            for lo, hi in windows:
+                for rec in seg.iter_freq_range(lo, hi):
+                    cid = rec.get("cand_id")
+                    if cid and (cid in suppressed or cid in last):
+                        continue
+                    if _passes(rec, None, None, False):
+                        cand_rows.append(rec)
+            if cand_rows:
+                ok = _harmonic_hits([r["freq"] for r in cand_rows],
+                                    freq, freq_tol, max_harm)
+                hits.extend(r for r, h in zip(cand_rows, ok) if h)
+        tail_rows = [
+            rec for idx, rec in enumerate(tails)
+            if (not rec.get("cand_id")
+                or last.get(str(rec.get("cand_id"))) == idx)
+            and _passes(rec, None, None, False)
+        ]
+        hits.extend(_query_stream(iter(tail_rows), freq, freq_tol,
+                                  max_harm))
+        hits.sort(key=seglib.record_sort_key)
+        return hits
+
+    def coincident_groups(self, freq_tol: float = 1e-4,
+                          min_sources: int = 2) -> list[list[dict]]:
+        """Cross-observation groups (see
+        :meth:`CandidateStore.coincident_groups`) as a SEEDED distill:
+        per-frequency-bin source masks (segment sidecars + live-tail
+        bins files, the reference coincidencer's per-bin beam counts
+        at survey scale) select the connected bin components that
+        could possibly qualify; only their records are fetched (fence
+        ranges in segments, bin filter over tails) and distilled.
+        Component closure under the ratio tolerance makes this
+        provably record-identical to distilling the whole survey."""
+        segs = self._segments()
+        tails, last, _ = self._tails(segs)
+
+        # per-bin source masks: sealed (sidecars) ∪ live (bins files,
+        # gap-scanned where coverage lags the shard)
+        bins = segs.bin_sources()
+        for path in self.shard_files():
+            base = os.path.basename(path)
+            folded = segs.folded_offset(base)
+            doc = seglib.load_bins_file(self.root, base)
+            for key, srcs in (doc.get("bins") or {}).items():
+                try:
+                    b = int(key)
+                except (TypeError, ValueError):
+                    continue
+                bins.setdefault(b, set()).update(srcs)
+            gap = max(int(doc.get("covered", 0)), folded)
+            for rec in _iter_records(path, start=gap):
+                b = seglib.freq_bin(rec.get("freq", 0.0))
+                if b is not None:
+                    bins.setdefault(b, set()).add(
+                        str(rec.get("source", "")))
+        spans = seglib.hot_components(bins, float(freq_tol),
+                                      int(min_sources))
+        if not spans:
+            return []
+        # dense surveys (most occupied bins selected) degrade to one
+        # sequential stream per segment — seeking span-by-span would
+        # re-read overlapping fence strides many times over
+        selected = sum(
+            1 for b in bins
+            if seglib.bins_in_spans(b, spans))
+        dense = bins and selected >= 0.5 * len(bins)
+
+        seed: list[dict] = []
+        for i, seg in enumerate(segs.segments):
+            suppressed = segs.suppressed_for(i)
+            if dense:
+                span_recs = seg.iter_records()
+            else:
+                span_recs = (
+                    rec
+                    for lo, hi in seglib.spans_to_freq_windows(spans)
+                    for rec in seg.iter_freq_range(lo, hi))
+            for rec in span_recs:
+                if not seglib.bins_in_spans(
+                        seglib.freq_bin(rec.get("freq", 0.0)),
+                        spans):
+                    continue
+                cid = rec.get("cand_id")
+                if cid and (cid in suppressed or cid in last):
+                    continue
+                if _passes(rec, None, None, False):
+                    seed.append(rec)
+        for idx, rec in enumerate(tails):
+            cid = rec.get("cand_id")
+            if cid and last.get(str(cid)) != idx:
+                continue
+            if not _passes(rec, None, None, False):
+                continue
+            if seglib.bins_in_spans(
+                    seglib.freq_bin(rec.get("freq", 0.0)), spans):
+                seed.append(rec)
+        return _distill_groups(seed, freq_tol, min_sources)
+
+    # -- indexed identity lookup (the ``why`` join) ------------------------
+
+    def lookup(self, cand_id_prefix: str) -> list[tuple[dict, str]]:
+        """Records whose ``cand_id`` starts with the prefix, newest
+        copy only, as ``(record, origin)`` pairs — origin is the
+        sealed segment's name or the live file's basename.  On a
+        compacted store this is an index-key lookup (the sidecar
+        ``cand_id → offset`` maps), never a shard scan; only unsealed
+        tails are streamed."""
+        prefix = str(cand_id_prefix)
+        segs = self._segments()
+        tails, last, origins = self._tails(segs)
+        out: list[tuple[dict, str]] = []
+        for rec, seg_name in segs.lookup_prefix(prefix):
+            cid = str(rec.get("cand_id", ""))
+            if cid in last:
+                continue  # a live tail copy is newer
+            out.append((rec, seg_name))
+        for idx, rec in enumerate(tails):
+            cid = str(rec.get("cand_id", ""))
+            if not cid or not cid.startswith(prefix):
+                continue
+            if last.get(cid) != idx:
+                continue  # an older duplicate within the tails
+            out.append((rec, origins[idx]))
+        return out

@@ -274,6 +274,33 @@ def action_retune_batch(sup: "Supervisor", finding: dict) -> dict | None:
     return {"batch_old": old, "batch_new": new}
 
 
+@supervisor_action("compact_store", rule="shard_backlog",
+                   severities=(WARN, CRIT), cooldown_s=60.0)
+def action_compact_store(sup: "Supervisor", finding: dict) -> dict | None:
+    """Unsealed shard tails past the compaction threshold
+    (rule_shard_backlog, ISSUE 20): fold them into sealed, indexed
+    segments so science queries stop paying for the backlog.  Runs
+    the compactor in-process under its own store-level lock; the
+    action cooldown rate-limits the supervisor side, the lock
+    serialises against any operator-run ``compact`` verb.  A lost
+    lock race is inapplicable (None), not an error — someone else is
+    already folding."""
+    from .compaction import Compactor, CompactionPolicy
+
+    report = Compactor(
+        sup.spool.root,
+        CompactionPolicy(min_bytes=1),  # the RULE decided pressure;
+        # fold every live tail rather than re-litigating thresholds
+        clock=sup.clock,
+    ).compact_once()
+    if not report.get("compacted"):
+        # locked (another compactor is folding) or nothing left to
+        # fold (the finding raced a compaction): inapplicable, keep
+        # the cooldown and actions budget for real work
+        return None
+    return report
+
+
 # -- the control loop ------------------------------------------------------
 
 class Supervisor:

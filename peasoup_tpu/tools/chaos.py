@@ -118,6 +118,25 @@ def clock_skew_lease(spool, job_id: str, skew_s: float) -> None:
     os.replace(tmp, path)
 
 
+def compactor_kill(store_root: str, stage: str,
+                   timeout_s: float = 120.0) -> int:
+    """Run one store compaction in a subprocess and hard-kill it at
+    ``stage`` (ISSUE 20 crash drill).  The subprocess uses the
+    ``compact`` verb's ``--fault-stage`` hook, which dies via
+    ``os._exit`` — no unwind, no cleanup — so the on-disk state is
+    exactly what a SIGKILLed compactor leaves: a ``.tmp*`` orphan at
+    worst, the live JSONL shards untouched, the manifest never
+    half-written.  Stages: ``scan``, ``segment_partial``,
+    ``segment_done``, ``index_done``, ``pre_manifest``.  Returns the
+    subprocess exit code (137 when the fault fired)."""
+    proc = subprocess.run(
+        _serve(store_root, "compact", "--force",
+               "--fault-stage", str(stage)),
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True, timeout=float(timeout_s))
+    return proc.returncode
+
+
 def make_plan(seed: int) -> list[dict]:
     """The smoke's seeded fault plan.  The fault *set* is fixed (the
     ISSUE recipe); the seed varies the arrival schedule and which

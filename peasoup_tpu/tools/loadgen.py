@@ -643,6 +643,92 @@ def run_smoke(dirpath: str) -> int:
     return 0
 
 
+# --------------------------------------------------------------------------
+# read-heavy science-query mix (ISSUE 20)
+# --------------------------------------------------------------------------
+
+#: the science-query op mix: surveys re-read far more than they
+#: ingest, and most reads are targeted frequency joins
+QUERY_MIX = (("query", 0.70), ("coincidence", 0.20), ("why", 0.10))
+
+
+def query_mix(n: int, rng, *, freqs: list[float],
+              cand_ids: list[str]) -> list[dict]:
+    """Seeded read-heavy request mix over a live store: ~70%
+    harmonic ``query``, ~20% ``coincidence``, ~10% ``why`` joins —
+    same seed, identical request stream.  ``freqs``/``cand_ids`` are
+    sampled from the store so every request can actually hit."""
+    reqs: list[dict] = []
+    for _ in range(max(0, int(n))):
+        r = rng.random()
+        if r < QUERY_MIX[0][1] or not cand_ids:
+            f = rng.choice(freqs) if freqs else 10.0
+            reqs.append({"op": "query",
+                         "freq": f * (1.0 + rng.uniform(-5e-5, 5e-5)),
+                         "freq_tol": 1e-4,
+                         "max_harm": rng.choice((1, 2, 4))})
+        elif r < QUERY_MIX[0][1] + QUERY_MIX[1][1]:
+            reqs.append({"op": "coincidence", "freq_tol": 1e-4,
+                         "min_sources": 2})
+        else:
+            reqs.append({"op": "why",
+                         "cand_id": rng.choice(cand_ids)[:12]})
+    return reqs
+
+
+def run_query_mix(store_root: str, n: int, *, seed: int = 0,
+                  history: str | None = None) -> dict:
+    """Drive ``n`` seeded science-query requests through the query
+    service in-process and report per-op latency percentiles.  Every
+    request also appends its own ``kind:"query"`` ledger record (the
+    ``query_latency`` SLO rule's input); the summary here is the
+    sweep-level view."""
+    import random
+
+    from ..serve.health import percentile
+    from ..serve.query_service import QueryService
+    from ..serve.store import ShardedCandidateStore
+
+    rng = random.Random(int(seed))
+    store = ShardedCandidateStore(store_root)
+    freqs: list[float] = []
+    cand_ids: list[str] = []
+    for rec in store.iter_records():
+        freqs.append(float(rec["freq"]))
+        if rec.get("cand_id"):
+            cand_ids.append(str(rec["cand_id"]))
+        if len(freqs) >= 512:
+            break
+    svc = QueryService(store_root, ledger_path=history)
+    lat_by_op: dict[str, list[float]] = {}
+    failures = 0
+    t0 = time.perf_counter()
+    for req in query_mix(n, rng, freqs=freqs, cand_ids=cand_ids):
+        res = svc.serve_request(req)
+        lat_by_op.setdefault(req["op"], []).append(
+            float(res["latency_ms"]))
+        if not res.get("ok"):
+            failures += 1
+    wall_s = time.perf_counter() - t0
+    all_lat = sorted(x for v in lat_by_op.values() for x in v)
+    doc = {
+        "v": 1,
+        "store": os.path.abspath(store_root),
+        "requests": int(n),
+        "failures": failures,
+        "wall_s": round(wall_s, 3),
+        "query_p50_ms": round(percentile(all_lat, 0.50), 3),
+        "query_p95_ms": round(percentile(all_lat, 0.95), 3),
+        "per_op": {
+            op: {"n": len(v),
+                 "p50_ms": round(percentile(v, 0.50), 3),
+                 "p95_ms": round(percentile(v, 0.95), 3)}
+            for op, v in sorted(lat_by_op.items())
+        },
+    }
+    return doc
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="peasoup-tpu-loadgen",

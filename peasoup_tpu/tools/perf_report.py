@@ -61,7 +61,8 @@ GATE_METRIC = "e2e_s"
 #: predating a metric stay green.
 STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
                       "jerk_s_per_ktrial", "recovery_fraction",
-                      "chaos_recovery_s", "cold_to_first_candidate_s")
+                      "chaos_recovery_s", "cold_to_first_candidate_s",
+                      "store_query_p50_ms", "compaction_s")
 
 #: metrics where UP is good (ISSUE 11's device_duty_cycle ledger:
 #: device seconds per wall second — a drop means the dispatch pipeline
@@ -70,7 +71,7 @@ STAGE_GATE_METRICS = ("peaks_device_s", "search_device_s",
 #: ``--stage-metrics device_duty_cycle`` gates them correctly.
 HIGHER_IS_BETTER_METRICS = ("device_duty_cycle", "vs_baseline",
                             "jobs_per_hour", "knee_throughput_per_s",
-                            "recovery_fraction")
+                            "recovery_fraction", "store_query_speedup")
 
 SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
 

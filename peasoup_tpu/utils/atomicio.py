@@ -21,6 +21,7 @@ says so, while high-frequency lease heartbeats deliberately never do
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 
@@ -69,6 +70,34 @@ def atomic_write_json(path: str, obj, *, fsync: bool = False,
     if trailing_newline:
         payload += "\n"
     _replace_via_tmp(path, payload, fsync=fsync, encoding="utf-8")
+
+
+@contextlib.contextmanager
+def atomic_writer(path: str, *, fsync: bool = False,
+                  encoding: str = "utf-8"):
+    """Streaming :func:`atomic_write_text`: yields a writable text
+    file object positioned on ``path + ".tmp<pid>"``; the tmp file is
+    renamed over ``path`` only when the ``with`` body exits cleanly,
+    and best-effort removed when it raises.  For artifacts too large
+    to assemble in memory (sealed store segments, ISSUE 20) where the
+    same killed-writer contract must hold: readers see the old file or
+    the complete new one, never a prefix.
+    """
+    path = str(path)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding=encoding) as f:
+            yield f
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def fsync_dir(path: str) -> None:

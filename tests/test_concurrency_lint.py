@@ -1,7 +1,8 @@
 """Tier-1 tests for the concurrency & contracts prover (ISSUE 17):
 PSL010 lock discipline, PSL011 lock ordering, PSL012 atomic-write
-discipline and PSL013 stream contracts — plus the engine's parse
-cache and the full-tree wall-clock budget."""
+discipline, PSL013 stream contracts and PSL014 rename-publication
+discipline (ISSUE 20) — plus the engine's parse cache and the
+full-tree wall-clock budget."""
 
 import os
 import subprocess
@@ -19,7 +20,7 @@ from peasoup_tpu.analysis.engine import (
 from peasoup_tpu.analysis.rules import ALL_RULES, rules_by_id
 
 REPO = repo_root()
-NEW_RULES = ("PSL010", "PSL011", "PSL012", "PSL013")
+NEW_RULES = ("PSL010", "PSL011", "PSL012", "PSL013", "PSL014")
 
 
 def _lint_snippet(tmp_path, code, relpath, rule_ids):
@@ -323,6 +324,102 @@ def test_psl012_pragma_suppresses(tmp_path):
     """, "peasoup_tpu/serve/fixture.py", ["PSL012"])
     assert vs == []
     assert suppressed == 1
+
+
+# --------------------------------------------------------------------------
+# PSL014 — rename publication discipline
+# --------------------------------------------------------------------------
+
+def test_psl014_hand_rolled_rename_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import json
+        import os
+
+        def publish(path, doc):
+            tmp = path + ".tmp"
+            with open(tmp, "x") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+    """, "peasoup_tpu/serve/fixture.py", ["PSL014"])
+    assert [v.rule for v in vs] == ["PSL014"]
+    assert "atomicio" in vs[0].message
+
+
+def test_psl014_os_rename_flagged(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def move(src, dst):
+            os.rename(src, dst)
+    """, "peasoup_tpu/obs/fixture.py", ["PSL014"])
+    assert [v.rule for v in vs] == ["PSL014"]
+
+
+def test_psl014_rotation_idiom_and_queue_exempt(tmp_path):
+    """The shard rotation (``path + ".1"``) and the spool state
+    machine (serve/queue.py — the rename IS the state transition)
+    stay sanctioned."""
+    vs, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def rotate(path):
+            os.replace(path, path + ".1")
+    """, "peasoup_tpu/obs/fixture.py", ["PSL014"])
+    assert vs == []
+    vs, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def transition(src, dst):
+            os.rename(src, dst)
+    """, "peasoup_tpu/serve/queue.py", ["PSL014"])
+    assert vs == []
+
+
+def test_psl014_dynamic_and_binary_update_modes_flagged(tmp_path):
+    """The gap PSL012's constant-text check leaves: a runtime mode
+    expression and a binary truncate-and-read-back mode."""
+    vs, _ = _lint_snippet(tmp_path, """
+        def save(path, mode, blob):
+            with open(path, mode) as f:
+                f.write(blob)
+            with open(path, "wb+") as f:
+                f.write(blob)
+    """, "peasoup_tpu/serve/fixture.py", ["PSL014"])
+    assert [v.rule for v in vs] == ["PSL014", "PSL014"]
+    assert "runtime expression" in vs[0].message
+
+
+def test_psl014_plain_binary_reads_appends_exempt(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        def ok(path, blob):
+            with open(path, "wb") as f:
+                f.write(blob)
+            with open(path, "rb") as f:
+                f.read()
+            with open(path, "a") as f:
+                f.write("line\\n")
+            with open(path) as f:
+                f.read()
+    """, "peasoup_tpu/obs/fixture.py", ["PSL014"])
+    assert vs == []
+
+
+def test_psl014_scoped_to_serve_and_obs(tmp_path):
+    vs, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def move(src, dst):
+            os.rename(src, dst)
+    """, "peasoup_tpu/ops/fixture.py", ["PSL014"])
+    assert vs == []
+
+
+def test_psl014_whole_tree_clean():
+    """The shipped serve/obs planes satisfy their own prover rule —
+    the segment/index/manifest writers all publish through atomicio."""
+    vs, _suppressed, errors = run_rules(rules_by_id(["PSL014"]))
+    assert not errors, errors
+    assert vs == [], "\n".join(v.format() for v in vs)
 
 
 # --------------------------------------------------------------------------
